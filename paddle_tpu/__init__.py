@@ -1,0 +1,24 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+legacy PaddlePaddle (YangXS/Paddle), rebuilt idiomatically on JAX/XLA/Pallas.
+
+Structure mirrors the reference's capability surface (see /root/repo/SURVEY.md),
+not its implementation:
+
+- ``paddle_tpu.ops``      — XLA/Pallas compute ops (replaces paddle/cuda hl_* +
+                            paddle/math + paddle/function; SURVEY §2.1).
+- ``paddle_tpu.nn``       — layer graph + 90-odd layer types (paddle/gserver/layers).
+- ``paddle_tpu.optim``    — optimizers/schedules/regularizers (paddle/parameter).
+- ``paddle_tpu.trainer``  — training drivers + updaters (paddle/trainer).
+- ``paddle_tpu.parallel`` — mesh/sharding/collectives (MultiGradientMachine ring,
+                            pserver sync, NCCL ops → ICI/DCN collectives).
+- ``paddle_tpu.data``     — readers/providers/datasets (python/paddle/v2/reader,
+                            gserver/dataproviders).
+- ``paddle_tpu.metrics``  — evaluators (paddle/gserver/evaluators).
+- ``paddle_tpu.models``   — model zoo for the BASELINE configs.
+- ``paddle_tpu.v2``       — the user-facing v2-style API (python/paddle/v2).
+"""
+
+__version__ = "0.1.0"
+
+from paddle_tpu.core import dtypes  # noqa: F401
+from paddle_tpu.core.init_ctx import init as init  # noqa: F401

@@ -1,0 +1,74 @@
+"""Dtype policy for TPU execution.
+
+The reference is float32-only (``real`` typedef, paddle/math). On TPU the MXU wants
+bfloat16 inputs with float32 accumulation, so compute dtype and parameter dtype are
+split: parameters/optimizer state stay float32, matmul/conv inputs may be cast to
+bfloat16, and accumulation uses ``preferred_element_type=float32``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    accum_dtype: jnp.dtype = jnp.float32
+    # Dot/conv precision. For f32 compute we must request HIGHEST: XLA's DEFAULT
+    # runs reduced-precision passes even on CPU, which breaks the numeric-oracle
+    # tests. For bf16 compute the inputs are already bf16 — DEFAULT is right.
+    precision: lax.Precision = lax.Precision.HIGHEST
+
+    def cast_compute(self, x):
+        if x.dtype != self.compute_dtype and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(self.compute_dtype)
+        return x
+
+
+_F32 = Policy()
+_BF16 = Policy(compute_dtype=jnp.bfloat16, precision=lax.Precision.DEFAULT)
+
+_current: Policy = _F32
+
+
+def current() -> Policy:
+    return _current
+
+
+def set_policy(policy: Policy) -> None:
+    global _current
+    _current = policy
+
+
+@contextlib.contextmanager
+def policy_scope(policy: Policy):
+    global _current
+    prev = _current
+    _current = policy
+    try:
+        yield policy
+    finally:
+        _current = prev
+
+
+def f32_policy() -> Policy:
+    return _F32
+
+
+def bf16_policy() -> Policy:
+    return _BF16
+
+
+def get(name: Optional[str]) -> Policy:
+    if name is None or name == "float32" or name == "f32":
+        return _F32
+    if name in ("bfloat16", "bf16", "mixed"):
+        return _BF16
+    raise ValueError(f"unknown dtype policy {name!r}")

@@ -1,0 +1,68 @@
+"""Process-level initialization + global flags.
+
+Replaces the reference's gflags surface (paddle/utils/Flags.h:19-43: use_gpu,
+trainer_count, trainer_id, num_gradient_servers, ...) and ``paddle.init``
+(python/paddle/v2/__init__.py:65 → initPaddle). Here ``trainer_count`` maps to the
+data axis of a `jax.sharding.Mesh`; multi-host topology comes from
+``jax.distributed.initialize`` (see paddle_tpu/parallel/distributed.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, Optional
+
+log = logging.getLogger("paddle_tpu")
+
+
+@dataclasses.dataclass
+class GlobalFlags:
+    # Device topology (reference: --use_gpu, --trainer_count; Flags.h:19-43).
+    use_tpu: bool = True
+    trainer_count: int = 1
+    trainer_id: int = 0
+    num_hosts: int = 1
+    # Logging / stats (reference: --log_period, --show_param_stats_period).
+    log_period: int = 100
+    show_param_stats_period: int = 0
+    # Random seed (reference: --seed).
+    seed: int = 0
+    # Dtype policy name ("float32" | "bfloat16").
+    dtype_policy: str = "float32"
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+_flags = GlobalFlags()
+_initialized = False
+
+
+def flags() -> GlobalFlags:
+    return _flags
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init(**kwargs: Any) -> GlobalFlags:
+    """paddle.init analog. Accepts the v1 flag names; unknown flags are kept in
+    ``extras`` rather than rejected (the reference forwards argv to gflags)."""
+    global _initialized
+    from paddle_tpu.core import dtypes
+
+    for key, value in kwargs.items():
+        if key == "use_gpu":  # v1 compat: GPU flag means "use the accelerator"
+            _flags.use_tpu = bool(value)
+        elif hasattr(_flags, key) and key != "extras":
+            setattr(_flags, key, type(getattr(_flags, key))(value))
+        else:
+            _flags.extras[key] = value
+    dtypes.set_policy(dtypes.get(_flags.dtype_policy))
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        )
+    _initialized = True
+    return _flags

@@ -1,0 +1,11 @@
+from paddle_tpu.nn.graph import (  # noqa: F401
+    Argument,
+    Context,
+    Layer,
+    Network,
+    ParamAttr,
+    reset_name_scope,
+)
+from paddle_tpu.nn import activations as activations  # noqa: F401
+from paddle_tpu.nn import layers as layers  # noqa: F401
+from paddle_tpu.nn import costs as costs  # noqa: F401

@@ -1,0 +1,100 @@
+"""Activation registry.
+
+Parity with the reference's macro-registered activations
+(paddle/gserver/activations/ActivationFunction.cpp:40-63): sigmoid, softmax,
+sequence_softmax, relu, brelu, tanh, stanh, softrelu, abs, square, exponential,
+log, plus identity/linear. All are pure jnp functions; backward comes from
+autodiff (the reference hand-codes each `backward`)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import ACTIVATIONS
+
+Array = jax.Array
+
+
+def register(*names: str):
+    return ACTIVATIONS.register(*names)
+
+
+@register("linear", "identity", "")
+def linear(x: Array) -> Array:
+    return x
+
+
+@register("sigmoid")
+def sigmoid(x: Array) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+@register("softmax")
+def softmax(x: Array) -> Array:
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("relu")
+def relu(x: Array) -> Array:
+    return jax.nn.relu(x)
+
+
+@register("brelu")
+def brelu(x: Array) -> Array:
+    # Bounded relu, clip at 24 like the reference (BReluActivation).
+    return jnp.clip(x, 0.0, 24.0)
+
+
+@register("tanh")
+def tanh(x: Array) -> Array:
+    return jnp.tanh(x)
+
+
+@register("stanh")
+def stanh(x: Array) -> Array:
+    # Scaled tanh: 1.7159 * tanh(2/3 x) (STanhActivation).
+    return 1.7159 * jnp.tanh(2.0 / 3.0 * x)
+
+
+@register("softrelu")
+def softrelu(x: Array) -> Array:
+    # log(1 + exp(x)), input clipped to +-40 like the reference.
+    return jax.nn.softplus(jnp.clip(x, -40.0, 40.0))
+
+
+@register("abs")
+def abs_(x: Array) -> Array:
+    return jnp.abs(x)
+
+
+@register("square")
+def square(x: Array) -> Array:
+    return jnp.square(x)
+
+
+@register("exponential", "exp")
+def exponential(x: Array) -> Array:
+    return jnp.exp(x)
+
+
+@register("log")
+def log(x: Array) -> Array:
+    return jnp.log(x)
+
+
+ActLike = Union[None, str, Callable[[Array], Array]]
+
+
+def get(act: ActLike) -> Callable[[Array], Array]:
+    if act is None:
+        return linear
+    if callable(act):
+        return act
+    return ACTIVATIONS.get(act)
+
+
+def apply(act: ActLike, x: Array) -> Array:
+    return get(act)(x)

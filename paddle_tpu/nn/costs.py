@@ -1,0 +1,216 @@
+"""Cost (loss) layers.
+
+Parity with paddle/gserver/layers/CostLayer.cpp: multi-class cross-entropy
+(+softmax fused, hl_matrix.h softmax+CE kernels), soft binary CE, squared error,
+rank cost, lambda cost, huber; plus classification output. Each cost layer
+outputs a per-example cost [B] (or [B,1]); the trainer averages/sums — matching
+Argument::sum over the cost layer output in TrainerInternal.cpp:66."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.nn.graph import Argument, Context, Layer
+from paddle_tpu.ops import sequence as seq_ops
+
+Array = jax.Array
+
+
+def _flatten_seq(value: Array, lengths: Optional[Array]):
+    """[B,T,...]+lengths → flat [(B*T), ...] values and [(B*T)] weight mask; or
+    pass-through for non-sequence [B, ...]."""
+    if lengths is None:
+        return value, None
+    b, t = value.shape[0], value.shape[1]
+    mask = seq_ops.mask_from_lengths(lengths, t).reshape(-1)
+    flat = value.reshape((b * t,) + value.shape[2:])
+    return flat, mask
+
+
+class CostLayer(Layer):
+    """Base for costs: handles sequence flattening + per-example weighting."""
+
+    def __init__(self, input: Layer, label: Layer, weight: Optional[Layer] = None, name=None, coeff: float = 1.0):
+        srcs = [input, label] + ([weight] if weight is not None else [])
+        super().__init__(srcs, name=name)
+        self.coeff = coeff
+        self.has_weight = weight is not None
+
+    def per_example(self, ctx, pred: Array, label: Array) -> Array:
+        raise NotImplementedError
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        pred_arg, label_arg = ins[0], ins[1]
+        pred, pmask = _flatten_seq(pred_arg.value, pred_arg.lengths)
+        label, _ = _flatten_seq(label_arg.value, label_arg.lengths)
+        cost = self.per_example(ctx, pred, label)
+        if cost.ndim > 1:
+            cost = cost.reshape(cost.shape[0], -1).sum(-1)
+        if pmask is not None:
+            cost = cost * pmask
+        if self.has_weight:
+            w = ins[2].value.reshape(-1)
+            cost = cost * w
+        # mean over examples (sequences count each timestep, like the reference's
+        # per-instance sum normalized by batch size in Argument::sum semantics).
+        denom = pred_arg.value.shape[0]
+        total = self.coeff * jnp.sum(cost) / denom
+        return Argument(total)
+
+
+@LAYERS.register("classification_cost", "multi_class_cross_entropy")
+class ClassificationCost(CostLayer):
+    """Softmax + multi-class cross-entropy (CostLayer.cpp
+    MultiClassCrossEntropy; the v1 helper classification_cost applies softmax
+    activation on the input layer — here fused via log_softmax for stability).
+    Input: logits or probabilities; set `from_logits=False` if the input layer
+    already applied softmax."""
+
+    type_name = "classification_cost"
+
+    def __init__(self, input, label, weight=None, name=None, coeff=1.0, from_logits=True):
+        super().__init__(input, label, weight, name, coeff)
+        self.from_logits = from_logits
+
+    def per_example(self, ctx, pred, label):
+        if self.from_logits:
+            logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
+        else:
+            logp = jnp.log(jnp.maximum(pred.astype(jnp.float32), 1e-10))
+        label = label.astype(jnp.int32).reshape(-1)
+        return -jnp.take_along_axis(logp, label[:, None], axis=-1)[:, 0]
+
+
+@LAYERS.register("soft_binary_class_cross_entropy")
+class SoftBinaryCrossEntropy(CostLayer):
+    """Per-dimension binary CE with soft targets (SoftBinaryClassCrossEntropy)."""
+
+    type_name = "soft_binary_class_cross_entropy"
+
+    def per_example(self, ctx, pred, label):
+        p = jnp.clip(pred.astype(jnp.float32), 1e-7, 1 - 1e-7)
+        y = label.astype(jnp.float32)
+        return -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)).sum(-1)
+
+
+@LAYERS.register("square_error", "mse_cost", "regression_cost")
+class SquareError(CostLayer):
+    """Sum-of-squares error (SumOfSquaresCostLayer): 0.5*||pred-label||^2."""
+
+    type_name = "square_error"
+
+    def per_example(self, ctx, pred, label):
+        d = pred.astype(jnp.float32) - label.astype(jnp.float32)
+        return 0.5 * jnp.sum(d * d, axis=-1)
+
+
+@LAYERS.register("cross_entropy_with_selfnorm")
+class CrossEntropyWithSelfNorm(CostLayer):
+    """MultiClassCrossEntropyWithSelfNorm: CE + alpha * log(Z)^2 self-norm."""
+
+    type_name = "cross_entropy_with_selfnorm"
+
+    def __init__(self, input, label, weight=None, name=None, coeff=1.0, softmax_selfnorm_alpha=0.1):
+        super().__init__(input, label, weight, name, coeff)
+        self.alpha = softmax_selfnorm_alpha
+
+    def per_example(self, ctx, pred, label):
+        logits = pred.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        logp = logits - logz[:, None]
+        label = label.astype(jnp.int32).reshape(-1)
+        ce = -jnp.take_along_axis(logp, label[:, None], axis=-1)[:, 0]
+        return ce + self.alpha * logz * logz
+
+
+@LAYERS.register("huber_regression_cost")
+class HuberRegression(CostLayer):
+    """HuberRegressionLoss (CostLayer.cpp)."""
+
+    type_name = "huber_regression_cost"
+
+    def __init__(self, input, label, weight=None, name=None, coeff=1.0, delta=1.0):
+        super().__init__(input, label, weight, name, coeff)
+        self.delta = delta
+
+    def per_example(self, ctx, pred, label):
+        d = jnp.abs(pred.astype(jnp.float32) - label.astype(jnp.float32))
+        quad = jnp.minimum(d, self.delta)
+        return jnp.sum(0.5 * quad * quad + self.delta * (d - quad), axis=-1)
+
+
+@LAYERS.register("huber_classification_cost")
+class HuberTwoClassification(CostLayer):
+    """HuberTwoClassification (labels {0,1} → y∈{-1,1}, squared hinge-ish)."""
+
+    type_name = "huber_classification_cost"
+
+    def per_example(self, ctx, pred, label):
+        y = 2.0 * label.astype(jnp.float32).reshape(-1) - 1.0
+        z = pred.astype(jnp.float32).reshape(-1) * y
+        return jnp.where(z < -1, -4 * z, jnp.where(z < 1, jnp.square(1 - z), 0.0))
+
+
+@LAYERS.register("rank_cost")
+class RankCost(Layer):
+    """Pairwise ranking cost (RankingCost, CostLayer.cpp): inputs left/right
+    scores + label in [0,1] preference."""
+
+    type_name = "rank_cost"
+
+    def __init__(self, left: Layer, right: Layer, label: Layer, weight=None, name=None, coeff=1.0):
+        srcs = [left, right, label] + ([weight] if weight is not None else [])
+        super().__init__(srcs, name=name)
+        self.coeff = coeff
+        self.has_weight = weight is not None
+
+    def forward(self, ctx, ins):
+        o = (ins[0].value - ins[1].value).astype(jnp.float32).reshape(-1)
+        t = ins[2].value.astype(jnp.float32).reshape(-1)
+        cost = jax.nn.softplus(o) - t * o  # log(1+e^o) - t*o
+        if self.has_weight:
+            cost = cost * ins[3].value.reshape(-1)
+        return Argument(self.coeff * jnp.mean(cost))
+
+
+@LAYERS.register("multi_binary_label_cross_entropy")
+class MultiBinaryLabelCrossEntropy(CostLayer):
+    """MultiBinaryLabelCrossEntropy: sigmoid CE against multi-hot labels."""
+
+    type_name = "multi_binary_label_cross_entropy"
+
+    def per_example(self, ctx, pred, label):
+        x = pred.astype(jnp.float32)
+        y = label.astype(jnp.float32)
+        # stable sigmoid CE on logits
+        return jnp.sum(jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x))), axis=-1)
+
+
+@LAYERS.register("sum_cost")
+class SumCost(Layer):
+    """SumCostLayer: cost = sum of input activations."""
+
+    type_name = "sum_cost"
+
+    def __init__(self, input: Layer, name=None, coeff: float = 1.0):
+        super().__init__(input, name=name)
+        self.coeff = coeff
+
+    def forward(self, ctx, ins):
+        v = ins[0].value
+        return Argument(self.coeff * jnp.sum(v) / v.shape[0])
+
+
+@LAYERS.register("smooth_l1_cost")
+class SmoothL1(CostLayer):
+    """SmoothL1CostLayer."""
+
+    type_name = "smooth_l1_cost"
+
+    def per_example(self, ctx, pred, label):
+        d = jnp.abs(pred.astype(jnp.float32) - label.astype(jnp.float32))
+        return jnp.sum(jnp.where(d < 1.0, 0.5 * d * d, d - 0.5), axis=-1)

@@ -1,0 +1,388 @@
+"""Functional layer-graph core.
+
+The TPU-native replacement for the reference's Layer/NeuralNetwork machinery
+(paddle/gserver/layers/Layer.h:62 `forward`/`backward`; NeuralNetwork.cpp:245
+forward = ordered loop over layers). Key design shift (SURVEY §7 "hard parts"):
+instead of eager per-layer kernel calls, layers here are *pure specs*; the whole
+forward pass is one traced JAX function, so XLA sees the entire step and fuses /
+schedules it for the MXU. Backward is `jax.grad` of the traced forward — there are
+no hand-written backward methods (the reference's per-layer `backward` and its
+gradient-check harness become `jax.grad` + numeric-check tests).
+
+Data between layers travels as `Argument` — the analog of paddle/parameter/Argument.h:26
+(value + sequenceStartPositions). Ragged sequences become padded [B, T, ...] arrays
+plus a per-example `lengths` vector (segment-id style), the TPU-friendly encoding of
+`Argument.sequenceStartPositions` (Argument.h:84).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import dtypes
+
+Array = jax.Array
+Initializer = Callable[[jax.Array, Sequence[int], Any], Array]
+
+
+# ---------------------------------------------------------------------------
+# Argument: the inter-layer value (paddle/parameter/Argument.h:26)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Argument:
+    """Value flowing between layers.
+
+    value:   [B, ...] dense batch, or [B, T, ...] padded sequence batch.
+    lengths: [B] int32 valid lengths when `value` is a sequence batch
+             (replaces Argument.sequenceStartPositions, Argument.h:84).
+    sub_lengths: [B, S] int32 for nested (sub-)sequences
+             (replaces subSequenceStartPositions, Argument.h:91).
+    """
+
+    value: Array
+    lengths: Optional[Array] = None
+    sub_lengths: Optional[Array] = None
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.value, self.lengths, self.sub_lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- helpers ------------------------------------------------------------
+    @property
+    def is_seq(self) -> bool:
+        return self.lengths is not None
+
+    @property
+    def batch_size(self) -> int:
+        return self.value.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        assert self.is_seq
+        return self.value.shape[1]
+
+    def mask(self, dtype=jnp.float32) -> Array:
+        """[B, T] validity mask from lengths."""
+        assert self.lengths is not None
+        t = self.value.shape[1]
+        return (jnp.arange(t)[None, :] < self.lengths[:, None]).astype(dtype)
+
+    def with_value(self, value: Array) -> "Argument":
+        return Argument(value, self.lengths, self.sub_lengths)
+
+    def as_non_seq(self) -> "Argument":
+        return Argument(self.value)
+
+
+# ---------------------------------------------------------------------------
+# ParamAttr (python/paddle/trainer_config_helpers/attrs.py ParamAttr)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ParamAttr:
+    """Per-parameter attributes: sharing name, init, LR scale, decay, staticness.
+
+    Mirrors the reference's ParameterConfig knobs (proto/ParameterConfig.proto:34:
+    learning_rate, momentum, decay_rate(l2), decay_rate_l1, initial_std/mean,
+    is_static, is_sparse) minus device placement, which is a sharding concern here.
+    """
+
+    name: Optional[str] = None  # set → parameter shared by this global name
+    initializer: Optional[Initializer] = None
+    initial_std: Optional[float] = None
+    initial_mean: float = 0.0
+    learning_rate: float = 1.0
+    momentum: Optional[float] = None
+    l1_decay: Optional[float] = None
+    l2_decay: Optional[float] = None
+    is_static: bool = False
+    is_sparse: bool = False
+    gradient_clipping_threshold: Optional[float] = None
+    # Logical sharding axes for pjit (None → replicated), e.g. ("model", None).
+    sharding: Optional[Tuple[Optional[str], ...]] = None
+
+
+# ---------------------------------------------------------------------------
+# Context: parameter/state plumbing through a forward trace
+# ---------------------------------------------------------------------------
+
+
+class Context:
+    """Threaded through a single forward trace.
+
+    mode='init'  — creates parameters/states eagerly (concrete arrays).
+    mode='apply' — reads from given pytrees; collects state updates (e.g.
+                   batch-norm moving stats — the functional form of the mutable
+                   movingMean_/movingVar_ in BatchNormalizationLayer).
+    """
+
+    def __init__(
+        self,
+        mode: str,
+        params: Dict[str, Array],
+        states: Dict[str, Array],
+        rng: Optional[Array],
+        train: bool,
+        policy: Optional[dtypes.Policy] = None,
+    ):
+        assert mode in ("init", "apply")
+        self.mode = mode
+        self.params = params
+        self.states = states
+        self.rng = rng
+        self.train = train
+        self.policy = policy or dtypes.current()
+        self.state_updates: Dict[str, Array] = {}
+        self.param_attrs: Dict[str, ParamAttr] = {}
+        self._rng_count = 0
+
+    # -- rng ---------------------------------------------------------------
+    def next_rng(self, tag: str) -> Array:
+        if self.rng is None:
+            raise ValueError("no rng available in this context (pass rng= to apply)")
+        self._rng_count += 1
+        return jax.random.fold_in(jax.random.fold_in(self.rng, _stable_hash(tag)), self._rng_count)
+
+    # -- params ------------------------------------------------------------
+    def param(
+        self,
+        layer: "Layer",
+        pname: str,
+        shape: Sequence[int],
+        init: Initializer,
+        attr: Optional[ParamAttr] = None,
+    ) -> Array:
+        attr = attr or ParamAttr()
+        full = attr.name or f"{layer.name}.{pname}"
+        if self.mode == "init":
+            if full not in self.params:
+                initializer = attr.initializer or init
+                if attr.initial_std is not None and attr.initializer is None:
+                    std, mean = attr.initial_std, attr.initial_mean
+                    initializer = (
+                        lambda k, s, d: mean + std * jax.random.normal(k, s, d)
+                    )
+                value = initializer(
+                    self.next_rng(full), tuple(shape), self.policy.param_dtype
+                )
+                self.params[full] = value
+                self.param_attrs[full] = attr
+            else:
+                got = tuple(self.params[full].shape)
+                if got != tuple(shape):
+                    raise ValueError(
+                        f"shared parameter {full!r} shape mismatch: {got} vs {tuple(shape)}"
+                    )
+        value = self.params[full]
+        return value
+
+    # -- state (non-trainable, updated functionally) ------------------------
+    def state(
+        self,
+        layer: "Layer",
+        sname: str,
+        shape: Sequence[int],
+        init_value: Union[float, Array] = 0.0,
+    ) -> Array:
+        full = f"{layer.name}.{sname}"
+        if self.mode == "init" and full not in self.states:
+            self.states[full] = jnp.full(tuple(shape), init_value, dtype=jnp.float32)
+        return self.states[full]
+
+    def update_state(self, layer: "Layer", sname: str, value: Array) -> None:
+        full = f"{layer.name}.{sname}"
+        self.state_updates[full] = value
+
+
+def _stable_hash(s: str) -> int:
+    h = 2166136261
+    for ch in s.encode():
+        h = ((h ^ ch) * 16777619) & 0x7FFFFFFF
+    return h
+
+
+# ---------------------------------------------------------------------------
+# Layer base + naming
+# ---------------------------------------------------------------------------
+
+_name_lock = threading.Lock()
+_name_counters: Dict[str, int] = {}
+
+
+def _auto_name(type_name: str) -> str:
+    with _name_lock:
+        idx = _name_counters.get(type_name, 0)
+        _name_counters[type_name] = idx + 1
+    return f"__{type_name}_{idx}__"
+
+
+def reset_name_scope() -> None:
+    """Reset auto-name counters (call between independently-built graphs)."""
+    with _name_lock:
+        _name_counters.clear()
+
+
+class Layer:
+    """A pure layer spec node in the graph.
+
+    Subclasses implement `forward(ctx, ins) -> Argument`. No backward: autodiff
+    handles it. `type_name` doubles as the registry key (REGISTER_LAYER analog).
+    """
+
+    type_name: str = "layer"
+
+    def __init__(
+        self,
+        inputs: Union[None, "Layer", Sequence["Layer"]] = None,
+        name: Optional[str] = None,
+        **kwargs: Any,
+    ):
+        if inputs is None:
+            inputs = []
+        elif isinstance(inputs, Layer):
+            inputs = [inputs]
+        else:
+            inputs = list(inputs)
+        for i, l in enumerate(inputs):
+            if not isinstance(l, Layer):
+                raise TypeError(
+                    f"{type(self).__name__} input {i} is {type(l).__name__}, not a Layer"
+                )
+        self.inputs: List[Layer] = inputs
+        self.name = name or _auto_name(self.type_name)
+        self.cfg = kwargs
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+
+# ---------------------------------------------------------------------------
+# Network: topological execution of a layer DAG
+# ---------------------------------------------------------------------------
+
+
+class Network:
+    """Compiles a layer DAG into pure init/apply functions.
+
+    The analog of NeuralNetwork (gserver/gradientmachines/NeuralNetwork.cpp:245):
+    topological order once, then `apply` evaluates each layer exactly once. Unlike
+    the reference, `apply` is pure and intended to be called *inside* jit/pjit so
+    the whole step compiles to one XLA program (SURVEY §7 hard-part (1))."""
+
+    def __init__(self, outputs: Union[Layer, Sequence[Layer]]):
+        if isinstance(outputs, Layer):
+            outputs = [outputs]
+        self.outputs: List[Layer] = list(outputs)
+        self.layer_order: List[Layer] = _topo_sort(self.outputs)
+        self.layers_by_name: Dict[str, Layer] = {}
+        for l in self.layer_order:
+            if l.name in self.layers_by_name and self.layers_by_name[l.name] is not l:
+                raise ValueError(f"duplicate layer name {l.name!r}")
+            self.layers_by_name[l.name] = l
+        self.param_attrs: Dict[str, ParamAttr] = {}
+
+    # -- data layer discovery ----------------------------------------------
+    @property
+    def data_names(self) -> List[str]:
+        return [l.name for l in self.layer_order if l.type_name == "data"]
+
+    # -- init ---------------------------------------------------------------
+    def init(
+        self,
+        rng: Array,
+        batch: Dict[str, Union[Argument, Array, np.ndarray]],
+        train: bool = True,
+    ) -> Tuple[Dict[str, Array], Dict[str, Array]]:
+        """Create params/states by running forward eagerly on a sample batch."""
+        params: Dict[str, Array] = {}
+        states: Dict[str, Array] = {}
+        ctx = Context("init", params, states, rng, train)
+        self._run(ctx, batch)
+        self.param_attrs = dict(ctx.param_attrs)
+        return params, states
+
+    # -- apply --------------------------------------------------------------
+    def apply(
+        self,
+        params: Dict[str, Array],
+        states: Dict[str, Array],
+        batch: Dict[str, Any],
+        train: bool = False,
+        rng: Optional[Array] = None,
+    ) -> Tuple[Dict[str, Argument], Dict[str, Array]]:
+        """Pure forward. Returns ({output_layer_name: Argument}, new_states)."""
+        ctx = Context("apply", params, states, rng, train)
+        values = self._run(ctx, batch)
+        new_states = dict(states)
+        new_states.update(ctx.state_updates)
+        outs = {l.name: values[l.name] for l in self.outputs}
+        return outs, new_states
+
+    def _run(self, ctx: Context, batch: Dict[str, Any]) -> Dict[str, Argument]:
+        values: Dict[str, Argument] = {}
+        for layer in self.layer_order:
+            if layer.type_name == "data":
+                values[layer.name] = _feed_to_argument(batch, layer)
+            else:
+                ins = [values[l.name] for l in layer.inputs]
+                out = layer.forward(ctx, ins)
+                if not isinstance(out, Argument):
+                    raise TypeError(
+                        f"layer {layer.name} forward returned {type(out).__name__}"
+                    )
+                values[layer.name] = out
+        return values
+
+
+def _topo_sort(outputs: Sequence[Layer]) -> List[Layer]:
+    order: List[Layer] = []
+    seen: Dict[int, int] = {}  # id -> 0 visiting, 1 done
+
+    def visit(l: Layer):
+        key = id(l)
+        st = seen.get(key)
+        if st == 1:
+            return
+        if st == 0:
+            raise ValueError(f"cycle in layer graph at {l.name}")
+        seen[key] = 0
+        for dep in l.inputs:
+            visit(dep)
+        seen[key] = 1
+        order.append(l)
+
+    for out in outputs:
+        visit(out)
+    return order
+
+
+def _feed_to_argument(batch: Dict[str, Any], layer: Layer) -> Argument:
+    if layer.name not in batch:
+        raise KeyError(
+            f"data layer {layer.name!r} missing from batch; got {sorted(batch)}"
+        )
+    v = batch[layer.name]
+    if isinstance(v, Argument):
+        return v
+    v = jnp.asarray(v)
+    lengths_key = layer.name + ".lengths"
+    if lengths_key in batch:
+        return Argument(v, jnp.asarray(batch[lengths_key]))
+    return Argument(v)

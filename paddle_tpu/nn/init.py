@@ -1,0 +1,78 @@
+"""Parameter initializers.
+
+The reference's default is N(0, 1/sqrt(fan_in)) (paddle/parameter/Parameter.cpp
+randomize: initial_std defaults to 1/sqrt(dim0); config_parser.py sets
+initial_strategy/initial_smart). We keep that default plus the standard menu."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def zeros(key: Array, shape: Sequence[int], dtype: Any = jnp.float32) -> Array:
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key: Array, shape: Sequence[int], dtype: Any = jnp.float32) -> Array:
+    return jnp.ones(shape, dtype)
+
+
+def constant(value: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+
+    return init
+
+
+def normal(std: float = 1.0, mean: float = 0.0):
+    def init(key, shape, dtype=jnp.float32):
+        return mean + std * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+def uniform(scale: float = 1.0):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+    return init
+
+
+def _fan_in(shape: Sequence[int]) -> int:
+    if len(shape) == 1:
+        return shape[0]
+    if len(shape) == 2:
+        return shape[0]
+    # conv kernels [kh, kw, cin, cout] (NHWC/HWIO layout)
+    receptive = 1
+    for d in shape[:-2]:
+        receptive *= d
+    return receptive * shape[-2]
+
+
+def smart_normal(key: Array, shape: Sequence[int], dtype: Any = jnp.float32) -> Array:
+    """N(0, 1/sqrt(fan_in)) — the reference's 'initial_smart' default."""
+    std = 1.0 / math.sqrt(max(1, _fan_in(shape)))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def xavier(key: Array, shape: Sequence[int], dtype: Any = jnp.float32) -> Array:
+    fan_in = _fan_in(shape)
+    fan_out = shape[-1] if len(shape) >= 2 else shape[0]
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def he_normal(key: Array, shape: Sequence[int], dtype: Any = jnp.float32) -> Array:
+    std = math.sqrt(2.0 / max(1, _fan_in(shape)))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+default_weight_init = smart_normal
+default_bias_init = zeros

@@ -1,0 +1,570 @@
+"""Core layers (batch 1: dense / image / elementwise).
+
+TPU-native re-implementations of the reference layer types in
+paddle/gserver/layers/ (93 REGISTER_LAYER registrations, Layer.h:31). Each class
+docstring cites the reference layer it matches. Layers are pure specs — see
+paddle_tpu/nn/graph.py; backward is autodiff."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.nn import activations as act_mod
+from paddle_tpu.nn import init as init_mod
+from paddle_tpu.nn.graph import Argument, Context, Layer, ParamAttr
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import linalg
+
+Array = jax.Array
+
+
+def _attr(a: Optional[Union[ParamAttr, dict]]) -> Optional[ParamAttr]:
+    if a is None or isinstance(a, ParamAttr):
+        return a
+    return ParamAttr(**a)
+
+
+@LAYERS.register("data")
+class Data(Layer):
+    """Input slot (DataLayer, gserver/layers/DataLayer.cpp). `shape` excludes the
+    batch dim; sequence inputs additionally carry lengths in the feed dict."""
+
+    type_name = "data"
+
+    def __init__(self, name: str, shape: Sequence[int] = (), is_seq: bool = False):
+        super().__init__(None, name=name)
+        self.shape = tuple(shape)
+        self.is_seq = is_seq
+
+    def forward(self, ctx, ins):  # data layers are fed directly by Network._run
+        raise AssertionError("data layer forward should not be called")
+
+
+@LAYERS.register("fc")
+class Fc(Layer):
+    """Fully-connected (FullyConnectedLayer.cpp). Multiple inputs each get their
+    own weight, summed before bias+activation — matching the reference, whose fc
+    accepts several inputs. Sequence inputs are applied per-timestep."""
+
+    type_name = "fc"
+
+    def __init__(
+        self,
+        input: Union[Layer, Sequence[Layer]],
+        size: int,
+        act: Any = "tanh",
+        bias: bool = True,
+        param_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.size = size
+        self.act = act
+        self.bias = bias
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        total = None
+        for i, arg in enumerate(ins):
+            x = arg.value
+            d = x.shape[-1]
+            suffix = "" if len(ins) == 1 else f".{i}"
+            w = ctx.param(
+                self, "w" + suffix, (d, self.size), init_mod.smart_normal, self.param_attr
+            )
+            y = linalg.matmul(x, w, ctx.policy)
+            total = y if total is None else total + y
+        if self.bias:
+            b = ctx.param(self, "b", (self.size,), init_mod.zeros, self.bias_attr)
+            total = total + b
+        total = act_mod.apply(self.act, total)
+        return ins[0].with_value(total)
+
+
+@LAYERS.register("embedding")
+class Embedding(Layer):
+    """Embedding lookup (TableProjection + hl_table_apply row select,
+    paddle/cuda/src/hl_table_apply.cu). Input carries int ids [B] or [B, T]."""
+
+    type_name = "embedding"
+
+    def __init__(
+        self,
+        input: Layer,
+        size: int,
+        vocab_size: Optional[int] = None,
+        param_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.size = size
+        self.vocab_size = vocab_size
+        self.param_attr = _attr(param_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        ids = ins[0].value
+        vocab = self.vocab_size
+        if vocab is None:
+            src = self.inputs[0]
+            vocab = getattr(src, "shape", (None,))[0]
+            if vocab is None:
+                raise ValueError(
+                    f"embedding {self.name}: vocab_size not set and input has no shape"
+                )
+        table = ctx.param(
+            self, "w", (vocab, self.size), init_mod.smart_normal, self.param_attr
+        )
+        out = jnp.take(table, ids.astype(jnp.int32), axis=0)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("conv")
+class Conv2D(Layer):
+    """2-D convolution, NHWC (ExpandConvLayer.cpp / CudnnConvBaseLayer.cpp via
+    GemmConvOp; here a single XLA conv HLO on the MXU)."""
+
+    type_name = "conv"
+
+    def __init__(
+        self,
+        input: Layer,
+        num_filters: int,
+        filter_size: Union[int, Tuple[int, int]],
+        stride: Union[int, Tuple[int, int]] = 1,
+        padding: Union[int, Tuple[int, int], str] = 0,
+        dilation: Union[int, Tuple[int, int]] = 1,
+        groups: int = 1,
+        act: Any = None,
+        bias: bool = True,
+        param_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.num_filters = num_filters
+        self.filter_size = filter_size
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.act = act
+        self.bias = bias
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        assert x.ndim == 4, f"conv {self.name}: expect NHWC input, got {x.shape}"
+        kh, kw = conv_ops._pair(self.filter_size)
+        cin = x.shape[-1]
+        w = ctx.param(
+            self,
+            "w",
+            (kh, kw, cin // self.groups, self.num_filters),
+            init_mod.he_normal,
+            self.param_attr,
+        )
+        out = conv_ops.conv2d(
+            x, w, self.stride, self.padding, self.dilation, self.groups, ctx.policy
+        )
+        if self.bias:
+            b = ctx.param(self, "b", (self.num_filters,), init_mod.zeros, self.bias_attr)
+            out = out + b
+        out = act_mod.apply(self.act, out)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("conv_transpose")
+class Conv2DTranspose(Layer):
+    """Transposed 2-D conv (ExpandConvLayer with trans=True; ConvTransLayerBase)."""
+
+    type_name = "conv_transpose"
+
+    def __init__(
+        self,
+        input: Layer,
+        num_filters: int,
+        filter_size: Union[int, Tuple[int, int]],
+        stride: Union[int, Tuple[int, int]] = 1,
+        padding: Union[int, Tuple[int, int]] = 0,
+        act: Any = None,
+        bias: bool = True,
+        param_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.num_filters = num_filters
+        self.filter_size = filter_size
+        self.stride = stride
+        self.padding = padding
+        self.act = act
+        self.bias = bias
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        kh, kw = conv_ops._pair(self.filter_size)
+        cin = x.shape[-1]
+        w = ctx.param(
+            self,
+            "w",
+            (kh, kw, self.num_filters, cin),
+            init_mod.he_normal,
+            self.param_attr,
+        )
+        out = conv_ops.conv2d_transpose(x, w, self.stride, self.padding, ctx.policy)
+        if self.bias:
+            b = ctx.param(self, "b", (self.num_filters,), init_mod.zeros, self.bias_attr)
+            out = out + b
+        out = act_mod.apply(self.act, out)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("pool")
+class Pool2D(Layer):
+    """Max/avg pooling, NHWC (PoolLayer.cpp / CudnnPoolLayer.cpp;
+    hl_maxpool/avgpool kernels in hl_cuda_cnn.cu)."""
+
+    type_name = "pool"
+
+    def __init__(
+        self,
+        input: Layer,
+        pool_size: Union[int, Tuple[int, int]],
+        pool_type: str = "max",
+        stride: Optional[Union[int, Tuple[int, int]]] = None,
+        padding: Union[int, Tuple[int, int]] = 0,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        assert pool_type in ("max", "avg")
+        self.pool_size = pool_size
+        self.pool_type = pool_type
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        if self.pool_type == "max":
+            out = conv_ops.max_pool2d(x, self.pool_size, self.stride, self.padding)
+        else:
+            out = conv_ops.avg_pool2d(x, self.pool_size, self.stride, self.padding)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("batch_norm")
+class BatchNorm(Layer):
+    """Batch normalization (BatchNormalizationLayer.cpp / CudnnBatchNormLayer.cpp;
+    hl_batch_norm.cu). Works on [B, D] or NHWC [B, H, W, C]; moving stats are
+    functional state updated only in train mode (movingAvgFraction default 0.9,
+    BatchNormBaseLayer)."""
+
+    type_name = "batch_norm"
+
+    def __init__(
+        self,
+        input: Layer,
+        act: Any = None,
+        epsilon: float = 1e-5,
+        moving_average_fraction: float = 0.9,
+        use_global_stats: Optional[bool] = None,
+        param_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.act = act
+        self.epsilon = epsilon
+        self.maf = moving_average_fraction
+        self.use_global_stats = use_global_stats
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        c = x.shape[-1]
+        axes = tuple(range(x.ndim - 1))
+        gamma = ctx.param(self, "scale", (c,), init_mod.ones, self.param_attr)
+        beta = ctx.param(self, "bias", (c,), init_mod.zeros, self.bias_attr)
+        moving_mean = ctx.state(self, "moving_mean", (c,), 0.0)
+        moving_var = ctx.state(self, "moving_var", (c,), 1.0)
+        use_global = (
+            self.use_global_stats
+            if self.use_global_stats is not None
+            else not ctx.train
+        )
+        if use_global:
+            mean, var = moving_mean, moving_var
+        else:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            ctx.update_state(
+                self, "moving_mean", self.maf * moving_mean + (1 - self.maf) * mean
+            )
+            ctx.update_state(
+                self, "moving_var", self.maf * moving_var + (1 - self.maf) * var
+            )
+        inv = jax.lax.rsqrt(var + self.epsilon) * gamma
+        out = ((x.astype(jnp.float32) - mean) * inv + beta).astype(x.dtype)
+        out = act_mod.apply(self.act, out)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("dropout")
+class Dropout(Layer):
+    """Dropout (Layer.h drop_rate handling in Layer::forwardDropOut). Inverted
+    dropout: scales by 1/(1-rate) at train time, identity at inference."""
+
+    type_name = "dropout"
+
+    def __init__(self, input: Layer, rate: float, name: Optional[str] = None):
+        super().__init__(input, name=name)
+        self.rate = rate
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        if not ctx.train or self.rate <= 0.0:
+            return ins[0]
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(ctx.next_rng(self.name), keep, x.shape)
+        return ins[0].with_value(jnp.where(mask, x / keep, 0).astype(x.dtype))
+
+
+@LAYERS.register("addto")
+class Addto(Layer):
+    """Elementwise sum of N inputs (+bias, activation) — AddtoLayer.cpp.
+    This is the residual-connection workhorse for ResNet."""
+
+    type_name = "addto"
+
+    def __init__(
+        self,
+        input: Sequence[Layer],
+        act: Any = None,
+        bias: bool = False,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.act = act
+        self.bias = bias
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        out = ins[0].value
+        for other in ins[1:]:
+            out = out + other.value
+        if self.bias:
+            b = ctx.param(self, "b", (out.shape[-1],), init_mod.zeros, self.bias_attr)
+            out = out + b
+        out = act_mod.apply(self.act, out)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("concat")
+class Concat(Layer):
+    """Feature-axis concat of N inputs (ConcatenateLayer.cpp)."""
+
+    type_name = "concat"
+
+    def __init__(self, input: Sequence[Layer], act: Any = None, name=None):
+        super().__init__(input, name=name)
+        self.act = act
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        out = jnp.concatenate([a.value for a in ins], axis=-1)
+        out = act_mod.apply(self.act, out)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("scaling")
+class Scaling(Layer):
+    """Row-wise scale: out[i] = w[i] * x[i], weight from first input
+    (ScalingLayer.cpp: input[0]=weight [B,1], input[1]=data)."""
+
+    type_name = "scaling"
+
+    def __init__(self, weight: Layer, input: Layer, name=None):
+        super().__init__([weight, input], name=name)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        w, x = ins[0].value, ins[1].value
+        while w.ndim < x.ndim:
+            w = w[..., None]
+        return ins[1].with_value(w * x)
+
+
+@LAYERS.register("slope_intercept")
+class SlopeIntercept(Layer):
+    """y = slope * x + intercept (SlopeInterceptLayer.cpp)."""
+
+    type_name = "slope_intercept"
+
+    def __init__(self, input: Layer, slope: float = 1.0, intercept: float = 0.0, name=None):
+        super().__init__(input, name=name)
+        self.slope = slope
+        self.intercept = intercept
+
+    def forward(self, ctx, ins):
+        return ins[0].with_value(self.slope * ins[0].value + self.intercept)
+
+
+@LAYERS.register("interpolation")
+class Interpolation(Layer):
+    """out = w*x + (1-w)*y with per-row weight (InterpolationLayer.cpp).
+    inputs: [weight [B,1], x, y]."""
+
+    type_name = "interpolation"
+
+    def __init__(self, weight: Layer, input1: Layer, input2: Layer, name=None):
+        super().__init__([weight, input1, input2], name=name)
+
+    def forward(self, ctx, ins):
+        w = ins[0].value
+        x, y = ins[1].value, ins[2].value
+        while w.ndim < x.ndim:
+            w = w[..., None]
+        return ins[1].with_value(w * x + (1.0 - w) * y)
+
+
+@LAYERS.register("power")
+class Power(Layer):
+    """out[i] = x[i] ** p[i], per-row exponent from first input (PowerLayer.cpp)."""
+
+    type_name = "power"
+
+    def __init__(self, exponent: Layer, input: Layer, name=None):
+        super().__init__([exponent, input], name=name)
+
+    def forward(self, ctx, ins):
+        p, x = ins[0].value, ins[1].value
+        while p.ndim < x.ndim:
+            p = p[..., None]
+        return ins[1].with_value(jnp.power(x, p))
+
+
+@LAYERS.register("dot_prod")
+class DotProd(Layer):
+    """Row-wise dot product of two inputs → [B, 1] (DotProdLayer.cpp)."""
+
+    type_name = "dot_prod"
+
+    def __init__(self, input1: Layer, input2: Layer, name=None):
+        super().__init__([input1, input2], name=name)
+
+    def forward(self, ctx, ins):
+        out = jnp.sum(ins[0].value * ins[1].value, axis=-1, keepdims=True)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("cos_sim")
+class CosSim(Layer):
+    """Row-wise cosine similarity ×scale → [B, 1] (CosSimLayer.cpp,
+    paddle/function/CosSimOp.cpp)."""
+
+    type_name = "cos_sim"
+
+    def __init__(self, input1: Layer, input2: Layer, scale: float = 1.0, name=None):
+        super().__init__([input1, input2], name=name)
+        self.scale = scale
+
+    def forward(self, ctx, ins):
+        a, b = ins[0].value, ins[1].value
+        num = jnp.sum(a * b, axis=-1, keepdims=True)
+        den = jnp.linalg.norm(a, axis=-1, keepdims=True) * jnp.linalg.norm(
+            b, axis=-1, keepdims=True
+        )
+        return ins[0].with_value(self.scale * num / jnp.maximum(den, 1e-12))
+
+
+@LAYERS.register("mixed")
+class Mixed(Layer):
+    """Sum of projections (MixedLayer.cpp): each input arrives via a Projection
+    object (see paddle_tpu/nn/projections.py); results are summed, then
+    bias+activation — matching Projection.h/Operator.h semantics."""
+
+    type_name = "mixed"
+
+    def __init__(
+        self,
+        input: Sequence["Projection"],
+        size: Optional[int] = None,
+        act: Any = None,
+        bias: bool = False,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        from paddle_tpu.nn.projections import Projection
+
+        self.projections = []
+        srcs: List[Layer] = []
+        for p in input:
+            if not isinstance(p, Projection):
+                raise TypeError("mixed layer inputs must be Projections")
+            self.projections.append(p)
+            srcs.extend(p.sources)
+        super().__init__(srcs, name=name)
+        self.size = size
+        self.act = act
+        self.bias = bias
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        out = None
+        pos = 0
+        first_arg = None
+        for proj in self.projections:
+            n = len(proj.sources)
+            args = ins[pos : pos + n]
+            pos += n
+            if first_arg is None:
+                first_arg = args[0]
+            y = proj.apply(ctx, self, args, self.size)
+            out = y if out is None else out + y
+        if self.bias:
+            b = ctx.param(self, "b", (out.shape[-1],), init_mod.zeros, self.bias_attr)
+            out = out + b
+        out = act_mod.apply(self.act, out)
+        return first_arg.with_value(out)
+
+
+@LAYERS.register("trans")
+class Trans(Layer):
+    """Matrix transpose of the feature block [B, M*N] viewed as MxN (TransLayer)."""
+
+    type_name = "trans"
+
+    def __init__(self, input: Layer, height: int, name=None):
+        super().__init__(input, name=name)
+        self.height = height
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        b, d = x.shape
+        h = self.height
+        out = x.reshape(b, h, d // h).swapaxes(1, 2).reshape(b, d)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("reshape")
+class Reshape(Layer):
+    """Feature reshape (ResizeLayer semantics: reinterpret [B, D] as [B', D'])."""
+
+    type_name = "reshape"
+
+    def __init__(self, input: Layer, shape: Sequence[int], name=None):
+        super().__init__(input, name=name)
+        self.shape = tuple(shape)
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        return Argument(x.reshape((x.shape[0],) + self.shape))

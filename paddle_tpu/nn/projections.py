@@ -1,0 +1,173 @@
+"""Projections / operators for Mixed layers.
+
+Parity with paddle/gserver/layers/Projection.h + Operator.h and their concrete
+classes (FullMatrixProjection, TableProjection, DotMulProjection,
+IdentityProjection, ScalingProjection, ContextProjection, TransposedFullMatrix).
+A Projection is a parameterized transform of one (or two) source layers whose
+results the Mixed layer sums."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn import init as init_mod
+from paddle_tpu.nn.graph import Argument, Context, Layer, ParamAttr
+from paddle_tpu.ops import linalg
+from paddle_tpu.ops import sequence as seq_ops
+
+
+class Projection:
+    def __init__(self, sources: Sequence[Layer], param_attr: Any = None):
+        self.sources: List[Layer] = list(sources)
+        self.param_attr = (
+            param_attr if isinstance(param_attr, (ParamAttr, type(None))) else ParamAttr(**param_attr)
+        )
+        self.tag: Optional[str] = None  # set by Mixed for param naming
+
+    def apply(self, ctx: Context, owner: Layer, args: List[Argument], size):
+        raise NotImplementedError
+
+    def _pname(self, owner: Layer, base: str) -> str:
+        idx = owner.projections.index(self)
+        return f"proj{idx}.{base}"
+
+
+class FullMatrix(Projection):
+    """FullMatrixProjection: x @ W."""
+
+    def __init__(self, input: Layer, param_attr: Any = None):
+        super().__init__([input], param_attr)
+
+    def apply(self, ctx, owner, args, size):
+        x = args[0].value
+        w = ctx.param(
+            owner,
+            self._pname(owner, "w"),
+            (x.shape[-1], size),
+            init_mod.smart_normal,
+            self.param_attr,
+        )
+        return linalg.matmul(x, w, ctx.policy)
+
+
+class TransposedFullMatrix(Projection):
+    """TransposedFullMatrixProjection: x @ W^T (weight stored [size, in])."""
+
+    def __init__(self, input: Layer, param_attr: Any = None):
+        super().__init__([input], param_attr)
+
+    def apply(self, ctx, owner, args, size):
+        x = args[0].value
+        w = ctx.param(
+            owner,
+            self._pname(owner, "w"),
+            (size, x.shape[-1]),
+            init_mod.smart_normal,
+            self.param_attr,
+        )
+        return linalg.matmul(x, w.T, ctx.policy)
+
+
+class Identity(Projection):
+    """IdentityProjection / IdentityOffsetProjection."""
+
+    def __init__(self, input: Layer, offset: int = 0, size: Optional[int] = None):
+        super().__init__([input])
+        self.offset = offset
+        self.slice_size = size
+
+    def apply(self, ctx, owner, args, size):
+        x = args[0].value
+        if self.offset or (self.slice_size and self.slice_size != x.shape[-1]):
+            end = self.offset + (self.slice_size or size or x.shape[-1])
+            return x[..., self.offset : end]
+        return x
+
+
+class DotMul(Projection):
+    """DotMulProjection: elementwise x * w with learned w[D]."""
+
+    def __init__(self, input: Layer, param_attr: Any = None):
+        super().__init__([input], param_attr)
+
+    def apply(self, ctx, owner, args, size):
+        x = args[0].value
+        w = ctx.param(
+            owner,
+            self._pname(owner, "w"),
+            (x.shape[-1],),
+            init_mod.ones,
+            self.param_attr,
+        )
+        return x * w
+
+
+class Scaling(Projection):
+    """ScalingProjection: a single learned scalar times x."""
+
+    def __init__(self, input: Layer, param_attr: Any = None):
+        super().__init__([input], param_attr)
+
+    def apply(self, ctx, owner, args, size):
+        x = args[0].value
+        w = ctx.param(
+            owner, self._pname(owner, "w"), (1,), init_mod.ones, self.param_attr
+        )
+        return x * w[0]
+
+
+class Table(Projection):
+    """TableProjection: embedding lookup from int-id input."""
+
+    def __init__(self, input: Layer, vocab_size: int, param_attr: Any = None):
+        super().__init__([input], param_attr)
+        self.vocab_size = vocab_size
+
+    def apply(self, ctx, owner, args, size):
+        ids = args[0].value.astype(jnp.int32)
+        table = ctx.param(
+            owner,
+            self._pname(owner, "w"),
+            (self.vocab_size, size),
+            init_mod.smart_normal,
+            self.param_attr,
+        )
+        return jnp.take(table, ids, axis=0)
+
+
+class Context_(Projection):
+    """ContextProjection (paddle/function/ContextProjectionOp.cpp): sliding-window
+    concat over a sequence input; optionally trainable out-of-range padding."""
+
+    def __init__(
+        self,
+        input: Layer,
+        context_start: int,
+        context_len: int,
+        trainable_padding: bool = False,
+        param_attr: Any = None,
+    ):
+        super().__init__([input], param_attr)
+        self.context_start = context_start
+        self.context_len = context_len
+        self.trainable_padding = trainable_padding
+
+    def apply(self, ctx, owner, args, size):
+        arg = args[0]
+        assert arg.is_seq, "context projection needs a sequence input"
+        return seq_ops.context_projection(
+            arg.value, arg.lengths, self.context_start, self.context_len
+        )
+
+
+class DotMulOperator(Projection):
+    """DotMulOperator: elementwise product of two inputs (no params)."""
+
+    def __init__(self, input1: Layer, input2: Layer, scale: float = 1.0):
+        super().__init__([input1, input2])
+        self.scale = scale
+
+    def apply(self, ctx, owner, args, size):
+        return self.scale * args[0].value * args[1].value

@@ -1,0 +1,12 @@
+"""XLA/Pallas compute ops.
+
+This package is the TPU-native replacement for the reference's three compute
+tiers — paddle/cuda (hl_* kernels), paddle/math (Matrix/BaseMatrix), and
+paddle/function (op functors); see SURVEY §2.1. Everything is a pure jnp/lax
+function designed to be traced inside jit; hand-fused Pallas kernels live in
+paddle_tpu/ops/pallas/ and are used only where XLA fusion is insufficient.
+"""
+
+from paddle_tpu.ops import linalg as linalg  # noqa: F401
+from paddle_tpu.ops import conv as conv  # noqa: F401
+from paddle_tpu.ops import sequence as sequence  # noqa: F401

@@ -1,0 +1,155 @@
+"""Convolution / pooling ops, NHWC (TPU-preferred layout).
+
+Replaces the reference's conv stack: im2col+GEMM (paddle/function/GemmConvOp.cpp,
+Im2ColOp.cpp), cuDNN conv/pool (paddle/cuda/src/hl_cuda_cudnn.cc), and the CNN
+pooling kernels (paddle/cuda/src/hl_cuda_cnn.cu maxpool/avgpool fwd/bwd). On TPU
+the conv *is* a first-class XLA HLO that tiles onto the MXU — no im2col needed;
+backward comes from autodiff instead of the hand-written *BackwardData/Filter."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import dtypes
+
+Array = jax.Array
+IntOr2 = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntOr2) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        assert len(v) == 2
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+def conv2d(
+    x: Array,
+    w: Array,
+    stride: IntOr2 = 1,
+    padding: Union[str, IntOr2] = 0,
+    dilation: IntOr2 = 1,
+    groups: int = 1,
+    policy: Optional[dtypes.Policy] = None,
+) -> Array:
+    """x: [B, H, W, Cin], w: [kh, kw, Cin/groups, Cout] → [B, H', W', Cout]."""
+    p = policy or dtypes.current()
+    x = p.cast_compute(x)
+    w = p.cast_compute(w)
+    if isinstance(padding, str):
+        pad = padding  # "SAME" / "VALID"
+    else:
+        ph, pw = _pair(padding)
+        pad = [(ph, ph), (pw, pw)]
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=_pair(stride),
+        padding=pad,
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=DIMNUMS,
+        feature_group_count=groups,
+        preferred_element_type=p.accum_dtype,
+        precision=p.precision,
+    )
+    return out
+
+
+def conv2d_transpose(
+    x: Array,
+    w: Array,
+    stride: IntOr2 = 1,
+    padding: IntOr2 = 0,
+    policy: Optional[dtypes.Policy] = None,
+) -> Array:
+    """Transposed conv (ExpandConvLayer with trans=True / DeConv).
+
+    w: [kh, kw, Cout, Cin] in HWIO w.r.t. the *forward* conv of the transpose."""
+    p = policy or dtypes.current()
+    x = p.cast_compute(x)
+    w = p.cast_compute(w)
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    kh, kw = w.shape[0], w.shape[1]
+    # lhs_dilation implements the fractional stride; padding converts to the
+    # equivalent full conv padding: k - 1 - p on each side.
+    out = lax.conv_general_dilated(
+        x,
+        jnp.flip(w, (0, 1)).swapaxes(2, 3),
+        window_strides=(1, 1),
+        padding=[(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)],
+        lhs_dilation=(sh, sw),
+        dimension_numbers=DIMNUMS,
+        preferred_element_type=p.accum_dtype,
+        precision=p.precision,
+    )
+    return out
+
+
+def max_pool2d(
+    x: Array, window: IntOr2, stride: Optional[IntOr2] = None, padding: IntOr2 = 0
+) -> Array:
+    """[B, H, W, C] max pooling (hl_maxpool_forward, hl_cuda_cnn.cu)."""
+    wh, ww = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    ph, pw = _pair(padding)
+    neg = (
+        -jnp.inf
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min
+    )
+    return lax.reduce_window(
+        x,
+        neg,
+        lax.max,
+        window_dimensions=(1, wh, ww, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=((0, 0), (ph, ph), (pw, pw), (0, 0)),
+    )
+
+
+def avg_pool2d(
+    x: Array,
+    window: IntOr2,
+    stride: Optional[IntOr2] = None,
+    padding: IntOr2 = 0,
+    exclusive: bool = True,
+) -> Array:
+    """[B, H, W, C] average pooling (hl_avgpool_forward). `exclusive` divides by
+    the count of valid (non-pad) elements, matching cuDNN's EXCLUDE_PADDING mode
+    used by the reference."""
+    wh, ww = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    ph, pw = _pair(padding)
+    dims = (1, wh, ww, 1)
+    strides = (1, sh, sw, 1)
+    pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    if exclusive and (ph or pw):
+        ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return summed / counts
+    return summed / float(wh * ww)
+
+
+def global_avg_pool2d(x: Array) -> Array:
+    return jnp.mean(x, axis=(1, 2))
+
+
+def bilinear_resize(x: Array, out_h: int, out_w: int) -> Array:
+    """Bilinear interpolation (hl_bilinear_forward / BilinearInterpLayer)."""
+    return jax.image.resize(
+        x, (x.shape[0], out_h, out_w, x.shape[3]), method="bilinear"
+    )
+
+
+def conv_out_size(in_size: int, k: int, stride: int, pad: int, dilation: int = 1) -> int:
+    eff = (k - 1) * dilation + 1
+    return (in_size + 2 * pad - eff) // stride + 1

@@ -1,0 +1,35 @@
+"""Dense linear algebra with the TPU dtype policy applied.
+
+Replaces the cuBLAS seam (paddle/cuda/src/hl_cuda_cublas.cc hl_matrix_mul and
+paddle/math/Matrix.cpp GpuMatrix::mul). Matmuls cast inputs to the compute dtype
+(bf16 for the MXU) and accumulate in f32 via preferred_element_type."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtypes
+
+Array = jax.Array
+
+
+def matmul(a: Array, b: Array, policy: Optional[dtypes.Policy] = None) -> Array:
+    """a @ b over the last axis of a / first axis of b, MXU-friendly."""
+    p = policy or dtypes.current()
+    a = p.cast_compute(a)
+    b = p.cast_compute(b)
+    out = jnp.matmul(
+        a, b, preferred_element_type=p.accum_dtype, precision=p.precision
+    )
+    return out
+
+
+def linear(x: Array, w: Array, b: Optional[Array] = None, policy=None) -> Array:
+    """x @ w + b, where x may have arbitrary leading batch/time dims."""
+    out = matmul(x, w, policy)
+    if b is not None:
+        out = out + b
+    return out

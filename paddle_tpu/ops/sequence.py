@@ -1,0 +1,100 @@
+"""Sequence ops over padded [B, T, ...] batches with per-example lengths.
+
+The TPU-native encoding of the reference's ragged sequences: where the reference
+carries exact start offsets (paddle/parameter/Argument.h:84 sequenceStartPositions)
+and reorders into per-timestep dense batches (gserver/layers/SequenceToBatch.h:41),
+we keep static padded shapes + masks so XLA sees fixed shapes, and express per-step
+recurrences as lax.scan over the time axis (SURVEY §5 "Long-context / sequence
+scaling"). Replaces the hl_sequence.h kernel family (seq2batch, sequence softmax,
+context projection) from paddle/cuda/src/hl_cuda_sequence.cu."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+NEG_INF = -1e9
+
+
+def mask_from_lengths(lengths: Array, max_len: int, dtype=jnp.float32) -> Array:
+    """[B] lengths → [B, T] validity mask."""
+    return (jnp.arange(max_len)[None, :] < lengths[:, None]).astype(dtype)
+
+
+def seq_softmax(x: Array, lengths: Array) -> Array:
+    """Softmax over the valid time steps of [B, T] scores
+    (hl_sequence_softmax_forward, paddle/cuda/include/hl_matrix.h:67)."""
+    m = mask_from_lengths(lengths, x.shape[1], jnp.bool_)
+    x = jnp.where(m, x, NEG_INF)
+    return jax.nn.softmax(x, axis=1) * m.astype(x.dtype)
+
+
+def seq_sum(x: Array, lengths: Array) -> Array:
+    """Sum-pool [B, T, D] → [B, D] over valid steps (SequencePoolLayer sum)."""
+    m = mask_from_lengths(lengths, x.shape[1], x.dtype)
+    return jnp.einsum("btd,bt->bd", x, m)
+
+
+def seq_mean(x: Array, lengths: Array) -> Array:
+    """(AverageLayer)"""
+    denom = jnp.maximum(lengths, 1).astype(x.dtype)[:, None]
+    return seq_sum(x, lengths) / denom
+
+
+def seq_max(x: Array, lengths: Array) -> Array:
+    """(MaxLayer)"""
+    m = mask_from_lengths(lengths, x.shape[1], jnp.bool_)[:, :, None]
+    return jnp.max(jnp.where(m, x, NEG_INF), axis=1)
+
+
+def seq_sqrt_pool(x: Array, lengths: Array) -> Array:
+    """sum / sqrt(len) (SequencePoolLayer 'sqrt' mode)."""
+    denom = jnp.sqrt(jnp.maximum(lengths, 1).astype(x.dtype))[:, None]
+    return seq_sum(x, lengths) / denom
+
+
+def seq_first(x: Array, lengths: Optional[Array] = None) -> Array:
+    """(SequenceLastInstanceLayer with select_first / FirstSeqLayer)"""
+    return x[:, 0]
+
+
+def seq_last(x: Array, lengths: Array) -> Array:
+    """(SequenceLastInstanceLayer)"""
+    idx = jnp.maximum(lengths - 1, 0)
+    return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+
+
+def context_projection(
+    x: Array, lengths: Array, context_start: int, context_len: int
+) -> Array:
+    """Sliding window concat of neighbouring steps (ContextProjection,
+    paddle/function/ContextProjectionOp.cpp; hl_context_projection_forward).
+
+    [B, T, D] → [B, T, context_len * D]; out-of-range steps are zero (the
+    trainable-padding variant is handled at the layer level)."""
+    b, t, d = x.shape
+    cols = []
+    valid = mask_from_lengths(lengths, t, x.dtype)[:, :, None]
+    xm = x * valid
+    for offset in range(context_start, context_start + context_len):
+        if offset == 0:
+            cols.append(xm)
+        elif offset < 0:
+            shifted = jnp.pad(xm, ((0, 0), (-offset, 0), (0, 0)))[:, :t]
+            cols.append(shifted)
+        else:
+            shifted = jnp.pad(xm, ((0, 0), (0, offset), (0, 0)))[:, offset:]
+            # steps beyond each sequence's own end are invalid → zero them
+            idx = jnp.arange(t)[None, :] + offset
+            ok = (idx < lengths[:, None]).astype(x.dtype)[:, :, None]
+            cols.append(shifted * ok)
+    return jnp.concatenate(cols, axis=-1)
+
+
+def expand_to_seq(x: Array, like_lengths: Array, max_len: int) -> Array:
+    """[B, D] → [B, T, D] broadcast across time (ExpandLayer)."""
+    return jnp.broadcast_to(x[:, None, :], (x.shape[0], max_len, x.shape[1]))

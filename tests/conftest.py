@@ -1,0 +1,28 @@
+"""Test harness config: force CPU backend with 8 virtual devices so multi-chip
+sharding tests run without TPU hardware (the reference's analogous trick is the
+GPU-less stub build, paddle/cuda/include/stub/ — CPU is the oracle everywhere,
+SURVEY §4). Must run before jax is imported anywhere."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    import jax
+
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,120 @@
+"""Core graph-system tests: init/apply, param sharing, topo order, state updates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.graph import Argument, Network, ParamAttr, reset_name_scope
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    reset_name_scope()
+
+
+def test_fc_forward_shapes(rng):
+    data = L.Data("x", shape=(16,))
+    fc1 = L.Fc(data, size=32, act="relu")
+    fc2 = L.Fc(fc1, size=4, act=None)
+    net = Network(fc2)
+    batch = {"x": np.random.RandomState(0).randn(8, 16).astype(np.float32)}
+    params, states = net.init(rng, batch)
+    outs, _ = net.apply(params, states, batch)
+    assert outs[fc2.name].value.shape == (8, 4)
+    # two weight matrices + two biases
+    assert len(params) == 4
+
+
+def test_param_sharing(rng):
+    data = L.Data("x", shape=(8,))
+    shared = ParamAttr(name="shared_w")
+    a = L.Fc(data, size=8, act=None, bias=False, param_attr=shared)
+    b = L.Fc(a, size=8, act=None, bias=False, param_attr=shared)
+    net = Network(b)
+    batch = {"x": np.zeros((2, 8), np.float32)}
+    params, _ = net.init(rng, batch)
+    assert list(params) == ["shared_w"]
+
+
+def test_shared_param_shape_mismatch(rng):
+    data = L.Data("x", shape=(8,))
+    shared = ParamAttr(name="w")
+    a = L.Fc(data, size=8, act=None, bias=False, param_attr=shared)
+    b = L.Fc(a, size=4, act=None, bias=False, param_attr=shared)
+    net = Network(b)
+    with pytest.raises(ValueError, match="mismatch"):
+        net.init(jax.random.PRNGKey(0), {"x": np.zeros((2, 8), np.float32)})
+
+
+def test_batchnorm_state_updates(rng):
+    data = L.Data("x", shape=(4,))
+    bn = L.BatchNorm(data)
+    net = Network(bn)
+    x = np.random.RandomState(1).randn(32, 4).astype(np.float32) * 3 + 1
+    params, states = net.init(rng, {"x": x}, train=True)
+    outs, new_states = net.apply(params, states, {"x": x}, train=True)
+    # train-mode output is normalized
+    v = np.asarray(outs[bn.name].value)
+    np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(v.std(0), 1.0, atol=1e-2)
+    # moving stats moved toward batch stats
+    mm = np.asarray(new_states[f"{bn.name}.moving_mean"])
+    assert np.all(np.abs(mm) > 0)
+    # eval mode uses moving stats and does not update state
+    outs2, states2 = net.apply(params, new_states, {"x": x}, train=False)
+    np.testing.assert_allclose(
+        np.asarray(states2[f"{bn.name}.moving_mean"]), mm, rtol=1e-6
+    )
+
+
+def test_dropout_train_vs_eval(rng):
+    data = L.Data("x", shape=(100,))
+    drop = L.Dropout(data, rate=0.5)
+    net = Network(drop)
+    x = np.ones((4, 100), np.float32)
+    params, states = net.init(rng, {"x": x})
+    out_eval, _ = net.apply(params, states, {"x": x}, train=False)
+    np.testing.assert_array_equal(np.asarray(out_eval[drop.name].value), x)
+    out_train, _ = net.apply(
+        params, states, {"x": x}, train=True, rng=jax.random.PRNGKey(3)
+    )
+    v = np.asarray(out_train[drop.name].value)
+    assert ((v == 0) | (v == 2.0)).all()
+    assert 0.3 < (v == 0).mean() < 0.7
+
+
+def test_apply_is_jittable(rng):
+    data = L.Data("x", shape=(16,))
+    out = L.Fc(data, size=8, act="sigmoid")
+    net = Network(out)
+    batch = {"x": np.zeros((4, 16), np.float32)}
+    params, states = net.init(rng, batch)
+
+    @jax.jit
+    def f(params, states, x):
+        outs, _ = net.apply(params, states, {"x": x})
+        return outs[out.name].value
+
+    y = f(params, states, batch["x"])
+    assert y.shape == (4, 8)
+
+
+def test_topo_diamond(rng):
+    data = L.Data("x", shape=(8,))
+    a = L.Fc(data, size=8, act=None)
+    b = L.Fc(data, size=8, act=None)
+    c = L.Addto([a, b], act="relu")
+    net = Network(c)
+    names = [l.name for l in net.layer_order]
+    assert names.index(data.name) < names.index(a.name)
+    assert names.index(a.name) < names.index(c.name)
+    assert len(names) == len(set(names))
+
+
+def test_argument_seq_mask():
+    v = jnp.zeros((2, 5, 3))
+    arg = Argument(v, lengths=jnp.array([2, 5]))
+    m = np.asarray(arg.mask())
+    assert m.tolist() == [[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]]

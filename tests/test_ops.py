@@ -1,0 +1,108 @@
+"""Op-level tests: conv/pool vs numpy reference, sequence ops vs per-example loops.
+
+This is the analog of the reference's CPU-vs-GPU compare idiom
+(paddle/math/tests/test_matrixCompare.cpp; function/*OpTest.cpp) — here numpy
+loops are the oracle for the XLA lowering."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import sequence as seq_ops
+
+
+def _np_conv2d(x, w, stride, pad):
+    b, h, wid, cin = x.shape
+    kh, kw, _, cout = w.shape
+    xp = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wid + 2 * pad - kw) // stride + 1
+    out = np.zeros((b, oh, ow, cout), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, i * stride : i * stride + kh, j * stride : j * stride + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, w, axes=([1, 2, 3], [0, 1, 2]))
+    return out
+
+
+def test_conv2d_matches_numpy(np_rng):
+    x = np_rng.randn(2, 8, 8, 3).astype(np.float32)
+    w = np_rng.randn(3, 3, 3, 5).astype(np.float32)
+    got = np.asarray(conv_ops.conv2d(x, w, stride=2, padding=1))
+    want = _np_conv2d(x, w, 2, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_max_pool(np_rng):
+    x = np_rng.randn(2, 6, 6, 4).astype(np.float32)
+    got = np.asarray(conv_ops.max_pool2d(x, 2, 2))
+    want = x.reshape(2, 3, 2, 3, 2, 4).max(axis=(2, 4))
+    np.testing.assert_allclose(got, want)
+
+
+def test_avg_pool_exclusive_padding(np_rng):
+    x = np.ones((1, 4, 4, 1), np.float32)
+    got = np.asarray(conv_ops.avg_pool2d(x, 3, 2, padding=1, exclusive=True))
+    # with exclusive counting every window averages ones → 1.0 everywhere
+    np.testing.assert_allclose(got, np.ones_like(got))
+
+
+def test_conv_transpose_shape(np_rng):
+    x = np_rng.randn(2, 4, 4, 8).astype(np.float32)
+    w = np_rng.randn(4, 4, 16, 8).astype(np.float32)
+    out = conv_ops.conv2d_transpose(x, w, stride=2, padding=1)
+    assert out.shape == (2, 8, 8, 16)
+
+
+def test_seq_pooling_vs_loop(np_rng):
+    x = np_rng.randn(3, 7, 4).astype(np.float32)
+    lengths = np.array([3, 7, 1], np.int32)
+    for fn, red in [
+        (seq_ops.seq_sum, lambda v: v.sum(0)),
+        (seq_ops.seq_mean, lambda v: v.mean(0)),
+        (seq_ops.seq_max, lambda v: v.max(0)),
+    ]:
+        got = np.asarray(fn(jnp.asarray(x), jnp.asarray(lengths)))
+        want = np.stack([red(x[i, : lengths[i]]) for i in range(3)])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_seq_last_first(np_rng):
+    x = np_rng.randn(3, 5, 2).astype(np.float32)
+    lengths = np.array([2, 5, 1], np.int32)
+    got = np.asarray(seq_ops.seq_last(jnp.asarray(x), jnp.asarray(lengths)))
+    want = np.stack([x[i, lengths[i] - 1] for i in range(3)])
+    np.testing.assert_allclose(got, want)
+    np.testing.assert_allclose(
+        np.asarray(seq_ops.seq_first(jnp.asarray(x))), x[:, 0]
+    )
+
+
+def test_seq_softmax(np_rng):
+    x = np_rng.randn(2, 6).astype(np.float32)
+    lengths = np.array([4, 6], np.int32)
+    got = np.asarray(seq_ops.seq_softmax(jnp.asarray(x), jnp.asarray(lengths)))
+    assert got[0, 4:].sum() == 0
+    np.testing.assert_allclose(got.sum(-1), [1.0, 1.0], rtol=1e-5)
+
+
+def test_context_projection(np_rng):
+    x = np_rng.randn(2, 5, 3).astype(np.float32)
+    lengths = np.array([3, 5], np.int32)
+    got = np.asarray(
+        seq_ops.context_projection(jnp.asarray(x), jnp.asarray(lengths), -1, 3)
+    )
+    assert got.shape == (2, 5, 9)
+    # middle block is x itself (masked beyond length)
+    np.testing.assert_allclose(got[1, :, 3:6], x[1])
+    # first block at t=0 is zeros (no left context)
+    np.testing.assert_allclose(got[:, 0, 0:3], 0)
+    # right context beyond sequence end is zero for the short sequence
+    np.testing.assert_allclose(got[0, 2, 6:9], 0)
+
+
+def test_bilinear_resize():
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    out = conv_ops.bilinear_resize(x, 8, 8)
+    assert out.shape == (1, 8, 8, 1)
