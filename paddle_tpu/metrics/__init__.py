@@ -1,0 +1,12 @@
+from paddle_tpu.metrics.evaluators import (  # noqa: F401
+    AucEvaluator,
+    ChunkEvaluator,
+    ClassificationErrorEvaluator,
+    ColumnSumEvaluator,
+    Evaluator,
+    PnpairEvaluator,
+    PrecisionRecallEvaluator,
+    RankAucEvaluator,
+    SequenceErrorEvaluator,
+    SumEvaluator,
+)

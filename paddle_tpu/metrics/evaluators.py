@@ -1,0 +1,310 @@
+"""Streaming evaluators (metrics accumulated across batches).
+
+Parity with paddle/gserver/evaluators/Evaluator.h:42 (start/eval/finish,
+registry :32) and its registered set: classification_error, seq error, auc,
+precision_recall, pnpair, rank auc, chunk F1 (ChunkEvaluator.cpp), sum /
+column-sum. CTC edit-distance lives with the CTC ops. Evaluators run on host
+numpy over batch outputs — the per-batch tensors come out of the compiled step;
+the streaming state is tiny and stays on host (same split as the reference:
+kernels produce per-batch stats, Evaluator accumulates)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.core.registry import EVALUATORS
+
+
+class Evaluator:
+    """start() → update(batch fields) per batch → finish() returns the metric."""
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def update(self, **kw) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> float:
+        raise NotImplementedError
+
+
+def _mask_flat(values: np.ndarray, lengths: Optional[np.ndarray]):
+    """Flatten [B,T,...] with lengths → (flat values, keep mask); or
+    (values, None) for non-sequence [B, ...]."""
+    if lengths is None:
+        return values, None
+    b, t = values.shape[:2]
+    keep = np.arange(t)[None, :] < lengths[:, None]
+    return values.reshape((b * t,) + values.shape[2:]), keep.reshape(-1)
+
+
+@EVALUATORS.register("classification_error")
+class ClassificationErrorEvaluator(Evaluator):
+    """classification_error (Evaluator.cpp ClassificationErrorEvaluator)."""
+
+    def start(self):
+        self.wrong = 0.0
+        self.total = 0.0
+
+    def update(self, output=None, label=None, weight=None, lengths=None, **kw):
+        output = np.asarray(output)
+        label = np.asarray(label)
+        if output.ndim == 3:  # sequence output
+            b, t, c = output.shape
+            pred = output.argmax(-1).reshape(-1)
+            lab = label.reshape(-1)
+            keep = (
+                (np.arange(t)[None, :] < np.asarray(lengths)[:, None]).reshape(-1)
+                if lengths is not None
+                else np.ones(b * t, bool)
+            )
+        else:
+            pred = output.argmax(-1)
+            lab = label.reshape(-1)
+            keep = np.ones(len(lab), bool)
+        w = np.asarray(weight).reshape(-1) if weight is not None else np.ones(len(lab))
+        self.wrong += float((w * keep * (pred != lab)).sum())
+        self.total += float((w * keep).sum())
+
+    def finish(self):
+        return self.wrong / max(self.total, 1e-12)
+
+
+@EVALUATORS.register("seq_error", "sequence_classification_error")
+class SequenceErrorEvaluator(Evaluator):
+    """Whole-sequence error: a sequence counts wrong if ANY step is wrong."""
+
+    def start(self):
+        self.wrong = 0
+        self.total = 0
+
+    def update(self, output=None, label=None, lengths=None, **kw):
+        output = np.asarray(output)
+        label = np.asarray(label)
+        pred = output.argmax(-1)
+        b, t = pred.shape
+        keep = np.arange(t)[None, :] < np.asarray(lengths)[:, None]
+        bad = ((pred != label) & keep).any(axis=1)
+        self.wrong += int(bad.sum())
+        self.total += b
+
+    def finish(self):
+        return self.wrong / max(self.total, 1)
+
+
+@EVALUATORS.register("auc")
+class AucEvaluator(Evaluator):
+    """Binary AUC via fixed binning (AucEvaluator in Evaluator.cpp uses the
+    same discretized approach)."""
+
+    def __init__(self, num_bins: int = 4096):
+        self.num_bins = num_bins
+
+    def start(self):
+        self.pos = np.zeros(self.num_bins)
+        self.neg = np.zeros(self.num_bins)
+
+    def update(self, output=None, label=None, weight=None, **kw):
+        output = np.asarray(output)
+        p = output[:, 1] if output.ndim == 2 and output.shape[1] == 2 else output.reshape(-1)
+        y = np.asarray(label).reshape(-1)
+        w = np.asarray(weight).reshape(-1) if weight is not None else np.ones(len(y))
+        idx = np.clip((p * self.num_bins).astype(int), 0, self.num_bins - 1)
+        np.add.at(self.pos, idx, w * (y == 1))
+        np.add.at(self.neg, idx, w * (y != 1))
+
+    def finish(self):
+        # sweep thresholds high→low accumulating TP/FP; trapezoid area
+        tp = np.cumsum(self.pos[::-1])
+        fp = np.cumsum(self.neg[::-1])
+        tot_p, tot_n = tp[-1], fp[-1]
+        if tot_p == 0 or tot_n == 0:
+            return 0.5
+        tpr = np.concatenate([[0.0], tp / tot_p])
+        fpr = np.concatenate([[0.0], fp / tot_n])
+        return float(np.trapezoid(tpr, fpr))
+
+
+@EVALUATORS.register("precision_recall")
+class PrecisionRecallEvaluator(Evaluator):
+    """precision_recall (PrecisionRecallEvaluator): per-class + macro stats."""
+
+    def __init__(self, positive_label: Optional[int] = None):
+        self.positive_label = positive_label
+
+    def start(self):
+        self.tp: Dict[int, float] = {}
+        self.fp: Dict[int, float] = {}
+        self.fn: Dict[int, float] = {}
+
+    def update(self, output=None, label=None, weight=None, **kw):
+        pred = np.asarray(output).argmax(-1).reshape(-1)
+        lab = np.asarray(label).reshape(-1)
+        w = np.asarray(weight).reshape(-1) if weight is not None else np.ones(len(lab))
+        for c in np.unique(np.concatenate([pred, lab])):
+            c = int(c)
+            self.tp[c] = self.tp.get(c, 0.0) + float((w * ((pred == c) & (lab == c))).sum())
+            self.fp[c] = self.fp.get(c, 0.0) + float((w * ((pred == c) & (lab != c))).sum())
+            self.fn[c] = self.fn.get(c, 0.0) + float((w * ((pred != c) & (lab == c))).sum())
+
+    def stats(self, c: int):
+        tp, fp, fn = self.tp.get(c, 0.0), self.fp.get(c, 0.0), self.fn.get(c, 0.0)
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return prec, rec, f1
+
+    def finish(self):
+        if self.positive_label is not None:
+            return self.stats(self.positive_label)[2]
+        f1s = [self.stats(c)[2] for c in self.tp]
+        return float(np.mean(f1s)) if f1s else 0.0
+
+
+@EVALUATORS.register("pnpair")
+class PnpairEvaluator(Evaluator):
+    """Positive-negative pair ratio grouped by query id (PnpairEvaluator)."""
+
+    def start(self):
+        self.records: List[np.ndarray] = []
+
+    def update(self, output=None, label=None, query_id=None, weight=None, **kw):
+        score = np.asarray(output).reshape(-1)
+        lab = np.asarray(label).reshape(-1)
+        qid = np.asarray(query_id).reshape(-1)
+        w = np.asarray(weight).reshape(-1) if weight is not None else np.ones(len(lab))
+        self.records.append(np.stack([score, lab, qid, w], 1))
+
+    def finish(self):
+        if not self.records:
+            return 0.0
+        rec = np.concatenate(self.records, 0)
+        pos, neg, tie = 0.0, 0.0, 0.0
+        for q in np.unique(rec[:, 2]):
+            grp = rec[rec[:, 2] == q]
+            n = len(grp)
+            for i in range(n):
+                for j in range(i + 1, n):
+                    if grp[i, 1] == grp[j, 1]:
+                        continue
+                    w = grp[i, 3] + grp[j, 3]
+                    hi, lo = (i, j) if grp[i, 1] > grp[j, 1] else (j, i)
+                    if grp[hi, 0] > grp[lo, 0]:
+                        pos += w
+                    elif grp[hi, 0] < grp[lo, 0]:
+                        neg += w
+                    else:
+                        tie += w
+        return (pos + 0.5 * tie) / max(pos + neg + tie, 1e-12)
+
+
+RankAucEvaluator = PnpairEvaluator
+
+
+@EVALUATORS.register("sum")
+class SumEvaluator(Evaluator):
+    def start(self):
+        self.total = 0.0
+
+    def update(self, output=None, weight=None, **kw):
+        v = np.asarray(output)
+        if weight is not None:
+            v = v * np.asarray(weight).reshape((-1,) + (1,) * (v.ndim - 1))
+        self.total += float(v.sum())
+
+    def finish(self):
+        return self.total
+
+
+@EVALUATORS.register("column_sum")
+class ColumnSumEvaluator(Evaluator):
+    def start(self):
+        self.total = None
+        self.n = 0.0
+
+    def update(self, output=None, **kw):
+        v = np.asarray(output).reshape(-1, np.asarray(output).shape[-1])
+        s = v.sum(0)
+        self.total = s if self.total is None else self.total + s
+        self.n += v.shape[0]
+
+    def finish(self):
+        return self.total / max(self.n, 1.0)
+
+
+@EVALUATORS.register("chunk")
+class ChunkEvaluator(Evaluator):
+    """Chunk-level F1 for sequence labeling (ChunkEvaluator.cpp). Supports the
+    same schemes: IOB/IOE/IOBES/plain with num_chunk_types."""
+
+    def __init__(self, scheme: str = "IOB", num_chunk_types: int = 1):
+        assert scheme in ("IOB", "IOE", "IOBES", "plain")
+        self.scheme = scheme
+        self.num_chunk_types = num_chunk_types
+
+    def start(self):
+        self.correct = 0
+        self.n_pred = 0
+        self.n_label = 0
+
+    def _extract(self, tags: np.ndarray):
+        """tag ids → set of (start, end, type) chunks."""
+        chunks = []
+        start = None
+        cur_type = None
+        scheme = self.scheme
+        n_tag_types = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+        other = n_tag_types * self.num_chunk_types  # the "O" tag id
+        for i, t in enumerate(list(tags) + [other]):
+            t = int(t)
+            if t == other:
+                pos, typ = None, None
+            else:
+                pos, typ = t % n_tag_types, t // n_tag_types
+            if scheme == "plain":
+                is_start = typ is not None and typ != cur_type
+                ends_prev = typ != cur_type
+            elif scheme == "IOB":
+                is_start = typ is not None and (pos == 0 or typ != cur_type)
+                ends_prev = typ is None or pos == 0 or typ != cur_type
+            elif scheme == "IOE":
+                # pos 0 = I, 1 = E(end)
+                is_start = typ is not None and cur_type is None
+                ends_prev = typ is None or typ != cur_type
+            else:  # IOBES: 0=B 1=I 2=E 3=S
+                is_start = typ is not None and pos in (0, 3)
+                ends_prev = typ is None or pos in (0, 3)
+            if start is not None and ends_prev:
+                chunks.append((start, i - 1, cur_type))
+                start, cur_type = None, None
+            if typ is not None and is_start:
+                start, cur_type = i, typ
+            elif typ is not None and start is None:
+                start, cur_type = i, typ
+            if scheme == "IOBES" and typ is not None and pos in (2, 3):
+                chunks.append((start, i, cur_type))
+                start, cur_type = None, None
+            if scheme == "IOE" and typ is not None and pos == 1:
+                chunks.append((start, i, cur_type))
+                start, cur_type = None, None
+        return set(chunks)
+
+    def update(self, output=None, label=None, lengths=None, **kw):
+        pred = np.asarray(output)
+        if pred.ndim == 3:
+            pred = pred.argmax(-1)
+        lab = np.asarray(label)
+        lens = np.asarray(lengths) if lengths is not None else [pred.shape[1]] * pred.shape[0]
+        for i in range(pred.shape[0]):
+            p_chunks = self._extract(pred[i, : lens[i]])
+            l_chunks = self._extract(lab[i, : lens[i]])
+            self.correct += len(p_chunks & l_chunks)
+            self.n_pred += len(p_chunks)
+            self.n_label += len(l_chunks)
+
+    def finish(self):
+        prec = self.correct / max(self.n_pred, 1e-12)
+        rec = self.correct / max(self.n_label, 1e-12)
+        return 2 * prec * rec / max(prec + rec, 1e-12)

@@ -1,0 +1,13 @@
+from paddle_tpu.optim.optimizers import (  # noqa: F401
+    Adam,
+    AdaMax,
+    AdaGrad,
+    AdaDelta,
+    DecayedAdaGrad,
+    Momentum,
+    Optimizer,
+    RMSProp,
+    SGD,
+)
+from paddle_tpu.optim import schedules as schedules  # noqa: F401
+from paddle_tpu.optim.average import ModelAverage  # noqa: F401

@@ -1,0 +1,48 @@
+"""Polyak/window parameter averaging.
+
+Parity with paddle/parameter/AverageOptimizer.h:23/100: maintains an averaged
+copy of the parameters alongside the optimizer (average_window in v1 settings);
+at test/save time the averaged values substitute for the raw ones."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+Params = Dict[str, Array]
+
+
+class ModelAverage:
+    def __init__(self, average_window: float = 0.0, max_average_window: int = 0):
+        # v1: average over the most recent `average_window * pass_length`
+        # updates, capped at max_average_window. We implement the standard
+        # incremental mean with a growing-then-capped window weight.
+        self.average_window = average_window
+        self.max_average_window = max_average_window or 2**31 - 1
+        self.enabled = average_window > 0
+
+    def init_state(self, params: Params) -> Dict[str, Any]:
+        if not self.enabled:
+            return {}
+        return {
+            "avg": jax.tree.map(lambda p: p.astype(jnp.float32), params),
+            "n": jnp.zeros((), jnp.float32),
+        }
+
+    def update(self, state: Dict[str, Any], params: Params) -> Dict[str, Any]:
+        if not self.enabled:
+            return state
+        n = jnp.minimum(state["n"] + 1.0, float(self.max_average_window))
+        w = 1.0 / n
+        avg = jax.tree.map(
+            lambda a, p: (1.0 - w) * a + w * p.astype(jnp.float32), state["avg"], params
+        )
+        return {"avg": avg, "n": n}
+
+    def averaged_params(self, state: Dict[str, Any], params: Params) -> Params:
+        if not self.enabled or not state:
+            return params
+        return jax.tree.map(lambda a, p: a.astype(p.dtype), state["avg"], params)

@@ -1,0 +1,234 @@
+"""First-order optimizers.
+
+Parity with paddle/parameter/FirstOrderOptimizer.h (SGD :24, AdaGrad :111,
+AdaDelta :141, RMSProp :167, DecayedAdaGrad :210, Adam :255, AdaMax :290) and
+the device kernels in paddle/math/TrainingAlgorithmOp.h:38-114. Per-parameter
+attributes (learning-rate scale, L1/L2 decay, static, clipping) follow
+ParameterConfig semantics (proto/ParameterConfig.proto:34; Regularizer.h:36-100;
+gradient clipping wrapper FirstOrderOptimizer.h:346).
+
+Design: each optimizer is pure — `init_state(params)` builds a state pytree and
+`update(grads, state, params, lr)` returns (new_params, new_state). The whole
+update runs inside the compiled train step (the reference's UpdateCallback folded
+into the XLA program; SURVEY §7 hard-part (1))."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.graph import ParamAttr
+
+Array = jax.Array
+Params = Dict[str, Array]
+
+
+def _zeros_like_tree(params: Params) -> Params:
+    return jax.tree.map(jnp.zeros_like, params)
+
+
+@dataclasses.dataclass
+class Optimizer:
+    """Base: handles per-param lr scale, L1/L2 decay, clipping, static params.
+
+    learning_rate here is the *base* lr; schedules scale it per step outside.
+    """
+
+    learning_rate: float = 0.01
+    # Global regularization defaults (settings(regularization=...) in v1);
+    # per-param attrs override (OptimizerWithRegularizer.cpp).
+    l1_rate: float = 0.0
+    l2_rate: float = 0.0
+    gradient_clipping_threshold: Optional[float] = None
+    # Populated by the trainer from Network.param_attrs.
+    param_attrs: Dict[str, ParamAttr] = dataclasses.field(default_factory=dict)
+
+    # -- subclass interface -------------------------------------------------
+    def init_param_state(self, p: Array) -> Tuple[Array, ...]:
+        return ()
+
+    def apply_param(
+        self, g: Array, s: Tuple[Array, ...], p: Array, lr: Array
+    ) -> Tuple[Array, Tuple[Array, ...]]:
+        raise NotImplementedError
+
+    # -- public -------------------------------------------------------------
+    def init_state(self, params: Params) -> Dict[str, Any]:
+        return {
+            "slots": {k: self.init_param_state(p) for k, p in params.items()},
+            "t": jnp.zeros((), jnp.int32),  # step counter (Adam bias correction)
+        }
+
+    def update(
+        self, grads: Params, state: Dict[str, Any], params: Params, lr: Array
+    ) -> Tuple[Params, Dict[str, Any]]:
+        t = state["t"] + 1
+        new_params: Params = {}
+        new_slots: Dict[str, Tuple[Array, ...]] = {}
+        self._t = t  # visible to apply_param for bias correction
+        for k, p in params.items():
+            attr = self.param_attrs.get(k) or ParamAttr()
+            g = grads[k]
+            if attr.is_static:
+                new_params[k] = p
+                new_slots[k] = state["slots"][k]
+                continue
+            g = g.astype(jnp.float32)
+            clip = attr.gradient_clipping_threshold or self.gradient_clipping_threshold
+            if clip:
+                g = jnp.clip(g, -clip, clip)
+            # L2 decay folded into the gradient (Regularizer.h L2Regularizer).
+            l2 = attr.l2_decay if attr.l2_decay is not None else self.l2_rate
+            if l2:
+                g = g + l2 * p
+            plr = lr * attr.learning_rate
+            new_p, new_s = self.apply_param(g, state["slots"][k], p, plr)
+            # L1 decay applied as post-update shrinkage (L1Regularizer::update).
+            l1 = attr.l1_decay if attr.l1_decay is not None else self.l1_rate
+            if l1:
+                shrink = plr * l1
+                new_p = jnp.sign(new_p) * jnp.maximum(jnp.abs(new_p) - shrink, 0.0)
+            new_params[k] = new_p
+            new_slots[k] = new_s
+        return new_params, {"slots": new_slots, "t": t}
+
+
+@dataclasses.dataclass
+class SGD(Optimizer):
+    """Plain / momentum / nesterov SGD (SgdOptimizer; sgdUpdate in
+    parameter/ParameterUpdateFunctions.h:33)."""
+
+    momentum: float = 0.0
+    nesterov: bool = False
+
+    def init_param_state(self, p):
+        if self.momentum:
+            return (jnp.zeros_like(p),)
+        return ()
+
+    def apply_param(self, g, s, p, lr):
+        if not self.momentum:
+            return p - lr * g, ()
+        (v,) = s
+        v = self.momentum * v - lr * g
+        if self.nesterov:
+            step = self.momentum * v - lr * g
+        else:
+            step = v
+        return p + step, (v,)
+
+
+Momentum = SGD
+
+
+@dataclasses.dataclass
+class AdaGrad(Optimizer):
+    """AdaGradOptimizer (FirstOrderOptimizer.h:111; adagradApply
+    TrainingAlgorithmOp.h)."""
+
+    epsilon: float = 1e-6
+
+    def init_param_state(self, p):
+        return (jnp.zeros_like(p),)
+
+    def apply_param(self, g, s, p, lr):
+        (accum,) = s
+        accum = accum + g * g
+        return p - lr * g / (jnp.sqrt(accum) + self.epsilon), (accum,)
+
+
+@dataclasses.dataclass
+class DecayedAdaGrad(Optimizer):
+    """DecayedAdagradOptimizer (FirstOrderOptimizer.h:210): leaky accumulator."""
+
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_param_state(self, p):
+        return (jnp.zeros_like(p),)
+
+    def apply_param(self, g, s, p, lr):
+        (accum,) = s
+        accum = self.rho * accum + (1 - self.rho) * g * g
+        return p - lr * g / (jnp.sqrt(accum) + self.epsilon), (accum,)
+
+
+@dataclasses.dataclass
+class AdaDelta(Optimizer):
+    """AdaDeltaOptimizer (FirstOrderOptimizer.h:141; adadeltaApply)."""
+
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_param_state(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply_param(self, g, s, p, lr):
+        accum_g, accum_x = s
+        accum_g = self.rho * accum_g + (1 - self.rho) * g * g
+        step = -jnp.sqrt((accum_x + self.epsilon) / (accum_g + self.epsilon)) * g
+        accum_x = self.rho * accum_x + (1 - self.rho) * step * step
+        return p + lr * step, (accum_g, accum_x)
+
+
+@dataclasses.dataclass
+class RMSProp(Optimizer):
+    """RMSPropOptimizer (FirstOrderOptimizer.h:167; rmspropApply — note the
+    reference keeps both E[g^2] and E[g] (centered variant))."""
+
+    rho: float = 0.95
+    epsilon: float = 1e-6
+    momentum: float = 0.0
+
+    def init_param_state(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply_param(self, g, s, p, lr):
+        ms, mg, mom = s
+        ms = self.rho * ms + (1 - self.rho) * g * g
+        mg = self.rho * mg + (1 - self.rho) * g
+        denom = jnp.sqrt(ms - mg * mg + self.epsilon)
+        mom = self.momentum * mom + lr * g / denom
+        return p - mom, (ms, mg, mom)
+
+
+@dataclasses.dataclass
+class Adam(Optimizer):
+    """AdamOptimizer (FirstOrderOptimizer.h:255; adamApply)."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_param_state(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply_param(self, g, s, p, lr):
+        m, v = s
+        t = self._t.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        mhat = m / (1 - jnp.power(self.beta1, t))
+        vhat = v / (1 - jnp.power(self.beta2, t))
+        return p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+
+
+@dataclasses.dataclass
+class AdaMax(Optimizer):
+    """AdamaxOptimizer (FirstOrderOptimizer.h:290; adamaxApply)."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+
+    def init_param_state(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def apply_param(self, g, s, p, lr):
+        m, u = s
+        t = self._t.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        return p - (lr / (1 - jnp.power(self.beta1, t))) * m / (u + 1e-12), (m, u)

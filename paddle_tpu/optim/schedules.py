@@ -1,0 +1,87 @@
+"""Learning-rate schedules.
+
+Parity with paddle/parameter/LearningRateScheduler.cpp:30+ registrations:
+constant, poly, caffe_poly, exp, discexp, linear_decay, manual, pass_manual.
+Each is a pure fn of the global sample/pass counter so it can live inside the
+compiled step (num_samples_processed drives v1 schedules)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import LR_SCHEDULES
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]  # samples_processed -> lr factor*base
+
+
+def build(
+    learning_rate: float,
+    schedule: Optional[str] = None,
+    decay_a: float = 0.0,
+    decay_b: float = 0.0,
+    warmup_samples: float = 0.0,
+) -> Schedule:
+    """Returns lr(t) where t = num samples processed (v1 semantics)."""
+    name = schedule or "constant"
+    fn = LR_SCHEDULES.get(name)
+    base = fn(learning_rate, decay_a, decay_b)
+    if warmup_samples > 0:
+
+        def warmed(t):
+            w = jnp.minimum(t / warmup_samples, 1.0)
+            return w * base(t)
+
+        return warmed
+    return base
+
+
+@LR_SCHEDULES.register("constant")
+def _constant(lr, a, b):
+    return lambda t: jnp.asarray(lr, jnp.float32)
+
+
+@LR_SCHEDULES.register("poly")
+def _poly(lr, a, b):
+    # lr * (1 + a*t)^(-b)   (LearningRateScheduler.cpp poly)
+    return lambda t: lr * jnp.power(1.0 + a * t, -b)
+
+
+@LR_SCHEDULES.register("caffe_poly")
+def _caffe_poly(lr, a, b):
+    # lr * (1 - t/a)^b, clipped at 0 once t >= a
+    return lambda t: lr * jnp.power(jnp.maximum(1.0 - t / a, 0.0), b)
+
+
+@LR_SCHEDULES.register("exp")
+def _exp(lr, a, b):
+    # lr * a^(t/b)
+    return lambda t: lr * jnp.power(a, t / b)
+
+
+@LR_SCHEDULES.register("discexp")
+def _discexp(lr, a, b):
+    # lr * a^floor(t/b)
+    return lambda t: lr * jnp.power(a, jnp.floor(t / b))
+
+
+@LR_SCHEDULES.register("linear")
+@LR_SCHEDULES.register("linear_decay")
+def _linear(lr, a, b):
+    # max(lr - a*t, b)
+    return lambda t: jnp.maximum(lr - a * t, b)
+
+
+def manual(lr: float, segments: Sequence[Tuple[float, float]]) -> Schedule:
+    """'manual' schedule: list of (boundary_samples, lr_factor) segments
+    (LearningRateScheduler.cpp ManualLearningRate)."""
+    bounds = jnp.asarray([s[0] for s in segments], jnp.float32)
+    rates = jnp.asarray([s[1] for s in segments], jnp.float32)
+
+    def fn(t):
+        idx = jnp.sum((t >= bounds).astype(jnp.int32))
+        idx = jnp.clip(idx, 0, len(segments) - 1)
+        return lr * rates[idx]
+
+    return fn

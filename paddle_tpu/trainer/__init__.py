@@ -1,0 +1,8 @@
+from paddle_tpu.trainer.events import (  # noqa: F401
+    BeginIteration,
+    BeginPass,
+    EndIteration,
+    EndPass,
+)
+from paddle_tpu.trainer.trainer import SGDTrainer, TrainState  # noqa: F401
+from paddle_tpu.trainer import checkpoint as checkpoint  # noqa: F401

@@ -1,0 +1,129 @@
+"""Checkpoint save/load.
+
+Parity with the reference's per-pass parameter dumps
+(trainer/ParamUtil.cpp:80 saveParameters → save_dir/pass-%05d/) and the Go
+pserver checkpoints that additionally persist optimizer state with integrity
+checks (go/pserver/service.go:146 parameterCheckpoint, CRC + atomic write).
+
+Format: one .npz per pytree (params / states / opt) + manifest.json with
+shapes, dtypes and a CRC of each file; writes are atomic (tmp + rename)."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _path_key(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def _to_numpy_tree(tree: Any) -> Dict[str, np.ndarray]:
+    flat = {}
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in leaves:
+        flat[_path_key(path)] = np.asarray(leaf)
+    return flat
+
+
+def restore_tree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
+    """Rebuild a pytree shaped like `template` from a flat path→array dict
+    (inverse of _to_numpy_tree). Leaves missing from `flat` or with mismatched
+    shapes keep the template's value."""
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path, leaf in leaves:
+        key = _path_key(path)
+        if key in flat and tuple(np.shape(flat[key])) == tuple(np.shape(leaf)):
+            new_leaves.append(jnp.asarray(flat[key], dtype=leaf.dtype))
+        else:
+            new_leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _save_npz_atomic(path: str, arrays: Dict[str, np.ndarray]) -> int:
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    # suffix must be .npz: np.savez appends it to any other filename
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+    os.close(fd)
+    try:
+        np.savez(tmp, **arrays)
+        with open(tmp, "rb") as f:
+            crc = zlib.crc32(f.read())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return crc
+
+
+def save_pass(
+    save_dir: str,
+    pass_id: int,
+    params: Dict[str, Any],
+    states: Optional[Dict[str, Any]] = None,
+    opt_state: Optional[Any] = None,
+    extra_meta: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write save_dir/pass-%05d/{params,states,opt}.npz + manifest.json."""
+    pdir = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    os.makedirs(pdir, exist_ok=True)
+    manifest: Dict[str, Any] = {"pass_id": pass_id, "files": {}, "version": 1}
+    if extra_meta:
+        manifest["extra"] = extra_meta
+    for name, tree in [("params", params), ("states", states), ("opt", opt_state)]:
+        if tree is None or (isinstance(tree, dict) and not tree):
+            continue
+        flat = _to_numpy_tree(tree)
+        path = os.path.join(pdir, f"{name}.npz")
+        crc = _save_npz_atomic(path, flat)
+        manifest["files"][name] = {
+            "crc32": crc,
+            "keys": {k: [list(v.shape), str(v.dtype)] for k, v in flat.items()},
+        }
+    mpath = os.path.join(pdir, "manifest.json")
+    fd, tmp = tempfile.mkstemp(dir=pdir, suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, mpath)
+    return pdir
+
+
+def load_pass(
+    save_dir: str, pass_id: Optional[int] = None
+) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray], Dict[str, np.ndarray], Dict]:
+    """Load (params, states, opt_flat, manifest). pass_id=None → latest."""
+    if pass_id is None:
+        passes = sorted(
+            int(d.split("-")[1])
+            for d in os.listdir(save_dir)
+            if d.startswith("pass-") and os.path.isdir(os.path.join(save_dir, d))
+        )
+        if not passes:
+            raise FileNotFoundError(f"no pass-* checkpoints under {save_dir}")
+        pass_id = passes[-1]
+    pdir = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    with open(os.path.join(pdir, "manifest.json")) as f:
+        manifest = json.load(f)
+    out = {}
+    for name in ("params", "states", "opt"):
+        path = os.path.join(pdir, f"{name}.npz")
+        if name in manifest["files"] and os.path.exists(path):
+            with open(path, "rb") as f:
+                crc = zlib.crc32(f.read())
+            if crc != manifest["files"][name]["crc32"]:
+                raise IOError(f"checkpoint {path} failed CRC check")
+            with np.load(path) as z:
+                out[name] = {k: z[k] for k in z.files}
+        else:
+            out[name] = {}
+    return out["params"], out["states"], out["opt"], manifest

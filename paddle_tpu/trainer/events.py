@@ -1,0 +1,31 @@
+"""Training events, parity with python/paddle/v2/event.py:45-88."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class BeginPass:
+    pass_id: int
+
+
+@dataclasses.dataclass
+class EndPass:
+    pass_id: int
+    metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class BeginIteration:
+    pass_id: int
+    batch_id: int
+
+
+@dataclasses.dataclass
+class EndIteration:
+    pass_id: int
+    batch_id: int
+    cost: float
+    metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)

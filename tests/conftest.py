@@ -12,6 +12,16 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The environment may inject a TPU-tunnel PJRT plugin via a sitecustomize that
+# programmatically sets jax_platforms='axon,cpu' at interpreter startup —
+# trumping the env var above; its client init can then block every test run
+# when the tunnel is down. Force the config back to CPU before any backend
+# initializes (tests must be hermetic on the CPU backend; SURVEY §4 CPU-oracle
+# idiom).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
