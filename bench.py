@@ -1,0 +1,97 @@
+"""Benchmark driver: ResNet-50 ImageNet training throughput on the available
+accelerator (the BASELINE.json north-star metric: images/sec/chip and MFU vs
+the ≥50% target).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline = achieved_MFU / 0.50 (the north-star MFU target), so 1.0 means
+"hit the 50%-MFU goal"; extra keys are informational.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.core import dtypes
+    from paddle_tpu import models
+    from paddle_tpu.nn.graph import Network, reset_name_scope
+    from paddle_tpu.optim import SGD
+    from paddle_tpu.parallel import DataParallel, make_mesh
+    from paddle_tpu.trainer import SGDTrainer
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
+    image_size = int(os.environ.get("BENCH_IMAGE", "224"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "20")))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    platform = devices[0].platform
+
+    dtypes.set_policy(dtypes.bf16_policy())
+    reset_name_scope()
+    img, label, logits, cost = models.resnet50(image_size=image_size)
+
+    mesh = make_mesh({"data": n_dev})
+    dp = DataParallel(mesh)
+    trainer = SGDTrainer(cost, SGD(learning_rate=0.1, momentum=0.9), parallel=dp)
+
+    rs = np.random.RandomState(0)
+    batch = {
+        "image": rs.randn(batch_size, image_size, image_size, 3).astype(np.float32),
+        "label": rs.randint(0, 1000, batch_size),
+    }
+    batch = dp.shard_batch(batch)
+    trainer.init_state(batch)
+    step = trainer._make_step()
+
+    state = trainer.state
+    for _ in range(warmup):
+        state, cost_v, _ = step(state, batch)
+    jax.block_until_ready(cost_v)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, cost_v, _ = step(state, batch)
+    jax.block_until_ready(cost_v)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch_size * steps / dt
+    images_per_sec_chip = images_per_sec / n_dev
+
+    # ResNet-50 @224 fwd ≈ 4.09 GFLOPs/image (conv+fc MACs×2); training
+    # (fwd + input-grad + weight-grad) ≈ 3× fwd.
+    flops_per_image = 3 * 4.09e9 * (image_size / 224.0) ** 2
+    peak = {
+        # bf16 peak TFLOPs per chip
+        "tpu": float(os.environ.get("BENCH_PEAK_TFLOPS", "197")),  # v5e ≈ 197
+        "cpu": 0.2,
+    }.get(platform, 197.0)
+    mfu = images_per_sec_chip * flops_per_image / (peak * 1e12)
+
+    out = {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(images_per_sec_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "mfu": round(mfu, 4),
+        "platform": platform,
+        "n_devices": n_dev,
+        "batch_size": batch_size,
+        "image_size": image_size,
+        "ms_per_step": round(1000 * dt / steps, 2),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

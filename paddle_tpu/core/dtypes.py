@@ -33,7 +33,14 @@ class Policy:
 
 
 _F32 = Policy()
-_BF16 = Policy(compute_dtype=jnp.bfloat16, precision=lax.Precision.DEFAULT)
+# bf16 end-to-end for dots/convs: the MXU accumulates in f32 internally and
+# rounds the result; asking for a f32 *output* (preferred_element_type) breaks
+# autodiff transpose rules with mixed-dtype operands, so accum == compute here.
+_BF16 = Policy(
+    compute_dtype=jnp.bfloat16,
+    accum_dtype=jnp.bfloat16,
+    precision=lax.Precision.DEFAULT,
+)
 
 _current: Policy = _F32
 

@@ -52,14 +52,13 @@ class ClassificationErrorEvaluator(Evaluator):
         output = np.asarray(output)
         label = np.asarray(label)
         if output.ndim == 3:  # sequence output
-            b, t, c = output.shape
-            pred = output.argmax(-1).reshape(-1)
-            lab = label.reshape(-1)
-            keep = (
-                (np.arange(t)[None, :] < np.asarray(lengths)[:, None]).reshape(-1)
-                if lengths is not None
-                else np.ones(b * t, bool)
+            flat, keep = _mask_flat(
+                output, np.asarray(lengths) if lengths is not None else None
             )
+            pred = flat.reshape((-1,) + flat.shape[-1:]).argmax(-1)
+            lab = label.reshape(-1)
+            if keep is None:
+                keep = np.ones(len(lab), bool)
         else:
             pred = output.argmax(-1)
             lab = label.reshape(-1)

@@ -1,0 +1,9 @@
+"""Model zoo covering the BASELINE configs (BASELINE.json 'configs') and the
+reference's benchmark models (benchmark/paddle/image/{alexnet,googlenet,vgg,
+smallnet_mnist_cifar}.py, v1_api_demo/mnist, v1_api_demo/model_zoo/resnet)."""
+
+from paddle_tpu.models.lenet import lenet  # noqa: F401
+from paddle_tpu.models.resnet import resnet, resnet50  # noqa: F401
+from paddle_tpu.models.vgg import vgg16, vgg19  # noqa: F401
+from paddle_tpu.models.alexnet import alexnet  # noqa: F401
+from paddle_tpu.models.googlenet import googlenet  # noqa: F401

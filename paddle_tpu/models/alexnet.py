@@ -1,0 +1,30 @@
+"""AlexNet — parity with benchmark/paddle/image/alexnet.py (the headline
+GPU benchmark model, BASELINE.md rows 1 and 4)."""
+
+from __future__ import annotations
+
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+
+
+def alexnet(num_classes: int = 1000, image_size: int = 224):
+    img = L.Data("image", shape=(image_size, image_size, 3))
+    label = L.Data("label", shape=())
+    x = L.Conv2D(img, 64, 11, stride=4, padding=2, act="relu", name="conv1")
+    x = L.CrossMapNorm(x, size=5, name="norm1")
+    x = L.Pool2D(x, 3, "max", stride=2, name="pool1")
+    x = L.Conv2D(x, 192, 5, padding=2, act="relu", name="conv2")
+    x = L.CrossMapNorm(x, size=5, name="norm2")
+    x = L.Pool2D(x, 3, "max", stride=2, name="pool2")
+    x = L.Conv2D(x, 384, 3, padding=1, act="relu", name="conv3")
+    x = L.Conv2D(x, 256, 3, padding=1, act="relu", name="conv4")
+    x = L.Conv2D(x, 256, 3, padding=1, act="relu", name="conv5")
+    x = L.Pool2D(x, 3, "max", stride=2, name="pool5")
+    x = L.Reshape(x, (-1,), name="flatten")
+    x = L.Fc(x, 4096, act="relu", name="fc6")
+    x = L.Dropout(x, 0.5, name="drop6")
+    x = L.Fc(x, 4096, act="relu", name="fc7")
+    x = L.Dropout(x, 0.5, name="drop7")
+    logits = L.Fc(x, num_classes, act=None, name="logits")
+    cost = C.ClassificationCost(logits, label, name="cost")
+    return img, label, logits, cost

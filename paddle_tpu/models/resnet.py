@@ -1,0 +1,93 @@
+"""ResNet for ImageNet — BASELINE config #2 and the flagship bench model.
+
+Capability parity with v1_api_demo/model_zoo/resnet/resnet.py (resnet_50/101/152
+built from conv_bn_layer + bottleneck blocks); re-designed NHWC + bf16-friendly
+for the MXU. The residual add is an Addto layer (AddtoLayer.cpp) exactly as the
+reference composes it."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.graph import Layer
+
+
+def conv_bn(
+    x: Layer,
+    num_filters: int,
+    filter_size: int,
+    stride: int = 1,
+    padding: Optional[int] = None,
+    act: Optional[str] = "relu",
+    name: str = "",
+) -> Layer:
+    """conv → BN → act, conv without bias (BN has the shift) — the
+    conv_bn_layer composite of the reference's resnet config."""
+    if padding is None:
+        padding = (filter_size - 1) // 2
+    conv = L.Conv2D(
+        x,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        stride=stride,
+        padding=padding,
+        act=None,
+        bias=False,
+        name=f"{name}.conv",
+    )
+    return L.BatchNorm(conv, act=act, name=f"{name}.bn")
+
+
+def bottleneck(x: Layer, mid: int, out: int, stride: int, name: str) -> Layer:
+    """1x1 → 3x3 → 1x1 bottleneck with projection shortcut when shape changes."""
+    in_ch = _out_channels(x)
+    a = conv_bn(x, mid, 1, stride, 0, "relu", f"{name}.a")
+    b = conv_bn(a, mid, 3, 1, 1, "relu", f"{name}.b")
+    c = conv_bn(b, out, 1, 1, 0, None, f"{name}.c")
+    if stride != 1 or in_ch != out:
+        shortcut = conv_bn(x, out, 1, stride, 0, None, f"{name}.proj")
+    else:
+        shortcut = x
+    return L.Addto([c, shortcut], act="relu", name=f"{name}.add")
+
+
+def _out_channels(layer: Layer) -> int:
+    # walk the spec graph for the static channel count
+    if isinstance(layer, L.Data):
+        return layer.shape[-1]
+    if isinstance(layer, L.Conv2D):
+        return layer.num_filters
+    if isinstance(layer, (L.BatchNorm, L.Pool2D, L.Addto)):
+        return _out_channels(layer.inputs[0])
+    raise ValueError(f"cannot infer channels of {layer}")
+
+
+DEPTHS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+def resnet(
+    depth: int = 50,
+    num_classes: int = 1000,
+    image_size: int = 224,
+) -> Tuple[Layer, Layer, Layer, Layer]:
+    """Returns (data, label, logits, cost). NHWC input [B, S, S, 3]."""
+    blocks = DEPTHS[depth]
+    img = L.Data("image", shape=(image_size, image_size, 3))
+    label = L.Data("label", shape=())
+    x = conv_bn(img, 64, 7, 2, 3, "relu", "stem")
+    x = L.Pool2D(x, 3, "max", stride=2, padding=1, name="stem.pool")
+    widths = [(64, 256), (128, 512), (256, 1024), (512, 2048)]
+    for stage, (n_blocks, (mid, out)) in enumerate(zip(blocks, widths)):
+        for blk in range(n_blocks):
+            stride = 2 if (stage > 0 and blk == 0) else 1
+            x = bottleneck(x, mid, out, stride, f"s{stage}b{blk}")
+    pooled = L.GlobalPool(x, "avg", name="gap")
+    logits = L.Fc(pooled, num_classes, act=None, name="logits")
+    cost = C.ClassificationCost(logits, label, name="cost")
+    return img, label, logits, cost
+
+
+def resnet50(num_classes: int = 1000, image_size: int = 224):
+    return resnet(50, num_classes, image_size)

@@ -1,0 +1,41 @@
+"""VGG-16/19 — parity with benchmark/paddle/image/vgg.py and the
+vgg_16_network helper (trainer_config_helpers/networks.py:468)."""
+
+from __future__ import annotations
+
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+
+
+def _block(x, n_convs, channels, name):
+    for i in range(n_convs):
+        x = L.Conv2D(
+            x, channels, 3, padding=1, act="relu", bias=True, name=f"{name}.conv{i}"
+        )
+    return L.Pool2D(x, 2, "max", name=f"{name}.pool")
+
+
+def vgg(depth: int, num_classes: int = 1000, image_size: int = 224, fc_dim: int = 4096):
+    cfg = {16: (2, 2, 3, 3, 3), 19: (2, 2, 4, 4, 4)}[depth]
+    img = L.Data("image", shape=(image_size, image_size, 3))
+    label = L.Data("label", shape=())
+    x = img
+    for i, (n, ch) in enumerate(zip(cfg, (64, 128, 256, 512, 512))):
+        x = _block(x, n, ch, f"b{i}")
+    side = image_size // 32
+    x = L.Reshape(x, (side * side * 512,), name="flatten")
+    x = L.Fc(x, fc_dim, act="relu", name="fc6")
+    x = L.Dropout(x, 0.5, name="drop6")
+    x = L.Fc(x, fc_dim, act="relu", name="fc7")
+    x = L.Dropout(x, 0.5, name="drop7")
+    logits = L.Fc(x, num_classes, act=None, name="logits")
+    cost = C.ClassificationCost(logits, label, name="cost")
+    return img, label, logits, cost
+
+
+def vgg16(num_classes: int = 1000, image_size: int = 224):
+    return vgg(16, num_classes, image_size)
+
+
+def vgg19(num_classes: int = 1000, image_size: int = 224):
+    return vgg(19, num_classes, image_size)

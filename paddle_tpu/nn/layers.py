@@ -11,6 +11,7 @@ from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.registry import LAYERS
 from paddle_tpu.nn import activations as act_mod
@@ -567,4 +568,386 @@ class Reshape(Layer):
 
     def forward(self, ctx, ins):
         x = ins[0].value
-        return Argument(x.reshape((x.shape[0],) + self.shape))
+        shape = self.shape
+        if -1 in shape:
+            known = 1
+            for d in shape:
+                if d != -1:
+                    known *= d
+            rest = int(np.prod(x.shape[1:])) // known
+            shape = tuple(rest if d == -1 else d for d in shape)
+        return Argument(x.reshape((x.shape[0],) + shape))
+
+
+@LAYERS.register("global_pool")
+class GlobalPool(Layer):
+    """Global spatial pooling NHWC → [B, C] (the reference expresses this as a
+    PoolLayer with full-image kernel, e.g. resnet's pool7x7 avg)."""
+
+    type_name = "global_pool"
+
+    def __init__(self, input: Layer, pool_type: str = "avg", name=None):
+        super().__init__(input, name=name)
+        assert pool_type in ("avg", "max")
+        self.pool_type = pool_type
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        if self.pool_type == "avg":
+            return ins[0].with_value(jnp.mean(x, axis=(1, 2)))
+        return ins[0].with_value(jnp.max(x, axis=(1, 2)))
+
+
+@LAYERS.register("maxout")
+class Maxout(Layer):
+    """Maxout over channel groups (MaxOutLayer.cpp; hl_maxout_forward)."""
+
+    type_name = "maxout"
+
+    def __init__(self, input: Layer, groups: int, name=None):
+        super().__init__(input, name=name)
+        self.groups = groups
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        c = x.shape[-1]
+        out = x.reshape(x.shape[:-1] + (c // self.groups, self.groups)).max(-1)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("spp")
+class SpatialPyramidPool(Layer):
+    """Spatial pyramid pooling (SpatialPyramidPoolLayer.cpp): concat of
+    max/avg pools at pyramid levels 1,2,4,... bins → fixed-size vector."""
+
+    type_name = "spp"
+
+    def __init__(self, input: Layer, pyramid_height: int = 3, pool_type: str = "max", name=None):
+        super().__init__(input, name=name)
+        self.pyramid_height = pyramid_height
+        self.pool_type = pool_type
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        b, h, w, c = x.shape
+        outs = []
+        for level in range(self.pyramid_height):
+            bins = 2**level
+            if bins > h or bins > w:
+                # finer than the feature map — skip the level (input smaller
+                # than the pyramid base)
+                continue
+            bh, bw = h // bins, w // bins
+            cropped = x[:, : bh * bins, : bw * bins, :]
+            tiles = cropped.reshape(b, bins, bh, bins, bw, c)
+            if self.pool_type == "max":
+                pooled = tiles.max(axis=(2, 4))
+            else:
+                pooled = tiles.mean(axis=(2, 4))
+            outs.append(pooled.reshape(b, bins * bins * c))
+        return Argument(jnp.concatenate(outs, axis=-1))
+
+
+@LAYERS.register("lrn", "img_cmrnorm")
+class CrossMapNorm(Layer):
+    """Local response normalization across channels (NormProjectionLayer /
+    CrossMapNormalOp, paddle/function/CrossMapNormalOp.cpp)."""
+
+    type_name = "lrn"
+
+    def __init__(self, input: Layer, size: int = 5, scale: float = 1e-4, power: float = 0.75, name=None):
+        super().__init__(input, name=name)
+        self.size = size
+        self.scale = scale
+        self.power = power
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        sq = jnp.square(x)
+        half = self.size // 2
+        # sum over a window of channels via padding + stacked slices
+        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        c = x.shape[-1]
+        acc = sum(padded[..., i : i + c] for i in range(self.size))
+        denom = jnp.power(1.0 + self.scale * acc, self.power)
+        return ins[0].with_value(x / denom)
+
+
+@LAYERS.register("row_l2_norm")
+class RowL2Norm(Layer):
+    """Row-wise L2 normalization (RowL2NormLayer.cpp)."""
+
+    type_name = "row_l2_norm"
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        return ins[0].with_value(x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12))
+
+
+@LAYERS.register("cross_channel_norm")
+class CrossChannelNorm(Layer):
+    """Per-pixel channel L2 norm with learned per-channel scale
+    (CrossChannelNormLayer.cpp, used by SSD)."""
+
+    type_name = "cross_channel_norm"
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        c = x.shape[-1]
+        scale = ctx.param(self, "scale", (c,), init_mod.ones, None)
+        norm = jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+        return ins[0].with_value(x / norm * scale)
+
+
+@LAYERS.register("data_norm")
+class DataNorm(Layer):
+    """Feature standardization with precomputed stats (DataNormLayer.cpp):
+    z-score / min-max / decimal-scaling using static (non-trained) stats."""
+
+    type_name = "data_norm"
+
+    def __init__(self, input: Layer, strategy: str = "z-score", name=None):
+        super().__init__(input, name=name)
+        assert strategy in ("z-score", "min-max", "decimal-scaling")
+        self.strategy = strategy
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        d = x.shape[-1]
+        if self.strategy == "z-score":
+            mean = ctx.state(self, "mean", (d,), 0.0)
+            std = ctx.state(self, "std", (d,), 1.0)
+            return ins[0].with_value((x - mean) / jnp.maximum(std, 1e-12))
+        if self.strategy == "min-max":
+            mn = ctx.state(self, "min", (d,), 0.0)
+            mx = ctx.state(self, "max", (d,), 1.0)
+            return ins[0].with_value((x - mn) / jnp.maximum(mx - mn, 1e-12))
+        scale = ctx.state(self, "scale", (d,), 1.0)
+        return ins[0].with_value(x / jnp.maximum(scale, 1e-12))
+
+
+@LAYERS.register("bilinear_interp")
+class BilinearInterp(Layer):
+    """Bilinear upsampling (BilinearInterpLayer.cpp; hl_bilinear_forward)."""
+
+    type_name = "bilinear_interp"
+
+    def __init__(self, input: Layer, out_size: Tuple[int, int], name=None):
+        super().__init__(input, name=name)
+        self.out_size = out_size
+
+    def forward(self, ctx, ins):
+        from paddle_tpu.ops import conv as conv_ops
+
+        out = conv_ops.bilinear_resize(ins[0].value, *self.out_size)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("pad")
+class Pad(Layer):
+    """Zero-padding on H/W/C axes (PadLayer.cpp, paddle/function/PadOp.cpp)."""
+
+    type_name = "pad"
+
+    def __init__(self, input: Layer, pad_h=(0, 0), pad_w=(0, 0), pad_c=(0, 0), name=None):
+        super().__init__(input, name=name)
+        self.pads = (tuple(pad_h), tuple(pad_w), tuple(pad_c))
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        ph, pw, pc = self.pads
+        return ins[0].with_value(jnp.pad(x, ((0, 0), ph, pw, pc)))
+
+
+@LAYERS.register("crop")
+class Crop(Layer):
+    """Spatial crop (CropLayer.cpp, paddle/function/CropOp.cpp)."""
+
+    type_name = "crop"
+
+    def __init__(self, input: Layer, offset_h: int, offset_w: int, out_h: int, out_w: int, name=None):
+        super().__init__(input, name=name)
+        self.offset = (offset_h, offset_w)
+        self.out = (out_h, out_w)
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        oh, ow = self.offset
+        h, w = self.out
+        return ins[0].with_value(x[:, oh : oh + h, ow : ow + w, :])
+
+
+@LAYERS.register("rotate")
+class Rotate(Layer):
+    """90° CCW rotation of the spatial block (RotateLayer.cpp)."""
+
+    type_name = "rotate"
+
+    def forward(self, ctx, ins):
+        return ins[0].with_value(jnp.rot90(ins[0].value, k=1, axes=(1, 2)))
+
+
+@LAYERS.register("switch_order")
+class SwitchOrder(Layer):
+    """NHWC ↔ NCHW reorder (SwitchOrderLayer.cpp, function/SwitchOp.cpp).
+    Kept for config parity; internally everything is NHWC."""
+
+    type_name = "switch_order"
+
+    def __init__(self, input: Layer, to: str = "NCHW", name=None):
+        super().__init__(input, name=name)
+        assert to in ("NCHW", "NHWC")
+        self.to = to
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        perm = (0, 3, 1, 2) if self.to == "NCHW" else (0, 2, 3, 1)
+        return ins[0].with_value(jnp.transpose(x, perm))
+
+
+@LAYERS.register("feature_map_expand")
+class FeatureMapExpand(Layer):
+    """Tile a [B, D] vector across feature-map positions (FeatureMapExpandLayer)."""
+
+    type_name = "feature_map_expand"
+
+    def __init__(self, input: Layer, num_filters: int, name=None):
+        super().__init__(input, name=name)
+        self.num_filters = num_filters
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        return ins[0].with_value(jnp.repeat(x[:, None, :], self.num_filters, axis=1).reshape(x.shape[0], -1))
+
+
+@LAYERS.register("clip")
+class Clip(Layer):
+    """Elementwise clip (ClipLayer.cpp)."""
+
+    type_name = "clip"
+
+    def __init__(self, input: Layer, min: float, max: float, name=None):
+        super().__init__(input, name=name)
+        self.lo, self.hi = min, max
+
+    def forward(self, ctx, ins):
+        return ins[0].with_value(jnp.clip(ins[0].value, self.lo, self.hi))
+
+
+@LAYERS.register("scale_shift")
+class ScaleShift(Layer):
+    """y = w*x + b with scalar learned w,b (ScaleShiftLayer.cpp)."""
+
+    type_name = "scale_shift"
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        w = ctx.param(self, "w", (1,), init_mod.ones, None)
+        b = ctx.param(self, "b", (1,), init_mod.zeros, None)
+        return ins[0].with_value(w[0] * x + b[0])
+
+
+@LAYERS.register("prelu")
+class ParameterRelu(Layer):
+    """Parametric ReLU with per-partition slopes (ParameterReluLayer.cpp;
+    hl_param_relu_forward)."""
+
+    type_name = "prelu"
+
+    def __init__(self, input: Layer, partial_sum: int = 1, name=None):
+        super().__init__(input, name=name)
+        self.partial_sum = partial_sum
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        d = x.shape[-1]
+        n_slope = d // self.partial_sum
+        w = ctx.param(self, "w", (n_slope,), init_mod.constant(0.25), None)
+        slopes = jnp.repeat(w, self.partial_sum)
+        return ins[0].with_value(jnp.where(x > 0, x, x * slopes))
+
+
+@LAYERS.register("multiplex")
+class Multiplex(Layer):
+    """Row-wise select among N inputs by index (MultiplexLayer.cpp):
+    inputs[0] = int index [B], inputs[1..N] = candidates."""
+
+    type_name = "multiplex"
+
+    def __init__(self, index: Layer, inputs: Sequence[Layer], name=None):
+        super().__init__([index] + list(inputs), name=name)
+
+    def forward(self, ctx, ins):
+        idx = ins[0].value.astype(jnp.int32).reshape(-1)
+        stacked = jnp.stack([a.value for a in ins[1:]], axis=1)  # [B, N, D]
+        out = jnp.take_along_axis(stacked, idx[:, None, None], axis=1)[:, 0]
+        return ins[1].with_value(out)
+
+
+@LAYERS.register("outer_prod")
+class OuterProd(Layer):
+    """Row-wise outer product flattened (OuterProdLayer.cpp)."""
+
+    type_name = "outer_prod"
+
+    def __init__(self, input1: Layer, input2: Layer, name=None):
+        super().__init__([input1, input2], name=name)
+
+    def forward(self, ctx, ins):
+        a, b = ins[0].value, ins[1].value
+        out = jnp.einsum("bi,bj->bij", a, b).reshape(a.shape[0], -1)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("conv_shift")
+class ConvShift(Layer):
+    """Circular 1-D correlation of each row with a learned/input kernel
+    (ConvShiftLayer.cpp): out[i] = sum_j b[j] * a[(i+j-half) mod D]."""
+
+    type_name = "conv_shift"
+
+    def __init__(self, input1: Layer, input2: Layer, name=None):
+        super().__init__([input1, input2], name=name)
+
+    def forward(self, ctx, ins):
+        a, b = ins[0].value, ins[1].value
+        d = a.shape[-1]
+        k = b.shape[-1]
+        half = k // 2
+        idx = (jnp.arange(d)[:, None] + jnp.arange(k)[None, :] - half) % d
+        # out[b, i] = sum_j  a[b, idx[i,j]] * b[b, j]
+        gathered = a[:, idx]  # [B, D, K]
+        out = jnp.einsum("bdk,bk->bd", gathered, b)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("sum_to_one_norm")
+class SumToOneNorm(Layer):
+    """Row normalize to sum 1 (SumToOneNormLayer.cpp)."""
+
+    type_name = "sum_to_one_norm"
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        return ins[0].with_value(x / jnp.maximum(jnp.sum(x, -1, keepdims=True), 1e-12))
+
+
+@LAYERS.register("tensor")
+class TensorLayer(Layer):
+    """Bilinear tensor product (TensorLayer.cpp): out_k = x W_k y^T."""
+
+    type_name = "tensor"
+
+    def __init__(self, input1: Layer, input2: Layer, size: int, act=None, name=None):
+        super().__init__([input1, input2], name=name)
+        self.size = size
+        self.act = act
+
+    def forward(self, ctx, ins):
+        x, y = ins[0].value, ins[1].value
+        w = ctx.param(
+            self, "w", (self.size, x.shape[-1], y.shape[-1]), init_mod.smart_normal, None
+        )
+        out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+        out = act_mod.apply(self.act, out)
+        return ins[0].with_value(out)

@@ -1,0 +1,3 @@
+from paddle_tpu.parallel.mesh import make_mesh  # noqa: F401
+from paddle_tpu.parallel.data_parallel import DataParallel  # noqa: F401
+from paddle_tpu.parallel import distributed as distributed  # noqa: F401

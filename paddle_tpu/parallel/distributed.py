@@ -1,0 +1,63 @@
+"""Multi-host initialization + host-level barriers.
+
+Replaces the reference's cluster bring-up: ParameterServerController (N pserver
+ports), ParameterClient2 connection setup, and the Go master/etcd discovery
+(go/master/etcd_client.go). On TPU pods, `jax.distributed.initialize` does
+discovery/rendezvous (GCS or coordinator address) and the resulting global
+device set feeds one Mesh spanning all hosts; DCN handles cross-slice."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("paddle_tpu.distributed")
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the multi-host runtime. No-op on single host (mirrors the
+    reference: local training skips pserver setup, TrainerMain.cpp:32)."""
+    global _initialized
+    if _initialized:
+        return
+    if num_processes is None or num_processes <= 1:
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "distributed init: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def barrier(name: str = "barrier") -> None:
+    """Host-level sync point — parity with ParameterServer2::synchronize
+    (ParameterServer2.h:423) and the ThreadBarrier across gradient servers.
+    Implemented as a tiny psum across all devices."""
+    import jax.numpy as jnp
+
+    x = jnp.ones((jax.local_device_count(),))
+    jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()

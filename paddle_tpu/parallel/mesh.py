@@ -1,0 +1,43 @@
+"""Device mesh construction.
+
+The TPU replacement for the reference's process/device topology flags
+(--trainer_count, --num_gradient_servers; utils/Flags.h:19-43): a named
+`jax.sharding.Mesh` whose axes express every parallelism the framework offers —
+data (the MultiGradientMachine ring / pserver sync), model (per-layer placement
+of ParallelNeuralNetwork), seq (ring-attention sequence parallelism), expert
+(sparse/embedding sharding à la SparseRemoteParameterUpdater)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXES = ("data", "model", "seq", "expert")
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """axis_sizes e.g. {"data": 4, "model": 2}. Unmentioned axes get size 1.
+    The product must divide the device count; when it is smaller, only the
+    first `product` devices are used (axis_sizes=None uses all on 'data')."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = {"data": n}
+    sizes = dict(axis_sizes)
+    total = int(np.prod(list(sizes.values()))) if sizes else 1
+    if n % total != 0:
+        raise ValueError(f"{n} devices not divisible by mesh {sizes}")
+    # explicit sizes are honored exactly: extra devices are left out rather
+    # than silently inflating an axis
+    devices = devices[:total]
+    names = [a for a in AXES if a in sizes] + [a for a in sizes if a not in AXES]
+    shape = [sizes[a] for a in names]
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(names))

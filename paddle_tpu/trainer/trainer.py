@@ -77,6 +77,10 @@ class SGDTrainer:
             "rng": rng,
         }
         if self.parallel is not None:
+            # hand the discovered per-param attrs (sharding specs) to the
+            # parallel plan before placing the state on the mesh
+            if not self.parallel.param_attrs:
+                self.parallel.param_attrs = self.network.param_attrs
             state = self.parallel.shard_state(state)
         self.state = state
         return state
@@ -165,6 +169,15 @@ class SGDTrainer:
             for batch_id, raw in enumerate(reader()):
                 batch = feeder(raw) if feeder is not None else raw
                 if self.parallel is not None:
+                    if not self.parallel.batch_divisible(batch):
+                        # trailing partial batch not divisible by the mesh data
+                        # axis — skip it (drop_last semantics), like the
+                        # per-thread batch split in MultiGradientMachine
+                        log.warning(
+                            "skipping batch %d: size not divisible by mesh "
+                            "data axis", batch_id,
+                        )
+                        continue
                     batch = self.parallel.shard_batch(batch)
                 if self.state is None:
                     self.init_state(batch)
@@ -215,16 +228,19 @@ class SGDTrainer:
         return {"cost": total / max(n, 1), "samples": n}
 
     def save(self, save_dir: str, pass_id: int) -> str:
+        """Raw params + optimizer + averaging state are all persisted so
+        load() is a true resume; deployment-time averaged weights are
+        recoverable via ModelAverage.averaged_params on the loaded state."""
         assert self.state is not None
-        params = self.model_average.averaged_params(
-            self.state["avg"], self.state["params"]
-        )
+        opt_tree = {"opt": self.state["opt"]}
+        if self.state["avg"]:
+            opt_tree["avg"] = self.state["avg"]
         return ckpt_mod.save_pass(
             save_dir,
             pass_id,
-            params,
+            self.state["params"],
             self.state["states"],
-            self.state["opt"],
+            opt_tree,
             extra_meta={"samples": int(self.state["samples"])},
         )
 
@@ -239,10 +255,20 @@ class SGDTrainer:
         if states:
             self.state["states"] = {k: jnp.asarray(v) for k, v in states.items()}
         if opt_flat:
-            self.state["opt"] = ckpt_mod.restore_tree(self.state["opt"], opt_flat)
+            template = {"opt": self.state["opt"]}
+            if self.state["avg"]:
+                template["avg"] = self.state["avg"]
+            restored = ckpt_mod.restore_tree(template, opt_flat)
+            self.state["opt"] = restored["opt"]
+            if "avg" in restored:
+                self.state["avg"] = restored["avg"]
         samples = manifest.get("extra", {}).get("samples")
         if samples is not None:
             self.state["samples"] = jnp.asarray(int(samples), jnp.int32)
+        if self.parallel is not None:
+            # re-establish mesh placement (sharded head weights, replicated
+            # slots) — plain asarray loads land unsharded otherwise
+            self.state = self.parallel.shard_state(self.state)
 
 
 def _batch_size(batch: Dict[str, Any]) -> int:

@@ -1,0 +1,77 @@
+"""Model zoo smoke + LeNet convergence (test_TrainerOnePass analog for the
+BASELINE configs) on tiny shapes."""
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.nn.graph import Network, reset_name_scope
+from paddle_tpu import models
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    reset_name_scope()
+
+
+def _smoke(builder, image_size, classes=10, batch=2, **kw):
+    img, label, logits, cost = builder(num_classes=classes, image_size=image_size, **kw)
+    net = Network([cost, logits])
+    rs = np.random.RandomState(0)
+    batch_data = {
+        img.name: rs.randn(batch, image_size, image_size, 3).astype(np.float32),
+        label.name: rs.randint(0, classes, batch),
+    }
+    params, states = net.init(jax.random.PRNGKey(0), batch_data)
+    outs, _ = net.apply(params, states, batch_data, train=False)
+    assert outs[logits.name].value.shape == (batch, classes)
+    assert np.isfinite(float(outs[cost.name].value))
+    return params
+
+
+def test_resnet50_tiny():
+    # image 32 keeps CPU time sane; stage/block structure identical to 224
+    params = _smoke(models.resnet50, 32)
+    # 53 convs + bn scales etc.
+    n_convs = sum(1 for k in params if k.endswith(".conv.w"))
+    assert n_convs == 53
+
+
+def test_vgg16_tiny():
+    _smoke(models.vgg16, 32)
+
+
+def test_alexnet():
+    _smoke(models.alexnet, 224)
+
+
+def test_googlenet_tiny():
+    _smoke(models.googlenet, 64)
+
+
+def test_lenet_converges():
+    from paddle_tpu.data import DataFeeder, dense_array, integer_value, reader as rd
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGDTrainer
+
+    img, label, logits, cost = models.lenet()
+    rs = np.random.RandomState(0)
+    # synthetic "digits": class k = blob at position k
+    xs, ys = [], []
+    for i in range(128):
+        y = i % 10
+        im = np.zeros((28, 28, 1), np.float32)
+        im[2 * y : 2 * y + 6, 2 * y : 2 * y + 6] = 1.0
+        im += rs.randn(28, 28, 1).astype(np.float32) * 0.1
+        xs.append(im)
+        ys.append(y)
+
+    def reader():
+        for x, y in zip(xs, ys):
+            yield {"pixel": x, "label": y}
+
+    trainer = SGDTrainer(cost, Adam(learning_rate=0.003))
+    feeder = DataFeeder({"pixel": dense_array((28, 28, 1)), "label": integer_value(10)})
+    state = trainer.train(rd.batch(reader, 32, drop_last=True), num_passes=6, feeder=feeder)
+    res = trainer.test(rd.batch(reader, 32, drop_last=True), feeder)
+    assert res["cost"] < 0.5, f"LeNet failed to learn: {res}"
