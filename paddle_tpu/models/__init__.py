@@ -7,3 +7,5 @@ from paddle_tpu.models.resnet import resnet, resnet50  # noqa: F401
 from paddle_tpu.models.vgg import vgg16, vgg19  # noqa: F401
 from paddle_tpu.models.alexnet import alexnet  # noqa: F401
 from paddle_tpu.models.googlenet import googlenet  # noqa: F401
+from paddle_tpu.models.seq2seq import seq2seq, Seq2SeqModel  # noqa: F401
+from paddle_tpu.models.text_lstm import text_lstm  # noqa: F401

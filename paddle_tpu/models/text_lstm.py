@@ -1,0 +1,29 @@
+"""LSTM text classification — the reference's RNN benchmark
+(benchmark/paddle/rnn/rnn.py: embedding + N×lstm + seq-pool + fc softmax;
+BASELINE.md LSTM rows)."""
+
+from __future__ import annotations
+
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.recurrent import simple_lstm
+from paddle_tpu.nn.seq_layers import SeqPool
+
+
+def text_lstm(
+    vocab_size: int = 30000,
+    embed_dim: int = 128,
+    hidden_dim: int = 256,
+    num_layers: int = 2,
+    num_classes: int = 2,
+):
+    """Returns (data, label, logits, cost)."""
+    ids = L.Data("word_ids", shape=(vocab_size,), is_seq=True)
+    label = L.Data("label", shape=())
+    x = L.Embedding(ids, embed_dim, vocab_size=vocab_size, name="emb")
+    for i in range(num_layers):
+        x = simple_lstm(x, hidden_dim, name=f"lstm{i}")
+    pooled = SeqPool(x, "max", name="pool")
+    logits = L.Fc(pooled, num_classes, act=None, name="logits")
+    cost = C.ClassificationCost(logits, label, name="cost")
+    return ids, label, logits, cost

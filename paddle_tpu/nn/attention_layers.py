@@ -1,0 +1,142 @@
+"""Attention + attention-decoder layers.
+
+AttentionDecoder is the TPU-native replacement for the reference's
+RecurrentGradientMachine-driven NMT decoder (the recurrent_group +
+simple_attention + gru_step composition of demo/seq2seq; RecurrentGradientMachine.h:32
+dynamic unroll): one lax.scan over target steps with teacher forcing at train
+time. Generation/beam search lives in paddle_tpu/nn/beam_search.py using the
+same parameters."""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.nn import init as init_mod
+from paddle_tpu.nn.graph import Argument, Context, Layer
+from paddle_tpu.ops import attention as attn_ops
+from paddle_tpu.ops import linalg
+from paddle_tpu.ops import rnn as rnn_ops
+
+
+@LAYERS.register("simple_attention")
+class SimpleAttention(Layer):
+    """simple_attention (networks.py:1304): additive attention of a decoder
+    state over an encoder sequence → context vector [B, D]."""
+
+    type_name = "simple_attention"
+
+    def __init__(self, enc: Layer, dec_state: Layer, attention_size: int = 0, name=None):
+        super().__init__([enc, dec_state], name=name)
+        self.attention_size = attention_size
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        enc, dec = ins
+        assert enc.is_seq
+        d_enc = enc.value.shape[-1]
+        d_dec = dec.value.shape[-1]
+        a = self.attention_size or d_dec
+        w_enc = ctx.param(self, "w_enc", (d_enc, a), init_mod.smart_normal, None)
+        w_dec = ctx.param(self, "w_dec", (d_dec, a), init_mod.smart_normal, None)
+        v = ctx.param(self, "v", (a,), init_mod.smart_normal, None)
+        enc_proj = linalg.matmul(enc.value, w_enc)
+        context, _ = attn_ops.additive_attention(
+            enc.value, enc_proj, dec.value, w_dec, v, enc.lengths
+        )
+        return Argument(context)
+
+
+class DecoderParams(NamedTuple):
+    """Everything the attention-GRU decoder step needs — shared between the
+    training scan and beam-search generation."""
+
+    w_enc: jax.Array  # [De, A] attention encoder proj
+    w_dec: jax.Array  # [H, A] attention decoder proj
+    v: jax.Array  # [A]
+    w_in: jax.Array  # [Demb+De, 3H] input projection for the GRU
+    gru: rnn_ops.GruParams
+    w_init: jax.Array  # [De, H] initial-state projection (from enc last/back)
+
+
+@LAYERS.register("attention_decoder")
+class AttentionDecoder(Layer):
+    """Teacher-forced attention decoder (training path).
+
+    inputs: [encoder_seq [B,Ts,De], target_embedding_seq [B,Tt,Demb]]
+    output: decoder hidden states [B, Tt, H] (project with Fc for logits).
+
+    Step t attends with the *previous* hidden state, then
+    GRU(input=[emb_t, context_t]) — matching the reference decoder composition
+    (demo seq2seq gru_decoder_with_attention)."""
+
+    type_name = "attention_decoder"
+
+    def __init__(
+        self,
+        enc: Layer,
+        target_emb: Layer,
+        size: int,
+        attention_size: int = 0,
+        name: Optional[str] = None,
+    ):
+        super().__init__([enc, target_emb], name=name)
+        self.size = size
+        self.attention_size = attention_size
+
+    def _params(self, ctx: Context, d_enc: int, d_emb: int) -> DecoderParams:
+        h = self.size
+        a = self.attention_size or h
+        return DecoderParams(
+            w_enc=ctx.param(self, "att.w_enc", (d_enc, a), init_mod.smart_normal, None),
+            w_dec=ctx.param(self, "att.w_dec", (h, a), init_mod.smart_normal, None),
+            v=ctx.param(self, "att.v", (a,), init_mod.smart_normal, None),
+            w_in=ctx.param(
+                self, "w_in", (d_emb + d_enc, 3 * h), init_mod.smart_normal, None
+            ),
+            gru=rnn_ops.GruParams(
+                w_hzr=ctx.param(self, "gru.w_hzr", (h, 2 * h), init_mod.smart_normal, None),
+                w_hc=ctx.param(self, "gru.w_hc", (h, h), init_mod.smart_normal, None),
+                bias=ctx.param(self, "gru.b", (3 * h,), init_mod.zeros, None),
+            ),
+            w_init=ctx.param(self, "w_init", (d_enc, h), init_mod.smart_normal, None),
+        )
+
+    def initial_state(self, p: DecoderParams, enc_value, enc_lengths):
+        """h0 = tanh(W @ first-step backward encoder state) — the reference
+        seeds the decoder from the encoder's first backward state."""
+        from paddle_tpu.ops import sequence as seq_ops
+
+        first = seq_ops.seq_first(enc_value)
+        return jnp.tanh(linalg.matmul(first, p.w_init))
+
+    def step(self, p: DecoderParams, enc_value, enc_proj, enc_lengths, emb_t, h):
+        context, w = attn_ops.additive_attention(
+            enc_value, enc_proj, h, p.w_dec, p.v, enc_lengths
+        )
+        x = jnp.concatenate([emb_t, context], axis=-1)
+        proj = linalg.matmul(x, p.w_in)
+        h_new = rnn_ops.gru_step(proj, h, p.gru)
+        return h_new
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        enc, emb = ins
+        assert enc.is_seq and emb.is_seq
+        p = self._params(ctx, enc.value.shape[-1], emb.value.shape[-1])
+        enc_proj = linalg.matmul(enc.value, p.w_enc)
+        h0 = self.initial_state(p, enc.value, enc.lengths)
+        mask = emb.mask(h0.dtype)
+
+        def scan_step(h, xs):
+            emb_t, m_t = xs
+            h_new = self.step(p, enc.value, enc_proj, enc.lengths, emb_t, h)
+            m = m_t[:, None]
+            h = m * h_new + (1 - m) * h
+            return h, h
+
+        xs = (jnp.swapaxes(emb.value, 0, 1), jnp.swapaxes(mask, 0, 1))
+        _, hs = lax.scan(scan_step, h0, xs)
+        return Argument(jnp.swapaxes(hs, 0, 1), emb.lengths)

@@ -1,0 +1,212 @@
+"""Recurrent layers.
+
+Parity with the reference recurrent stack: RecurrentLayer.cpp (vanilla),
+LstmLayer.cpp + LstmCompute.cu (lstmemory: input is the 4H-wide projection,
+peephole 'check' weights, gate/state activations), GatedRecurrentLayer.cpp +
+GruCompute.cu (gated_unit: 3H-wide input), and the bidirectional composites
+bidirectional_lstm/gru (trainer_config_helpers/networks.py). Execution is a
+lax.scan over time-major padded batches (see paddle_tpu/ops/rnn.py) rather
+than SequenceToBatch reordering."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.nn import init as init_mod
+from paddle_tpu.nn.graph import Argument, Context, Layer
+from paddle_tpu.nn.layers import Fc, _attr
+from paddle_tpu.ops import rnn as rnn_ops
+
+
+@LAYERS.register("lstmemory")
+class Lstm(Layer):
+    """lstmemory (LstmLayer.cpp): input must be size 4H (pre-projected, as the
+    reference requires a preceding fc/mixed layer). use_peephole matches the
+    'check' weights of hl_lstm."""
+
+    type_name = "lstmemory"
+
+    def __init__(
+        self,
+        input: Layer,
+        size: Optional[int] = None,
+        reverse: bool = False,
+        act: Any = "tanh",
+        gate_act: Any = "sigmoid",
+        state_act: Any = "tanh",
+        use_peephole: bool = True,
+        param_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.size = size
+        self.reverse = reverse
+        self.act = act
+        self.gate_act = gate_act
+        self.state_act = state_act
+        self.use_peephole = use_peephole
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        arg = ins[0]
+        assert arg.is_seq, f"{self.name}: lstmemory needs a sequence input"
+        proj = arg.value
+        hdim = self.size or proj.shape[-1] // 4
+        assert proj.shape[-1] == 4 * hdim, (
+            f"{self.name}: input width {proj.shape[-1]} != 4*size ({4 * hdim})"
+        )
+        w_hh = ctx.param(
+            self, "w_hh", (hdim, 4 * hdim), init_mod.smart_normal, self.param_attr
+        )
+        bias = ctx.param(self, "b", (4 * hdim,), init_mod.zeros, self.bias_attr)
+        checks = (None, None, None)
+        if self.use_peephole:
+            checks = tuple(
+                ctx.param(self, f"check_{g}", (hdim,), init_mod.zeros, None)
+                for g in ("i", "f", "o")
+            )
+        p = rnn_ops.LstmParams(w_hh, bias, *checks)
+        mask = arg.mask(proj.dtype)
+        hs, h_last, c_last = rnn_ops.lstm_scan(
+            proj,
+            mask,
+            p,
+            reverse=self.reverse,
+            gate_act=self.gate_act,
+            cell_act=self.act,
+            state_act=self.state_act,
+        )
+        return Argument(hs, arg.lengths)
+
+
+@LAYERS.register("gated_unit", "grumemory")
+class Gru(Layer):
+    """grumemory (GatedRecurrentLayer.cpp): input must be size 3H."""
+
+    type_name = "grumemory"
+
+    def __init__(
+        self,
+        input: Layer,
+        size: Optional[int] = None,
+        reverse: bool = False,
+        act: Any = "tanh",
+        gate_act: Any = "sigmoid",
+        param_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.size = size
+        self.reverse = reverse
+        self.act = act
+        self.gate_act = gate_act
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        arg = ins[0]
+        assert arg.is_seq, f"{self.name}: grumemory needs a sequence input"
+        proj = arg.value
+        hdim = self.size or proj.shape[-1] // 3
+        assert proj.shape[-1] == 3 * hdim
+        w_hzr = ctx.param(
+            self, "w_hzr", (hdim, 2 * hdim), init_mod.smart_normal, self.param_attr
+        )
+        # w_hc has a different shape than w_hzr — a shared param_attr name must
+        # not collide, so derive a distinct sharing key for it
+        c_attr = self.param_attr
+        if c_attr is not None and c_attr.name:
+            import dataclasses as _dc
+
+            c_attr = _dc.replace(c_attr, name=c_attr.name + ".c")
+        w_hc = ctx.param(
+            self, "w_hc", (hdim, hdim), init_mod.smart_normal, c_attr
+        )
+        bias = ctx.param(self, "b", (3 * hdim,), init_mod.zeros, self.bias_attr)
+        p = rnn_ops.GruParams(w_hzr, w_hc, bias)
+        mask = arg.mask(proj.dtype)
+        hs, h_last = rnn_ops.gru_scan(
+            proj, mask, p, reverse=self.reverse,
+            gate_act=self.gate_act, cand_act=self.act,
+        )
+        return Argument(hs, arg.lengths)
+
+
+@LAYERS.register("recurrent")
+class SimpleRnn(Layer):
+    """Vanilla full-matrix recurrence (RecurrentLayer.cpp). Input size == H."""
+
+    type_name = "recurrent"
+
+    def __init__(
+        self,
+        input: Layer,
+        act: Any = "tanh",
+        reverse: bool = False,
+        bias: bool = True,
+        param_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.act = act
+        self.reverse = reverse
+        self.bias = bias
+        self.param_attr = _attr(param_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        arg = ins[0]
+        assert arg.is_seq
+        proj = arg.value
+        hdim = proj.shape[-1]
+        w_hh = ctx.param(
+            self, "w_hh", (hdim, hdim), init_mod.smart_normal, self.param_attr
+        )
+        b = ctx.param(self, "b", (hdim,), init_mod.zeros, None) if self.bias else None
+        hs, _ = rnn_ops.simple_rnn_scan(
+            proj, arg.mask(proj.dtype), w_hh, b, self.act, reverse=self.reverse
+        )
+        return Argument(hs, arg.lengths)
+
+
+def simple_lstm(
+    input: Layer,
+    size: int,
+    reverse: bool = False,
+    name: str = "lstm",
+    **lstm_kwargs: Any,
+) -> Layer:
+    """fc(4H) + lstmemory — the simple_lstm helper
+    (trainer_config_helpers/networks.py:553)."""
+    proj = Fc(input, 4 * size, act=None, name=f"{name}.input_proj")
+    return Lstm(proj, size=size, reverse=reverse, name=name, **lstm_kwargs)
+
+
+def simple_gru(
+    input: Layer, size: int, reverse: bool = False, name: str = "gru", **kw: Any
+) -> Layer:
+    """fc(3H) + grumemory (networks.py:981 simple_gru)."""
+    proj = Fc(input, 3 * size, act=None, name=f"{name}.input_proj")
+    return Gru(proj, size=size, reverse=reverse, name=name, **kw)
+
+
+def bidirectional_lstm(
+    input: Layer, size: int, name: str = "bilstm", **kw: Any
+) -> Layer:
+    """Concat of forward+backward lstm (networks.py bidirectional_lstm)."""
+    from paddle_tpu.nn.layers import Concat
+
+    fwd = simple_lstm(input, size, reverse=False, name=f"{name}.fw", **kw)
+    bwd = simple_lstm(input, size, reverse=True, name=f"{name}.bw", **kw)
+    return Concat([fwd, bwd], name=f"{name}.cat")
+
+
+def bidirectional_gru(input: Layer, size: int, name: str = "bigru", **kw: Any) -> Layer:
+    from paddle_tpu.nn.layers import Concat
+
+    fwd = simple_gru(input, size, reverse=False, name=f"{name}.fw", **kw)
+    bwd = simple_gru(input, size, reverse=True, name=f"{name}.bw", **kw)
+    return Concat([fwd, bwd], name=f"{name}.cat")

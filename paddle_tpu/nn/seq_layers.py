@@ -1,0 +1,205 @@
+"""Sequence-structure layers.
+
+Parity with the reference sequence layer family (paddle/gserver/layers/):
+SequencePoolLayer (sum/avg/max/sqrt), SequenceLastInstanceLayer (+first),
+MaxLayer, AverageLayer, ExpandLayer, SequenceConcatLayer, SequenceReshapeLayer,
+SequenceSliceLayer, KmaxSeqScoreLayer, GetOutputLayer — on padded [B,T,...]
+batches with masks (the TPU encoding of Argument.sequenceStartPositions)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.nn.graph import Argument, Context, Layer
+from paddle_tpu.ops import sequence as seq_ops
+
+
+@LAYERS.register("seq_pool")
+class SeqPool(Layer):
+    """SequencePoolLayer: pool over time → [B, D]."""
+
+    type_name = "seq_pool"
+
+    def __init__(self, input: Layer, pool_type: str = "sum", name=None):
+        super().__init__(input, name=name)
+        assert pool_type in ("sum", "average", "avg", "max", "sqrt")
+        self.pool_type = pool_type
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        arg = ins[0]
+        assert arg.is_seq, f"{self.name}: needs sequence input"
+        fn = {
+            "sum": seq_ops.seq_sum,
+            "average": seq_ops.seq_mean,
+            "avg": seq_ops.seq_mean,
+            "max": seq_ops.seq_max,
+            "sqrt": seq_ops.seq_sqrt_pool,
+        }[self.pool_type]
+        return Argument(fn(arg.value, arg.lengths))
+
+
+@LAYERS.register("last_seq")
+class LastSeq(Layer):
+    """SequenceLastInstanceLayer."""
+
+    type_name = "last_seq"
+
+    def forward(self, ctx, ins):
+        arg = ins[0]
+        return Argument(seq_ops.seq_last(arg.value, arg.lengths))
+
+
+@LAYERS.register("first_seq")
+class FirstSeq(Layer):
+    """SequenceLastInstanceLayer with select_first=True."""
+
+    type_name = "first_seq"
+
+    def forward(self, ctx, ins):
+        return Argument(seq_ops.seq_first(ins[0].value))
+
+
+@LAYERS.register("expand")
+class Expand(Layer):
+    """ExpandLayer: broadcast [B, D] across the time axis of a reference
+    sequence → [B, T, D]."""
+
+    type_name = "expand"
+
+    def __init__(self, input: Layer, expand_as: Layer, name=None):
+        super().__init__([input, expand_as], name=name)
+
+    def forward(self, ctx, ins):
+        x, ref = ins[0], ins[1]
+        assert ref.is_seq
+        out = seq_ops.expand_to_seq(x.value, ref.lengths, ref.max_len)
+        return Argument(out, ref.lengths)
+
+
+@LAYERS.register("seq_concat")
+class SeqConcat(Layer):
+    """SequenceConcatLayer: concatenate two sequences in time."""
+
+    type_name = "seq_concat"
+
+    def __init__(self, a: Layer, b: Layer, name=None):
+        super().__init__([a, b], name=name)
+
+    def forward(self, ctx, ins):
+        a, b = ins
+        assert a.is_seq and b.is_seq
+        ta, tb = a.max_len, b.max_len
+        d = a.value.shape[-1]
+        bsz = a.value.shape[0]
+        out_t = ta + tb
+        out = jnp.zeros((bsz, out_t, d), a.value.dtype)
+        out = out.at[:, :ta].set(a.value * a.mask(a.value.dtype)[:, :, None])
+        # scatter b after each row's a-length
+        idx = a.lengths[:, None] + jnp.arange(tb)[None, :]  # [B, tb]
+        bm = b.mask(b.value.dtype)[:, :, None]
+        batch_idx = jnp.arange(bsz)[:, None].repeat(tb, 1)
+        out = out.at[batch_idx, idx].add(b.value * bm)
+        return Argument(out, a.lengths + b.lengths)
+
+
+@LAYERS.register("seq_reshape")
+class SeqReshape(Layer):
+    """SequenceReshapeLayer: change the feature width by regrouping time
+    steps (T*D = T'*D')."""
+
+    type_name = "seq_reshape"
+
+    def __init__(self, input: Layer, reshape_size: int, name=None):
+        super().__init__(input, name=name)
+        self.reshape_size = reshape_size
+
+    def forward(self, ctx, ins):
+        arg = ins[0]
+        b, t, d = arg.value.shape
+        new_d = self.reshape_size
+        total = t * d
+        assert total % new_d == 0, f"{self.name}: {t}x{d} not divisible by {new_d}"
+        new_t = total // new_d
+        out = arg.value.reshape(b, new_t, new_d)
+        # ceil so a ragged row whose valid element count is not divisible by
+        # new_d keeps its trailing partial step (zero-padded) instead of
+        # silently dropping data
+        new_lengths = -((arg.lengths * d) // -new_d)
+        return Argument(out, new_lengths)
+
+
+@LAYERS.register("seq_slice")
+class SeqSlice(Layer):
+    """SequenceSliceLayer: keep the first/last k steps of each sequence."""
+
+    type_name = "seq_slice"
+
+    def __init__(self, input: Layer, k: int, from_start: bool = True, name=None):
+        super().__init__(input, name=name)
+        self.k = k
+        self.from_start = from_start
+
+    def forward(self, ctx, ins):
+        arg = ins[0]
+        x, lengths = arg.value, arg.lengths
+        b, t = x.shape[:2]
+        k = min(self.k, t)
+        new_len = jnp.minimum(lengths, k)
+        if self.from_start:
+            out = x[:, :k]
+        else:
+            # last k valid steps of each row: gather with per-row offsets
+            start = jnp.maximum(lengths - k, 0)  # [B]
+            idx = start[:, None] + jnp.arange(k)[None, :]
+            idx = jnp.minimum(idx, t - 1)
+            out = jnp.take_along_axis(
+                x, idx.reshape(b, k, *([1] * (x.ndim - 2))), axis=1
+            )
+        return Argument(out, new_len)
+
+
+@LAYERS.register("kmax_seq_score")
+class KmaxSeqScore(Layer):
+    """KmaxSeqScoreLayer: indices of the top-k scores within each sequence."""
+
+    type_name = "kmax_seq_score"
+
+    def __init__(self, input: Layer, beam_size: int, name=None):
+        super().__init__(input, name=name)
+        self.beam_size = beam_size
+
+    def forward(self, ctx, ins):
+        arg = ins[0]
+        scores = arg.value
+        if scores.ndim == 3:
+            scores = scores[..., 0]
+        masked = jnp.where(arg.mask(jnp.bool_), scores, seq_ops.NEG_INF)
+        _, idx = jax.lax.top_k(masked, self.beam_size)
+        return Argument(idx)
+
+
+@LAYERS.register("sub_seq")
+class SubSeq(Layer):
+    """SubSequenceLayer: per-row [offset, size) windows from companion
+    integer inputs."""
+
+    type_name = "sub_seq"
+
+    def __init__(self, input: Layer, offsets: Layer, sizes: Layer, name=None):
+        super().__init__([input, offsets, sizes], name=name)
+
+    def forward(self, ctx, ins):
+        arg, off_arg, size_arg = ins
+        x = arg.value
+        b, t = x.shape[:2]
+        offsets = off_arg.value.reshape(-1).astype(jnp.int32)
+        sizes = size_arg.value.reshape(-1).astype(jnp.int32)
+        idx = offsets[:, None] + jnp.arange(t)[None, :]
+        idx = jnp.minimum(idx, t - 1)
+        out = jnp.take_along_axis(x, idx.reshape(b, t, *([1] * (x.ndim - 2))), axis=1)
+        return Argument(out, jnp.minimum(sizes, t))
+

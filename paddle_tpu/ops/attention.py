@@ -1,0 +1,63 @@
+"""Attention primitives.
+
+The reference composes additive attention from primitive layers
+(simple_attention, trainer_config_helpers/networks.py:1304: fc + expand +
+addto + tanh + fc(1) + sequence softmax + scaling + pooling). Here they are
+fused ops; dot-product attention is also provided (the building block the
+ring-attention sequence parallelism in paddle_tpu/parallel uses)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import linalg
+from paddle_tpu.ops import sequence as seq_ops
+
+Array = jax.Array
+
+
+def additive_scores(
+    enc_proj: Array,  # [B, T, A] — W_e @ encoder states (precomputed)
+    dec_state: Array,  # [B, H]
+    w_dec: Array,  # [H, A]
+    v: Array,  # [A]
+) -> Array:
+    """Bahdanau scores: v^T tanh(enc_proj + W_d s) → [B, T]."""
+    q = linalg.matmul(dec_state, w_dec)  # [B, A]
+    e = jnp.tanh(enc_proj + q[:, None, :])
+    return jnp.einsum("bta,a->bt", e, v)
+
+
+def additive_attention(
+    enc: Array,  # [B, T, D] encoder states
+    enc_proj: Array,  # [B, T, A]
+    dec_state: Array,  # [B, H]
+    w_dec: Array,
+    v: Array,
+    lengths: Array,
+) -> Tuple[Array, Array]:
+    """→ (context [B, D], weights [B, T]); masked sequence softmax."""
+    scores = additive_scores(enc_proj, dec_state, w_dec, v)
+    weights = seq_ops.seq_softmax(scores, lengths)
+    context = jnp.einsum("btd,bt->bd", enc, weights.astype(enc.dtype))
+    return context, weights
+
+
+def dot_product_attention(
+    q: Array,  # [B, Tq, D]
+    k: Array,  # [B, Tk, D]
+    v: Array,  # [B, Tk, Dv]
+    mask: Optional[Array] = None,  # [B, Tq, Tk] or [B, 1, Tk]
+    scale: Optional[float] = None,
+) -> Array:
+    """Scaled dot-product attention → [B, Tq, Dv]."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    if mask is not None:
+        logits = jnp.where(mask.astype(jnp.bool_), logits, seq_ops.NEG_INF)
+    w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bqk,bkv->bqv", w, v)

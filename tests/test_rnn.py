@@ -1,0 +1,130 @@
+"""RNN op tests: scan implementations vs per-example numpy step loops — the
+analog of gserver/tests/test_RecurrentLayer.cpp and test_LayerGrad LSTM/GRU
+cases (CPU oracle idiom, SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import rnn as rnn_ops
+from paddle_tpu.ops import sequence as seq_ops
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(proj, lengths, w_hh, bias, peep=None):
+    b, t, h4 = proj.shape
+    h = h4 // 4
+    hs = np.zeros((b, t, h), np.float32)
+    for i in range(b):
+        hv = np.zeros(h, np.float32)
+        cv = np.zeros(h, np.float32)
+        for s in range(lengths[i]):
+            g = proj[i, s] + hv @ w_hh + bias
+            gi, gf, gc, go = np.split(g, 4)
+            if peep is not None:
+                gi = gi + cv * peep[0]
+                gf = gf + cv * peep[1]
+            i_g = _sigmoid(gi)
+            f_g = _sigmoid(gf)
+            cand = np.tanh(gc)
+            cv = f_g * cv + i_g * cand
+            if peep is not None:
+                go = go + cv * peep[2]
+            o_g = _sigmoid(go)
+            hv = o_g * np.tanh(cv)
+            hs[i, s] = hv
+    return hs
+
+
+def _np_gru(proj, lengths, w_hzr, w_hc, bias):
+    b, t, h3 = proj.shape
+    h = h3 // 3
+    hs = np.zeros((b, t, h), np.float32)
+    for i in range(b):
+        hv = np.zeros(h, np.float32)
+        for s in range(lengths[i]):
+            pz, pr, pc = np.split(proj[i, s] + bias, 3)
+            rz = hv @ w_hzr
+            z = _sigmoid(pz + rz[:h])
+            r = _sigmoid(pr + rz[h:])
+            c = np.tanh(pc + (r * hv) @ w_hc)
+            hv = (1 - z) * hv + z * c
+            hs[i, s] = hv
+    return hs
+
+
+@pytest.mark.parametrize("peephole", [False, True])
+def test_lstm_scan_vs_numpy(np_rng, peephole):
+    b, t, h = 3, 6, 5
+    proj = np_rng.randn(b, t, 4 * h).astype(np.float32)
+    lengths = np.array([4, 6, 1], np.int32)
+    w_hh = (np_rng.randn(h, 4 * h) * 0.3).astype(np.float32)
+    bias = np_rng.randn(4 * h).astype(np.float32) * 0.1
+    peep = None
+    checks = (None, None, None)
+    if peephole:
+        peep = [np_rng.randn(h).astype(np.float32) * 0.2 for _ in range(3)]
+        checks = tuple(jnp.asarray(p) for p in peep)
+    p = rnn_ops.LstmParams(jnp.asarray(w_hh), jnp.asarray(bias), *checks)
+    mask = seq_ops.mask_from_lengths(jnp.asarray(lengths), t)
+    hs, h_last, c_last = rnn_ops.lstm_scan(jnp.asarray(proj), mask, p)
+    want = _np_lstm(proj, lengths, w_hh, bias, peep)
+    np.testing.assert_allclose(np.asarray(hs) * np.asarray(mask)[:, :, None], want, rtol=2e-5, atol=2e-5)
+    # final state equals state at each row's last valid step
+    for i in range(b):
+        np.testing.assert_allclose(np.asarray(h_last)[i], want[i, lengths[i] - 1], rtol=2e-5, atol=2e-5)
+
+
+def test_gru_scan_vs_numpy(np_rng):
+    b, t, h = 2, 5, 4
+    proj = np_rng.randn(b, t, 3 * h).astype(np.float32)
+    lengths = np.array([5, 3], np.int32)
+    w_hzr = (np_rng.randn(h, 2 * h) * 0.3).astype(np.float32)
+    w_hc = (np_rng.randn(h, h) * 0.3).astype(np.float32)
+    bias = np_rng.randn(3 * h).astype(np.float32) * 0.1
+    p = rnn_ops.GruParams(jnp.asarray(w_hzr), jnp.asarray(w_hc), jnp.asarray(bias))
+    mask = seq_ops.mask_from_lengths(jnp.asarray(lengths), t)
+    hs, h_last = rnn_ops.gru_scan(jnp.asarray(proj), mask, p)
+    want = _np_gru(proj, lengths, w_hzr, w_hc, bias)
+    np.testing.assert_allclose(np.asarray(hs) * np.asarray(mask)[:, :, None], want, rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_reverse_matches_flipped(np_rng):
+    b, t, h = 2, 4, 3
+    proj = np_rng.randn(b, t, 4 * h).astype(np.float32)
+    lengths = np.full((b,), t, np.int32)  # full-length → reverse == flip
+    w_hh = (np_rng.randn(h, 4 * h) * 0.3).astype(np.float32)
+    bias = np.zeros(4 * h, np.float32)
+    p = rnn_ops.LstmParams(jnp.asarray(w_hh), jnp.asarray(bias))
+    mask = seq_ops.mask_from_lengths(jnp.asarray(lengths), t)
+    hs_rev, _, _ = rnn_ops.lstm_scan(jnp.asarray(proj), mask, p, reverse=True)
+    hs_flip, _, _ = rnn_ops.lstm_scan(jnp.asarray(proj[:, ::-1]), mask, p)
+    np.testing.assert_allclose(np.asarray(hs_rev), np.asarray(hs_flip)[:, ::-1], rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_grad_flows(np_rng):
+    # numeric vs analytic gradient through the scan (LayerGradUtil analog)
+    b, t, h = 2, 3, 3
+    proj = jnp.asarray(np_rng.randn(b, t, 4 * h).astype(np.float32) * 0.5)
+    lengths = jnp.asarray([3, 2], dtype=jnp.int32)
+    mask = seq_ops.mask_from_lengths(lengths, t)
+    w0 = np_rng.randn(h, 4 * h).astype(np.float32) * 0.3
+
+    def loss(w_hh):
+        p = rnn_ops.LstmParams(w_hh, jnp.zeros(4 * h))
+        hs, h_last, _ = rnn_ops.lstm_scan(proj, mask, p)
+        return jnp.sum(h_last**2)
+
+    g = jax.grad(loss)(jnp.asarray(w0))
+    eps = 1e-3
+    for idx in [(0, 0), (2, 5), (1, 11)]:
+        wp = w0.copy()
+        wp[idx] += eps
+        wm = w0.copy()
+        wm[idx] -= eps
+        num = (float(loss(jnp.asarray(wp))) - float(loss(jnp.asarray(wm)))) / (2 * eps)
+        assert abs(num - float(g[idx])) < 5e-3 * max(1.0, abs(num))

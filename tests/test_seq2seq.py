@@ -1,0 +1,142 @@
+"""Sequence layers + seq2seq: copy-task convergence and beam-search decode —
+the analog of test_recurrent_machine_generation.cpp (golden generation) done
+as a learnable toy task."""
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.data import DataFeeder, InputSpec, integer_value_sequence
+from paddle_tpu.data import reader as rd
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.graph import Argument, Network, reset_name_scope
+from paddle_tpu.nn.seq_layers import Expand, FirstSeq, LastSeq, SeqPool, SeqReshape, SeqSlice
+from paddle_tpu.optim import Adam
+from paddle_tpu.trainer import SGDTrainer
+from paddle_tpu.models import Seq2SeqModel, text_lstm
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    reset_name_scope()
+
+
+def test_seq_layers_shapes(np_rng):
+    ids = L.Data("x", shape=(7,), is_seq=True)
+    emb = L.Embedding(ids, 6, vocab_size=7)
+    pool = SeqPool(emb, "average")
+    last = LastSeq(emb)
+    first = FirstSeq(emb)
+    exp = Expand(last, emb)
+    net = Network([pool, last, first, exp])
+    batch = {
+        "x": np_rng.randint(0, 7, (3, 5)),
+        "x.lengths": np.array([2, 5, 3], np.int32),
+    }
+    params, states = net.init(jax.random.PRNGKey(0), batch)
+    outs, _ = net.apply(params, states, batch)
+    assert outs[pool.name].value.shape == (3, 6)
+    assert outs[exp.name].value.shape == (3, 5, 6)
+    # expand broadcasts the last state across time
+    np.testing.assert_allclose(
+        np.asarray(outs[exp.name].value[:, 0]), np.asarray(outs[last.name].value)
+    )
+
+
+def test_seq_slice_last(np_rng):
+    x = np_rng.randn(2, 6, 3).astype(np.float32)
+    lengths = np.array([4, 6], np.int32)
+    ids = L.Data("x", shape=(3,), is_seq=True)
+    sl = SeqSlice(ids, 2, from_start=False)
+    net = Network(sl)
+    params, states = net.init(jax.random.PRNGKey(0), {"x": x, "x.lengths": lengths})
+    outs, _ = net.apply(params, states, {"x": x, "x.lengths": lengths})
+    got = np.asarray(outs[sl.name].value)
+    np.testing.assert_allclose(got[0], x[0, 2:4])
+    np.testing.assert_allclose(got[1], x[1, 4:6])
+
+
+def test_text_lstm_trains():
+    vocab, classes = 50, 2
+    rs = np.random.RandomState(0)
+    samples = []
+    for i in range(96):
+        y = i % 2
+        # class determined by presence of token 7 vs 13
+        length = rs.randint(3, 10)
+        seq = rs.randint(20, vocab, size=length).tolist()
+        seq[rs.randint(length)] = 7 if y else 13
+        samples.append({"word_ids": seq, "label": y})
+
+    def reader():
+        yield from samples
+
+    ids, label, logits, cost = text_lstm(
+        vocab_size=vocab, embed_dim=16, hidden_dim=24, num_layers=1, num_classes=classes
+    )
+    trainer = SGDTrainer(cost, Adam(learning_rate=0.01))
+    feeder = DataFeeder(
+        {
+            "word_ids": InputSpec("index_seq", vocab, seq_bucket=[10]),
+            "label": InputSpec("index", classes, np.int32),
+        }
+    )
+    trainer.train(rd.batch(reader, 32, drop_last=True), num_passes=10, feeder=feeder)
+    res = trainer.test(rd.batch(reader, 32, drop_last=True), feeder)
+    assert res["cost"] < 0.3, res
+
+
+def test_seq2seq_copy_task_and_beam_search():
+    # learn to copy a short token sequence; beam search must reproduce it
+    vocab = 12  # 0=BOS 1=EOS 2..11 payload
+    rs = np.random.RandomState(1)
+    samples = []
+    for _ in range(160):
+        n = rs.randint(2, 5)
+        toks = rs.randint(2, vocab, size=n).tolist()
+        samples.append(
+            {
+                "source_ids": toks,
+                "target_ids": [0] + toks,  # BOS + shifted
+                "label_ids": toks + [1],  # tokens + EOS
+            }
+        )
+
+    def reader():
+        yield from samples
+
+    model = Seq2SeqModel(vocab, vocab, embed_dim=24, hidden_dim=32)
+    trainer = SGDTrainer(model.cost, Adam(learning_rate=0.01), seed=0)
+    feeder = DataFeeder(
+        {
+            "source_ids": InputSpec("index_seq", vocab, seq_bucket=[8]),
+            "target_ids": InputSpec("index_seq", vocab, seq_bucket=[8]),
+            "label_ids": InputSpec("index_seq", vocab, seq_bucket=[8]),
+        }
+    )
+    trainer.train(rd.batch(reader, 32, drop_last=True), num_passes=30, feeder=feeder)
+    res = trainer.test(rd.batch(reader, 32, drop_last=True), feeder)
+    assert res["cost"] < 0.35, res
+
+    gen = model.build_generator(beam_size=3, max_len=8)
+    src = np.zeros((4, 8), np.int32)
+    want = []
+    for i, s in enumerate(samples[:4]):
+        toks = s["source_ids"]
+        src[i, : len(toks)] = toks
+        want.append(toks + [1])
+    lengths = np.array([len(s["source_ids"]) for s in samples[:4]], np.int32)
+    seqs, scores = gen(
+        trainer.state["params"], trainer.state["states"], src, lengths
+    )
+    seqs = np.asarray(seqs)
+    ok = 0
+    for i in range(4):
+        top = seqs[i, 0].tolist()
+        if 1 in top:
+            top = top[: top.index(1) + 1]
+        if top == want[i]:
+            ok += 1
+    assert ok >= 3, f"beam search reproduced {ok}/4: {seqs[:, 0]} vs {want}"
+    # beams are sorted best-first
+    assert np.all(np.diff(np.asarray(scores), axis=1) <= 1e-6)
