@@ -307,3 +307,83 @@ class ChunkEvaluator(Evaluator):
         prec = self.correct / max(self.n_pred, 1e-12)
         rec = self.correct / max(self.n_label, 1e-12)
         return 2 * prec * rec / max(prec + rec, 1e-12)
+
+
+def _edit_distance(a, b) -> int:
+    """Levenshtein distance between two id sequences (host-side numpy DP —
+    same role as CTCErrorEvaluator.cpp's per-pair editDistance)."""
+    la, lb = len(a), len(b)
+    if la == 0:
+        return lb
+    if lb == 0:
+        return la
+    prev = np.arange(lb + 1)
+    for i in range(1, la + 1):
+        cur = np.empty(lb + 1, dtype=np.int64)
+        cur[0] = i
+        for j in range(1, lb + 1):
+            cur[j] = min(
+                prev[j] + 1,
+                cur[j - 1] + 1,
+                prev[j - 1] + (0 if a[i - 1] == b[j - 1] else 1),
+            )
+        prev = cur
+    return int(prev[lb])
+
+
+@EVALUATORS.register("ctc_edit_distance", "ctc_error")
+class CTCErrorEvaluator(Evaluator):
+    """Sequence error rate of the CTC best path vs the gold label sequence
+    (CTCErrorEvaluator.cpp): sum(edit_distance) / sum(label_len).
+
+    update() takes either pre-decoded ids (`decoded`, -1-padded, from
+    ops.ctc.ctc_greedy_decode) or raw `output` logits [B, T, C] which are
+    greedy-decoded on host."""
+
+    def start(self):
+        self.total_dist = 0
+        self.total_len = 0
+
+    def update(
+        self,
+        label=None,
+        label_lengths=None,
+        decoded=None,
+        output=None,
+        lengths=None,
+        blank=0,
+        **kw,
+    ):
+        lab = np.asarray(label)
+        lab_lens = (
+            np.asarray(label_lengths)
+            if label_lengths is not None
+            else np.full(lab.shape[0], lab.shape[1])
+        )
+        if decoded is None:
+            logits = np.asarray(output)
+            lens = (
+                np.asarray(lengths)
+                if lengths is not None
+                else np.full(logits.shape[0], logits.shape[1])
+            )
+            rows = []
+            for i in range(logits.shape[0]):
+                ids = logits[i, : lens[i]].argmax(-1)
+                if len(ids) == 0:
+                    rows.append(ids)
+                    continue
+                keep = np.concatenate([[True], ids[1:] != ids[:-1]])
+                ids = ids[keep]
+                rows.append(ids[ids != blank])
+            dec_rows = rows
+        else:
+            dec = np.asarray(decoded)
+            dec_rows = [row[row >= 0] for row in dec]
+        for i, d in enumerate(dec_rows):
+            g = lab[i, : lab_lens[i]]
+            self.total_dist += _edit_distance(list(d), list(g))
+            self.total_len += len(g)
+
+    def finish(self):
+        return self.total_dist / max(self.total_len, 1e-12)

@@ -951,3 +951,69 @@ class TensorLayer(Layer):
         out = jnp.einsum("bi,kij,bj->bk", x, w, y)
         out = act_mod.apply(self.act, out)
         return ins[0].with_value(out)
+
+
+@LAYERS.register("max_id")
+class MaxId(Layer):
+    """Argmax id of the last axis (MaxIdLayer.cpp); beam_size > 1 → top-k ids,
+    matching the reference's beam output for generation."""
+
+    type_name = "max_id"
+
+    def __init__(self, input: Layer, beam_size: int = 1, name=None):
+        super().__init__(input, name=name)
+        self.beam_size = beam_size
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        if self.beam_size <= 1:
+            out = jnp.argmax(x, axis=-1)
+        else:
+            out = jax.lax.top_k(x, self.beam_size)[1]
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("sampling_id")
+class SamplingId(Layer):
+    """Sample an id from each row's probability distribution
+    (SamplingIdLayer.cpp). Needs an rng in the apply context."""
+
+    type_name = "sampling_id"
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        logits = jnp.log(jnp.maximum(x, 1e-30))
+        ids = jax.random.categorical(ctx.next_rng(self.name), logits, axis=-1)
+        return ins[0].with_value(ids)
+
+
+@LAYERS.register("eos_id")
+class EosIdCheck(Layer):
+    """1 where the input id equals eos_id (EosIdCheckLayer.cpp)."""
+
+    type_name = "eos_id"
+
+    def __init__(self, input: Layer, eos_id: int, name=None):
+        super().__init__(input, name=name)
+        self.eos_id = eos_id
+
+    def forward(self, ctx, ins):
+        return ins[0].with_value(
+            (ins[0].value == self.eos_id).astype(jnp.float32)
+        )
+
+
+@LAYERS.register("print")
+class PrintLayer(Layer):
+    """Debug-print its input during tracing/execution (PrintLayer.cpp) via
+    jax.debug.print; passes the value through unchanged."""
+
+    type_name = "print"
+
+    def __init__(self, input: Layer, message: str = "", name=None):
+        super().__init__(input, name=name)
+        self.message = message
+
+    def forward(self, ctx, ins):
+        jax.debug.print((self.message + " {x}").lstrip(), x=ins[0].value)
+        return ins[0]

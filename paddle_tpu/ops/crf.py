@@ -1,0 +1,116 @@
+"""Linear-chain CRF: log-likelihood + Viterbi decode, scan-based.
+
+Parity with paddle/gserver/layers/LinearChainCRF.cpp (forward/backward over
+per-sequence emissions with start/end/transition weights packed into one
+(C+2, C) parameter — row 0 = start weights a, row 1 = end weights b, rows
+2.. = transition matrix w[from, to]) and CRFDecodingLayer.cpp (Viterbi).
+
+TPU shift: the reference runs per-sequence variable-length DPs on CPU; here
+both the partition function and Viterbi are single `lax.scan`s over the padded
+time axis with length masks, batched over [B], so they compile into the
+training step and vectorize on the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+
+def _unpack(w: Array) -> Tuple[Array, Array, Array]:
+    """(C+2, C) packed weights → (start[C], end[C], trans[C, C])."""
+    return w[0], w[1], w[2:]
+
+
+def crf_nll(
+    emissions: Array, lengths: Array, labels: Array, w: Array
+) -> Array:
+    """Per-example negative log-likelihood.
+
+    emissions: [B, T, C] unnormalized scores (the CRF input layer's output).
+    lengths:   [B] valid timesteps.
+    labels:    [B, T] gold tag ids (padding ignored).
+    w:         [C+2, C] packed start/end/transition weights.
+    """
+    a, b_w, trans = _unpack(w)
+    bsz, t, c = emissions.shape
+    emissions = emissions.astype(jnp.float32)
+    steps = jnp.arange(t)
+
+    # --- gold path score ---------------------------------------------------
+    lab_emit = jnp.take_along_axis(emissions, labels[:, :, None], axis=2)[..., 0]
+    valid = steps[None, :] < lengths[:, None]
+    emit_score = jnp.sum(jnp.where(valid, lab_emit, 0.0), axis=1)
+
+    prev_lab = labels[:, :-1]
+    next_lab = labels[:, 1:]
+    trans_steps = trans[prev_lab, next_lab]  # [B, T-1]
+    tvalid = steps[None, 1:] < lengths[:, None]
+    trans_score = jnp.sum(jnp.where(tvalid, trans_steps, 0.0), axis=1)
+
+    first_lab = labels[:, 0]
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_lab = jnp.take_along_axis(labels, last_idx[:, None], axis=1)[:, 0]
+    path = emit_score + trans_score + a[first_lab] + b_w[last_lab]
+
+    # --- partition function (forward algorithm) ----------------------------
+    alpha0 = a[None, :] + emissions[:, 0]  # [B, C]
+
+    def step(alpha, inputs):
+        emit_t, t_i = inputs
+        # alpha[:, i] + trans[i, j] → logsumexp over i
+        scores = alpha[:, :, None] + trans[None, :, :]
+        new = jax.scipy.special.logsumexp(scores, axis=1) + emit_t
+        active = (t_i < lengths)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(
+        step, alpha0, (jnp.swapaxes(emissions, 0, 1)[1:], jnp.arange(1, t))
+    )
+    log_z = jax.scipy.special.logsumexp(alpha + b_w[None, :], axis=1)
+    return log_z - path
+
+
+def crf_decode(emissions: Array, lengths: Array, w: Array) -> Array:
+    """Viterbi decode → [B, T] best tag ids (entries past `lengths` are the
+    frozen last tag; mask with lengths downstream). CRFDecodingLayer parity."""
+    a, b_w, trans = _unpack(w)
+    bsz, t, c = emissions.shape
+    emissions = emissions.astype(jnp.float32)
+
+    delta0 = a[None, :] + emissions[:, 0]
+
+    def fwd(delta, inputs):
+        emit_t, t_i = inputs
+        scores = delta[:, :, None] + trans[None, :, :]  # [B, from, to]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, C]
+        new = jnp.max(scores, axis=1) + emit_t
+        active = (t_i < lengths)[:, None]
+        new = jnp.where(active, new, delta)
+        # frozen frames point back at themselves so backtrace passes through
+        best_prev = jnp.where(
+            active, best_prev, jnp.arange(c)[None, :].astype(best_prev.dtype)
+        )
+        return new, best_prev
+
+    delta, backptrs = jax.lax.scan(
+        fwd, delta0, (jnp.swapaxes(emissions, 0, 1)[1:], jnp.arange(1, t))
+    )  # backptrs: [T-1, B, C]
+
+    last = jnp.argmax(delta + b_w[None, :], axis=1)  # [B]
+
+    def back(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first, tags_rev = jax.lax.scan(back, last, backptrs, reverse=True)
+    tags = jnp.concatenate(
+        [first[None, :], tags_rev], axis=0
+    )  # [T, B]
+    return jnp.swapaxes(tags, 0, 1)

@@ -1,0 +1,147 @@
+"""Connectionist Temporal Classification, scan-based and jit-friendly.
+
+TPU-native replacement for the reference's CTC pair:
+  - paddle/gserver/layers/LinearChainCTC.cpp (exact alpha/beta DP on CPU)
+  - paddle/cuda/src/hl_warpctc_wrap.cc (warp-ctc dlopen shim)
+
+Design: one `lax.scan` over time carrying log-alpha over the blank-extended
+label sequence [2L+1]. Static shapes (padded labels + length masks) so the
+whole loss compiles into the training step; the backward pass is jax.grad of
+this forward — no hand-written beta recursion needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+
+def _logadd(a: Array, b: Array) -> Array:
+    # NaN-safe under jax.grad: when both inputs are ~-inf the sum of exps is 0
+    # and log() would emit -inf with a NaN cotangent that jnp.where cannot
+    # stop; clamping the sum keeps the dead branch finite (exact otherwise,
+    # since the finite branch's sum is >= 1).
+    mx = jnp.maximum(a, b)
+    mx_safe = jnp.where(mx <= _NEG_INF, 0.0, mx)
+    ssum = jnp.exp(a - mx_safe) + jnp.exp(b - mx_safe)
+    out = mx_safe + jnp.log(jnp.maximum(ssum, 1e-30))
+    return jnp.where(mx <= _NEG_INF, _NEG_INF, out)
+
+
+def ctc_loss(
+    logits: Array,
+    logit_lengths: Array,
+    labels: Array,
+    label_lengths: Array,
+    blank: int = 0,
+    norm_by_times: bool = False,
+) -> Array:
+    """Per-example negative log-likelihood of the label sequences.
+
+    logits:         [B, T, C] unnormalized scores.
+    logit_lengths:  [B] valid frames per example.
+    labels:         [B, L] int labels padded with anything (masked by lengths).
+    label_lengths:  [B] valid labels per example.
+    blank:          blank id (the reference fixes blank=0 in CTCLayer.cpp).
+    norm_by_times:  divide each example's NLL by its frame count
+                    (WarpCTCLayer `norm_by_times` config).
+    """
+    b, t, c = logits.shape
+    l = labels.shape[1]
+    s = 2 * l + 1
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # Blank-extended label row per example: [blank, y1, blank, y2, ..., blank]
+    ext = jnp.full((b, s), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+
+    idx = jnp.arange(s)[None, :]
+    valid_s = idx < (2 * label_lengths[:, None] + 1)
+
+    # skip transition s-2 -> s allowed when ext[s] is a label differing from ext[s-2]
+    ext_shift2 = jnp.concatenate(
+        [jnp.full((b, 2), -1, dtype=ext.dtype), ext[:, :-2]], axis=1
+    )
+    can_skip = (idx % 2 == 1) & (ext != ext_shift2)
+
+    # emission log-probs gathered per extended symbol: [B, T, S]
+    emit = jnp.take_along_axis(
+        logp, ext[:, None, :].astype(jnp.int32), axis=2
+    )
+
+    alpha0 = jnp.full((b, s), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(emit[:, 0, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_lengths > 0, emit[:, 0, 1], _NEG_INF)
+    )
+    alpha0 = jnp.where(valid_s, alpha0, _NEG_INF)
+
+    def step(alpha, inputs):
+        emit_t, t_i = inputs
+        prev1 = jnp.concatenate(
+            [jnp.full((b, 1), _NEG_INF), alpha[:, :-1]], axis=1
+        )
+        prev2 = jnp.concatenate(
+            [jnp.full((b, 2), _NEG_INF), alpha[:, :-2]], axis=1
+        )
+        acc = _logadd(alpha, prev1)
+        acc = _logadd(acc, jnp.where(can_skip, prev2, _NEG_INF))
+        new = jnp.where(valid_s, acc + emit_t, _NEG_INF)
+        # frozen past each example's final frame so the end-read is stable
+        active = (t_i < logit_lengths)[:, None]
+        new = jnp.where(active, new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(
+        step,
+        alpha0,
+        (jnp.swapaxes(emit, 0, 1)[1:], jnp.arange(1, t)),
+    )
+
+    end = 2 * label_lengths  # final blank position
+    a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_last_label = jnp.where(
+        label_lengths > 0,
+        jnp.take_along_axis(
+            alpha, jnp.maximum(end - 1, 0)[:, None], axis=1
+        )[:, 0],
+        _NEG_INF,
+    )
+    nll = -_logadd(a_end, a_last_label)
+    if norm_by_times:
+        nll = nll / jnp.maximum(logit_lengths.astype(nll.dtype), 1.0)
+    return nll
+
+
+def ctc_greedy_decode(
+    logits: Array, logit_lengths: Array, blank: int = 0
+) -> Array:
+    """Best-path decode: argmax per frame, collapse repeats, drop blanks.
+
+    Returns [B, T] decoded ids padded with -1 (left-packed), for the
+    ctc_error evaluator (CTCErrorEvaluator.cpp computes edit distance on the
+    best path)."""
+    ids = jnp.argmax(logits, axis=-1)  # [B, T]
+    t = ids.shape[1]
+    valid = jnp.arange(t)[None, :] < logit_lengths[:, None]
+    prev = jnp.concatenate(
+        [jnp.full_like(ids[:, :1], -1), ids[:, :-1]], axis=1
+    )
+    keep = valid & (ids != blank) & (ids != prev)
+
+    # left-pack kept ids with a cumsum-scatter (static-shape friendly);
+    # dropped slots route to an out-of-range index
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full_like(ids, -1)
+    safe_pos = jnp.where(keep, pos, t)
+    out = jax.vmap(
+        lambda o, i, p, k: o.at[p].set(jnp.where(k, i, -1), mode="drop")
+    )(out, ids, safe_pos, keep)
+    return out
