@@ -387,3 +387,94 @@ class CTCErrorEvaluator(Evaluator):
 
     def finish(self):
         return self.total_dist / max(self.total_len, 1e-12)
+
+
+@EVALUATORS.register("detection_map")
+class DetectionMAPEvaluator(Evaluator):
+    """Mean average precision over detection outputs
+    (DetectionMAPEvaluator.cpp): accumulates per-class scored TP/FP marks
+    across batches, then AP per class by 11-point or integral rule.
+
+    update(detections=[B, K, 6] rows (label, score, xmin, ymin, xmax, ymax;
+    score==0 padding), gt_boxes=[B, G, 4], gt_labels=[B, G],
+    gt_lengths=[B]) — the padded-tensor form of the reference's sequence
+    label input."""
+
+    def __init__(self, overlap_threshold=0.5, ap_type="11point", background_id=0):
+        self.overlap_threshold = overlap_threshold
+        self.ap_type = ap_type
+        self.background_id = background_id
+
+    def start(self):
+        self.marks = {}  # class -> list of (score, is_tp)
+        self.n_gt = {}  # class -> count
+
+    @staticmethod
+    def _iou(a, b):
+        lt = np.maximum(a[:2], b[:2])
+        rb = np.minimum(a[2:], b[2:])
+        wh = np.maximum(rb - lt, 0.0)
+        inter = wh[0] * wh[1]
+        ua = max(a[2] - a[0], 0) * max(a[3] - a[1], 0)
+        ub = max(b[2] - b[0], 0) * max(b[3] - b[1], 0)
+        return inter / max(ua + ub - inter, 1e-12)
+
+    def update(self, detections=None, gt_boxes=None, gt_labels=None, gt_lengths=None, **kw):
+        det = np.asarray(detections)
+        gtb = np.asarray(gt_boxes)
+        gtl = np.asarray(gt_labels)
+        lens = (
+            np.asarray(gt_lengths)
+            if gt_lengths is not None
+            else np.full(gtb.shape[0], gtb.shape[1])
+        )
+        for i in range(det.shape[0]):
+            gts = gtb[i, : lens[i]]
+            gls = gtl[i, : lens[i]]
+            for c in np.unique(gls):
+                if c == self.background_id:
+                    continue
+                self.n_gt[int(c)] = self.n_gt.get(int(c), 0) + int((gls == c).sum())
+            used = np.zeros(len(gts), bool)
+            rows = det[i]
+            rows = rows[rows[:, 1] > 0]
+            rows = rows[np.argsort(-rows[:, 1])]
+            for row in rows:
+                c, score, box = int(row[0]), float(row[1]), row[2:6]
+                cand = np.where((gls == c) & ~used)[0]
+                best_j, best_iou = -1, self.overlap_threshold
+                for j in cand:
+                    v = self._iou(box, gts[j])
+                    if v >= best_iou:
+                        best_j, best_iou = j, v
+                tp = best_j >= 0
+                if tp:
+                    used[best_j] = True
+                self.marks.setdefault(c, []).append((score, tp))
+
+    def finish(self):
+        aps = []
+        for c, n_pos in self.n_gt.items():
+            marks = sorted(self.marks.get(c, []), key=lambda t: -t[0])
+            if n_pos == 0:
+                continue
+            if not marks:
+                aps.append(0.0)
+                continue
+            tps = np.cumsum([m[1] for m in marks])
+            fps = np.cumsum([not m[1] for m in marks])
+            recall = tps / n_pos
+            precision = tps / np.maximum(tps + fps, 1e-12)
+            if self.ap_type == "11point":
+                ap = 0.0
+                for r in np.linspace(0, 1, 11):
+                    p = precision[recall >= r].max() if (recall >= r).any() else 0.0
+                    ap += p / 11.0
+            else:  # integral
+                ap = 0.0
+                prev_r = 0.0
+                for k in range(len(marks)):
+                    ap += precision[k] * (recall[k] - prev_r)
+                    prev_r = recall[k]
+            aps.append(float(ap))
+        return float(np.mean(aps)) if aps else 0.0

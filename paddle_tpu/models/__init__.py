@@ -9,3 +9,4 @@ from paddle_tpu.models.alexnet import alexnet  # noqa: F401
 from paddle_tpu.models.googlenet import googlenet  # noqa: F401
 from paddle_tpu.models.seq2seq import seq2seq, Seq2SeqModel  # noqa: F401
 from paddle_tpu.models.text_lstm import text_lstm  # noqa: F401
+from paddle_tpu.models.ssd import ssd  # noqa: F401

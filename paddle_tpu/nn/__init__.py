@@ -10,6 +10,7 @@ from paddle_tpu.nn import activations as activations  # noqa: F401
 from paddle_tpu.nn import layers as layers  # noqa: F401
 from paddle_tpu.nn import costs as costs  # noqa: F401
 from paddle_tpu.nn import struct_costs as struct_costs  # noqa: F401
+from paddle_tpu.nn import detection_layers as detection_layers  # noqa: F401
 from paddle_tpu.nn import recurrent as recurrent  # noqa: F401
 from paddle_tpu.nn import seq_layers as seq_layers  # noqa: F401
 from paddle_tpu.nn import attention_layers as attention_layers  # noqa: F401

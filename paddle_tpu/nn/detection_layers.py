@@ -1,0 +1,207 @@
+"""SSD detection layers: PriorBox, MultiBoxLoss, DetectionOutput.
+
+Parity with paddle/gserver/layers/{PriorBox,MultiBoxLossLayer,
+DetectionOutputLayer}.cpp. The reference's multi-input wiring (N loc conv
+outputs + N conf conv outputs + priorbox layers, appendWithPermute) becomes:
+each PriorBox binds to its conv feature layer; MultiBoxLoss/DetectionOutput
+take lists of (loc, conf, priorbox) triples and concatenate along the prior
+axis inside the traced step.
+
+Ground truth feeds as padded tensors: 'gt_boxes' [B, G, 4] (normalized
+corners), 'gt_labels' [B, G], 'gt_valid'/lengths mask — replacing the
+reference's sequence-encoded label data (getBBoxFromLabelData's
+class/xmin/ymin/xmax/ymax/difficult rows)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.nn.graph import Argument, Context, Layer
+from paddle_tpu.ops import detection as det_ops
+
+Array = jax.Array
+
+
+@LAYERS.register("priorbox")
+class PriorBox(Layer):
+    """Anchor generator bound to a conv feature map (PriorBox.cpp). Output is
+    a compile-time-constant [P, 8] array per the reference's layout: 4 box
+    coords + 4 variances, broadcast over the batch."""
+
+    type_name = "priorbox"
+
+    def __init__(
+        self,
+        input: Layer,
+        image_size: Tuple[int, int],
+        min_size: Sequence[float],
+        max_size: Sequence[float] = (),
+        aspect_ratio: Sequence[float] = (2.0,),
+        variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+        clip: bool = True,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.image_size = image_size
+        self.min_size = list(min_size)
+        self.max_size = list(max_size)
+        self.aspect_ratio = list(aspect_ratio)
+        self.variance = list(variance)
+        self.clip = clip
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        feat = ins[0].value  # [B, H, W, C]
+        fh, fw = int(feat.shape[1]), int(feat.shape[2])
+        boxes, var = det_ops.prior_boxes(
+            (fh, fw),
+            self.image_size,
+            self.min_size,
+            self.max_size,
+            self.aspect_ratio,
+            self.variance,
+            self.clip,
+        )
+        packed = jnp.asarray(np.concatenate([boxes, var], axis=1))  # [P, 8]
+        return Argument(packed)
+
+
+def _gather_heads(
+    ins: List[Argument], n: int
+) -> Tuple[Array, Array, Array, Array]:
+    """Split inputs [loc..., conf..., prior...] (n each) and concatenate along
+    the prior axis. loc heads are conv outputs [B, H, W, 4*K] → [B, P, 4];
+    conf heads [B, H, W, C*K] → [B, P, C]."""
+    locs, confs, priors, variances = [], [], [], []
+    for i in range(n):
+        loc = ins[i].value
+        b = loc.shape[0]
+        locs.append(loc.reshape(b, -1, 4))
+    # conf channel count differs; infer per head from prior count
+    for i in range(n):
+        conf = ins[n + i].value
+        b = conf.shape[0]
+        p_i = locs[i].shape[1]
+        confs.append(conf.reshape(b, p_i, -1))
+        packed = ins[2 * n + i].value  # [P_i, 8]
+        priors.append(packed[:, :4])
+        variances.append(packed[:, 4:])
+    return (
+        jnp.concatenate(locs, axis=1),
+        jnp.concatenate(confs, axis=1),
+        jnp.concatenate(priors, axis=0),
+        jnp.concatenate(variances, axis=0),
+    )
+
+
+@LAYERS.register("multibox_loss")
+class MultiBoxLoss(Layer):
+    """SSD training loss (MultiBoxLossLayer.cpp)."""
+
+    type_name = "multibox_loss"
+
+    def __init__(
+        self,
+        loc_layers: Sequence[Layer],
+        conf_layers: Sequence[Layer],
+        priorbox_layers: Sequence[Layer],
+        gt_boxes: Layer,
+        gt_labels: Layer,
+        num_classes: int,
+        overlap_threshold: float = 0.5,
+        neg_pos_ratio: float = 3.0,
+        background_id: int = 0,
+        name: Optional[str] = None,
+    ):
+        loc_layers = list(loc_layers)
+        conf_layers = list(conf_layers)
+        priorbox_layers = list(priorbox_layers)
+        assert len(loc_layers) == len(conf_layers) == len(priorbox_layers)
+        super().__init__(
+            loc_layers + conf_layers + priorbox_layers + [gt_boxes, gt_labels],
+            name=name,
+        )
+        self.n_heads = len(loc_layers)
+        self.num_classes = num_classes
+        self.overlap_threshold = overlap_threshold
+        self.neg_pos_ratio = neg_pos_ratio
+        self.background_id = background_id
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        n = self.n_heads
+        loc, conf, priors, variances = _gather_heads(ins, n)
+        gtb_arg, gtl_arg = ins[3 * n], ins[3 * n + 1]
+        gtb = gtb_arg.value  # [B, G, 4]
+        gtl = gtl_arg.value.astype(jnp.int32)  # [B, G]
+        if gtb_arg.lengths is not None:
+            g = gtb.shape[1]
+            valid = jnp.arange(g)[None, :] < gtb_arg.lengths[:, None]
+        else:
+            # a gt row of all zeros is padding
+            valid = jnp.any(gtb != 0, axis=-1)
+        cost = det_ops.multibox_loss(
+            loc,
+            conf,
+            priors,
+            variances,
+            gtb,
+            gtl,
+            valid,
+            overlap_threshold=self.overlap_threshold,
+            neg_pos_ratio=self.neg_pos_ratio,
+            background_id=self.background_id,
+        )
+        return Argument(jnp.mean(cost))
+
+
+@LAYERS.register("detection_output")
+class DetectionOutput(Layer):
+    """Decode + per-class NMS → [B, keep_top_k, 6] (DetectionOutputLayer.cpp;
+    row = label, score, xmin, ymin, xmax, ymax; score==0 rows are padding)."""
+
+    type_name = "detection_output"
+
+    def __init__(
+        self,
+        loc_layers: Sequence[Layer],
+        conf_layers: Sequence[Layer],
+        priorbox_layers: Sequence[Layer],
+        num_classes: int,
+        background_id: int = 0,
+        nms_threshold: float = 0.45,
+        nms_top_k: int = 400,
+        keep_top_k: int = 200,
+        confidence_threshold: float = 0.01,
+        name: Optional[str] = None,
+    ):
+        loc_layers = list(loc_layers)
+        conf_layers = list(conf_layers)
+        priorbox_layers = list(priorbox_layers)
+        super().__init__(loc_layers + conf_layers + priorbox_layers, name=name)
+        self.n_heads = len(loc_layers)
+        self.num_classes = num_classes
+        self.background_id = background_id
+        self.nms_threshold = nms_threshold
+        self.nms_top_k = nms_top_k
+        self.keep_top_k = keep_top_k
+        self.confidence_threshold = confidence_threshold
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        loc, conf, priors, variances = _gather_heads(ins, self.n_heads)
+        out = det_ops.detection_output(
+            loc,
+            conf,
+            priors,
+            variances,
+            num_classes=self.num_classes,
+            background_id=self.background_id,
+            nms_threshold=self.nms_threshold,
+            nms_top_k=self.nms_top_k,
+            keep_top_k=self.keep_top_k,
+            confidence_threshold=self.confidence_threshold,
+        )
+        return Argument(out)

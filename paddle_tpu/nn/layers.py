@@ -1015,5 +1015,7 @@ class PrintLayer(Layer):
         self.message = message
 
     def forward(self, ctx, ins):
-        jax.debug.print((self.message + " {x}").lstrip(), x=ins[0].value)
+        # escape user braces — only the {x} placeholder is a format field
+        msg = self.message.replace("{", "{{").replace("}", "}}")
+        jax.debug.print((msg + " {x}").lstrip(), x=ins[0].value)
         return ins[0]

@@ -332,7 +332,13 @@ class LambdaCost(Layer):
         )(ranks, order) + 1  # [B, T]
 
         gain = jnp.exp2(g) - 1.0
-        dfac = 1.0 / jnp.log2(1.0 + ranks.astype(jnp.float32))
+        # discounts truncate at ndcg_num so pair weights match NDCG@k — pairs
+        # entirely below the cutoff get zero weight, as in the reference
+        dfac = jnp.where(
+            ranks <= self.ndcg_num,
+            1.0 / jnp.log2(1.0 + ranks.astype(jnp.float32)),
+            0.0,
+        )
         # |ΔNDCG| for swapping i, j
         dndcg = jnp.abs(
             (gain[:, :, None] - gain[:, None, :])

@@ -1,0 +1,347 @@
+"""SSD-style detection ops: prior boxes, matching, multibox loss, NMS, decode.
+
+Parity targets: paddle/gserver/layers/PriorBox.cpp, MultiBoxLossLayer.cpp,
+DetectionOutputLayer.cpp and DetectionUtil.cpp (jaccardOverlap,
+encodeBBoxWithVar/decodeBBoxWithVar, matchBBox/generateMatchIndices, NMS).
+
+TPU shift: the reference walks per-sequence std::vectors of NormalizedBBox on
+the host. Here ground truth is a padded [B, G, 4] tensor + validity mask and
+every stage (IoU matrix, bipartite+threshold matching, hard negative mining,
+NMS) is a fixed-shape batched computation that compiles into the training or
+inference step — matching is an argmax over an IoU matrix instead of loops,
+NMS is a fori_loop over a top-k-sorted prefix.
+
+Boxes are normalized corners (xmin, ymin, xmax, ymax) throughout, like
+NormalizedBBox (DetectionUtil.h:54).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Prior (anchor) box generation — PriorBox.cpp
+# ---------------------------------------------------------------------------
+
+
+def prior_boxes(
+    feature_hw: Tuple[int, int],
+    image_hw: Tuple[int, int],
+    min_sizes: Sequence[float],
+    max_sizes: Sequence[float],
+    aspect_ratios: Sequence[float],
+    variances: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+    clip: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Anchor grid for one feature map → ([P, 4] boxes, [P, 4] variances).
+
+    Per cell, in PriorBoxLayer::forward's order: for each min_size an
+    aspect-1 box, then (if given) the sqrt(min*max) box, then one box per
+    extra aspect ratio (and its reciprocal). Static python/numpy — priors are
+    compile-time constants baked into the XLA program."""
+    fh, fw = feature_hw
+    ih, iw = image_hw
+    ratios = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - r) > 1e-6 for r in ratios):
+            ratios.append(ar)
+        recip = 1.0 / ar
+        if all(abs(recip - r) > 1e-6 for r in ratios):
+            ratios.append(recip)
+
+    boxes = []
+    for y, x in itertools.product(range(fh), range(fw)):
+        cx = (x + 0.5) / fw
+        cy = (y + 0.5) / fh
+        for k, msize in enumerate(min_sizes):
+            # aspect 1, min size
+            bw, bh = msize / iw, msize / ih
+            boxes.append((cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2))
+            if k < len(max_sizes):
+                s = math.sqrt(msize * max_sizes[k])
+                bw, bh = s / iw, s / ih
+                boxes.append(
+                    (cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2)
+                )
+            for ar in ratios:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                bw = msize * math.sqrt(ar) / iw
+                bh = msize / math.sqrt(ar) / ih
+                boxes.append(
+                    (cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2)
+                )
+    out = np.asarray(boxes, np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.asarray(variances, np.float32)[None, :], (out.shape[0], 1))
+    return out, var
+
+
+# ---------------------------------------------------------------------------
+# IoU + box coding — DetectionUtil.cpp jaccardOverlap / encode / decode
+# ---------------------------------------------------------------------------
+
+
+def iou_matrix(a: Array, b: Array) -> Array:
+    """[N, 4] × [M, 4] → [N, M] Jaccard overlap."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0.0) * jnp.maximum(
+        a[:, 3] - a[:, 1], 0.0
+    )
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0.0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0.0
+    )
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+
+
+def _to_center(boxes: Array) -> Tuple[Array, Array, Array, Array]:
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = boxes[..., 0] + w / 2
+    cy = boxes[..., 1] + h / 2
+    return cx, cy, w, h
+
+
+def encode_boxes(priors: Array, variances: Array, gt: Array) -> Array:
+    """Center-form offset targets (encodeBBoxWithVar)."""
+    pcx, pcy, pw, ph = _to_center(priors)
+    gcx, gcy, gw, gh = _to_center(gt)
+    pw = jnp.maximum(pw, 1e-12)
+    ph = jnp.maximum(ph, 1e-12)
+    tx = (gcx - pcx) / pw / variances[..., 0]
+    ty = (gcy - pcy) / ph / variances[..., 1]
+    tw = jnp.log(jnp.maximum(gw / pw, 1e-12)) / variances[..., 2]
+    th = jnp.log(jnp.maximum(gh / ph, 1e-12)) / variances[..., 3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+def decode_boxes(priors: Array, variances: Array, loc: Array) -> Array:
+    """Inverse of encode_boxes (decodeBBoxWithVar)."""
+    pcx, pcy, pw, ph = _to_center(priors)
+    cx = loc[..., 0] * variances[..., 0] * pw + pcx
+    cy = loc[..., 1] * variances[..., 1] * ph + pcy
+    w = jnp.exp(loc[..., 2] * variances[..., 2]) * pw
+    h = jnp.exp(loc[..., 3] * variances[..., 3]) * ph
+    return jnp.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1
+    )
+
+
+# ---------------------------------------------------------------------------
+# Matching — DetectionUtil.cpp matchBBox / generateMatchIndices
+# ---------------------------------------------------------------------------
+
+
+def match_priors(
+    priors: Array,
+    gt_boxes: Array,
+    gt_valid: Array,
+    overlap_threshold: float = 0.5,
+) -> Tuple[Array, Array]:
+    """SSD matching for ONE example.
+
+    priors [P, 4], gt_boxes [G, 4], gt_valid [G] bool.
+    Returns (match_idx [P] int32 — index into gt, -1 unmatched;
+             match_iou [P]).
+    Bipartite stage: each valid gt claims its best prior. Threshold stage:
+    remaining priors take their best gt if IoU > threshold."""
+    p, g = priors.shape[0], gt_boxes.shape[0]
+    iou = iou_matrix(priors, gt_boxes)  # [P, G]
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+
+    # threshold stage
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)  # [P]
+    best_gt_iou = jnp.max(iou, axis=1)
+    match = jnp.where(best_gt_iou > overlap_threshold, best_gt, -1)
+    match_iou = jnp.where(best_gt_iou > overlap_threshold, best_gt_iou, 0.0)
+
+    # bipartite stage overrides (generateMatchIndices): G rounds, each round
+    # the globally-best still-unassigned (prior, gt) pair is locked in, so
+    # every valid gt ends up with a DISTINCT prior even when several gts
+    # share the same favorite.
+    def round_(state, _):
+        match, match_iou, work = state  # work: [P, G] with used rows/cols -inf
+        flat = jnp.argmax(work)
+        p_star = (flat // g).astype(jnp.int32)
+        g_star = (flat % g).astype(jnp.int32)
+        ok = work[p_star, g_star] >= 0.0
+        match = jnp.where(
+            ok, match.at[p_star].set(g_star), match
+        )
+        match_iou = jnp.where(
+            ok, match_iou.at[p_star].set(work[p_star, g_star]), match_iou
+        )
+        work = jnp.where(ok, work.at[p_star, :].set(-jnp.inf), work)
+        work = jnp.where(ok, work.at[:, g_star].set(-jnp.inf), work)
+        return (match, match_iou, work), None
+
+    (match, match_iou, _), _ = jax.lax.scan(
+        round_,
+        (match, match_iou, jnp.where(gt_valid[None, :], iou, -jnp.inf)),
+        None,
+        length=g,
+    )
+    return match, match_iou
+
+
+# ---------------------------------------------------------------------------
+# MultiBox loss — MultiBoxLossLayer.cpp
+# ---------------------------------------------------------------------------
+
+
+def multibox_loss(
+    loc_preds: Array,
+    conf_preds: Array,
+    priors: Array,
+    variances: Array,
+    gt_boxes: Array,
+    gt_labels: Array,
+    gt_valid: Array,
+    overlap_threshold: float = 0.5,
+    neg_pos_ratio: float = 3.0,
+    background_id: int = 0,
+) -> Array:
+    """Batched SSD loss → per-example cost [B].
+
+    loc_preds  [B, P, 4], conf_preds [B, P, C] logits,
+    priors [P, 4], variances [P, 4],
+    gt_boxes [B, G, 4], gt_labels [B, G] (real class ids; background_id
+    reserved), gt_valid [B, G] bool.
+
+    Positives get smooth-L1 on encoded offsets + softmax CE on their class;
+    negatives are hard-mined by conf loss at `neg_pos_ratio`× the positive
+    count (MultiBoxLossLayer's mining, as one sort per example)."""
+
+    def one(loc_p, conf_p, gtb, gtl, gtv):
+        p = priors.shape[0]
+        match, _ = match_priors(priors, gtb, gtv, overlap_threshold)
+        pos = match >= 0
+        n_pos = jnp.sum(pos.astype(jnp.int32))
+
+        safe_match = jnp.maximum(match, 0)
+        matched_gt = gtb[safe_match]  # [P, 4]
+        loc_target = encode_boxes(priors, variances, matched_gt)
+        diff = loc_p - loc_target
+        adiff = jnp.abs(diff)
+        smooth_l1 = jnp.where(adiff < 1.0, 0.5 * diff * diff, adiff - 0.5)
+        loc_loss = jnp.sum(
+            jnp.where(pos[:, None], smooth_l1, 0.0)
+        )
+
+        cls_target = jnp.where(pos, gtl[safe_match], background_id)
+        logp = jax.nn.log_softmax(conf_p, axis=-1)
+        ce = -jnp.take_along_axis(
+            logp, cls_target[:, None].astype(jnp.int32), axis=1
+        )[:, 0]  # [P]
+
+        # hard negative mining: top (ratio * n_pos) background-CE among negs
+        neg_score = -logp[:, background_id]
+        neg_score = jnp.where(pos, -jnp.inf, neg_score)
+        order = jnp.argsort(-neg_score)
+        rank = jnp.zeros((p,), jnp.int32).at[order].set(jnp.arange(p, dtype=jnp.int32))
+        n_neg = jnp.minimum(
+            (neg_pos_ratio * n_pos).astype(jnp.int32), p - n_pos
+        )
+        neg = (~pos) & (rank < n_neg)
+
+        conf_loss = jnp.sum(jnp.where(pos | neg, ce, 0.0))
+        denom = jnp.maximum(n_pos, 1).astype(loc_loss.dtype)
+        return (loc_loss + conf_loss) / denom
+
+    return jax.vmap(one)(loc_preds, conf_preds, gt_boxes, gt_labels, gt_valid)
+
+
+# ---------------------------------------------------------------------------
+# NMS + detection output — DetectionOutputLayer.cpp
+# ---------------------------------------------------------------------------
+
+
+def nms(
+    boxes: Array,
+    scores: Array,
+    iou_threshold: float = 0.45,
+    top_k: int = 100,
+    score_threshold: float = 0.01,
+) -> Tuple[Array, Array]:
+    """Greedy NMS over one class → (keep mask [K] over the top-k prefix,
+    indices [K] into the input). Fixed shapes: sorts once, then a fori_loop
+    marks suppressions in the score-ordered prefix."""
+    k = min(top_k, scores.shape[0])
+    top_scores, idx = jax.lax.top_k(scores, k)
+    top_boxes = boxes[idx]
+    iou = iou_matrix(top_boxes, top_boxes)
+
+    valid0 = top_scores > score_threshold
+
+    def body(i, keep):
+        alive = keep[i]
+        suppress = (iou[i] > iou_threshold) & (jnp.arange(k) > i)
+        return jnp.where(alive, keep & ~suppress, keep)
+
+    keep = jax.lax.fori_loop(0, k, body, valid0)
+    return keep, idx
+
+
+def detection_output(
+    loc_preds: Array,
+    conf_preds: Array,
+    priors: Array,
+    variances: Array,
+    num_classes: int,
+    background_id: int = 0,
+    nms_threshold: float = 0.45,
+    nms_top_k: int = 400,
+    keep_top_k: int = 200,
+    confidence_threshold: float = 0.01,
+) -> Array:
+    """[B, P, 4] locs + [B, P, C] logits → [B, keep_top_k, 6] detections
+    (label, score, xmin, ymin, xmax, ymax), score 0 rows are padding.
+    Per-class NMS then global keep_top_k, as in DetectionOutputLayer."""
+    probs = jax.nn.softmax(conf_preds, axis=-1)
+
+    def one(loc_p, prob):
+        decoded = decode_boxes(priors, variances, loc_p)  # [P, 4]
+        all_scores = []
+        all_boxes = []
+        all_labels = []
+        for c in range(num_classes):
+            if c == background_id:
+                continue
+            keep, idx = nms(
+                decoded,
+                prob[:, c],
+                iou_threshold=nms_threshold,
+                top_k=min(nms_top_k, priors.shape[0]),
+                score_threshold=confidence_threshold,
+            )
+            sc = jnp.where(keep, prob[idx, c], 0.0)
+            all_scores.append(sc)
+            all_boxes.append(decoded[idx])
+            all_labels.append(jnp.full(sc.shape, c, jnp.float32))
+        scores = jnp.concatenate(all_scores)
+        boxes_c = jnp.concatenate(all_boxes, axis=0)
+        labels = jnp.concatenate(all_labels)
+        kk = min(keep_top_k, scores.shape[0])
+        top_s, ti = jax.lax.top_k(scores, kk)
+        out = jnp.concatenate(
+            [labels[ti][:, None], top_s[:, None], boxes_c[ti]], axis=1
+        )
+        if kk < keep_top_k:
+            out = jnp.pad(out, ((0, keep_top_k - kk), (0, 0)))
+        return out
+
+    return jax.vmap(one)(loc_preds, probs)

@@ -1,0 +1,212 @@
+"""Detection stack vs small numpy oracles (analog of the reference's
+detection tests: gserver/tests/test_PriorBox.cpp, test_DetectionOutput.cpp,
+and DetectionMAPEvaluator's eval tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import detection as det
+
+
+def test_prior_boxes_count_and_range():
+    boxes, var = det.prior_boxes(
+        (2, 2), (32, 32), min_sizes=[8], max_sizes=[16], aspect_ratios=[2.0]
+    )
+    # per cell: 1 (min) + 1 (sqrt(min*max)) + 2 (ar 2, 1/2) = 4
+    assert boxes.shape == (2 * 2 * 4, 4)
+    assert var.shape == boxes.shape
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    # center of cell (0,0) is (0.25, 0.25)
+    np.testing.assert_allclose(
+        boxes[0], [0.25 - 0.125, 0.25 - 0.125, 0.25 + 0.125, 0.25 + 0.125]
+    )
+
+
+def test_iou_matrix():
+    a = jnp.array([[0.0, 0.0, 1.0, 1.0], [0.0, 0.0, 0.5, 0.5]])
+    b = jnp.array([[0.5, 0.5, 1.0, 1.0]])
+    got = np.asarray(det.iou_matrix(a, b))
+    np.testing.assert_allclose(got[:, 0], [0.25, 0.0], atol=1e-6)
+
+
+def test_encode_decode_roundtrip(np_rng):
+    priors = jnp.asarray(
+        np.stack(
+            [
+                np_rng.uniform(0, 0.4, 12),
+                np_rng.uniform(0, 0.4, 12),
+                np_rng.uniform(0.5, 1.0, 12),
+                np_rng.uniform(0.5, 1.0, 12),
+            ],
+            1,
+        ).astype(np.float32)
+    )
+    var = jnp.full((12, 4), 0.1)
+    gt = priors + 0.05
+    enc = det.encode_boxes(priors, var, gt)
+    dec = det.decode_boxes(priors, var, enc)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(gt), atol=1e-5)
+
+
+def test_match_priors_bipartite_overrides_threshold():
+    priors = jnp.array(
+        [
+            [0.0, 0.0, 0.3, 0.3],
+            [0.35, 0.35, 0.65, 0.65],
+            [0.7, 0.7, 1.0, 1.0],
+        ]
+    )
+    # gt overlaps prior 1 weakly but it's the best available → bipartite match
+    gt = jnp.array([[0.4, 0.4, 0.9, 0.9]])
+    match, iou = det.match_priors(priors, gt, jnp.array([True]), 0.5)
+    match = np.asarray(match)
+    assert (match >= 0).sum() >= 1
+    best = np.asarray(
+        det.iou_matrix(priors, gt)
+    )[:, 0].argmax()
+    assert match[best] == 0
+
+
+def test_multibox_loss_learns(np_rng):
+    """Loss must decrease when loc preds move toward encoded targets."""
+    priors_np, var_np = det.prior_boxes(
+        (4, 4), (64, 64), min_sizes=[24], max_sizes=[40], aspect_ratios=[2.0]
+    )
+    priors, var = jnp.asarray(priors_np), jnp.asarray(var_np)
+    p = priors.shape[0]
+    gt_boxes = jnp.array([[[0.1, 0.1, 0.45, 0.5]]])
+    gt_labels = jnp.array([[3]])
+    gt_valid = jnp.array([[True]])
+    loc0 = jnp.asarray(np_rng.randn(1, p, 4).astype(np.float32))
+    conf0 = jnp.asarray(np_rng.randn(1, p, 5).astype(np.float32))
+
+    def loss(loc, conf):
+        return jnp.sum(
+            det.multibox_loss(
+                loc, conf, priors, var, gt_boxes, gt_labels, gt_valid
+            )
+        )
+
+    l0 = float(loss(loc0, conf0))
+    gl, gc = jax.grad(loss, argnums=(0, 1))(loc0, conf0)
+    l1 = float(loss(loc0 - 0.1 * gl, conf0 - 0.1 * gc))
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.array(
+        [
+            [0.0, 0.0, 0.5, 0.5],
+            [0.02, 0.02, 0.52, 0.52],  # heavy overlap with box 0
+            [0.6, 0.6, 0.9, 0.9],
+        ]
+    )
+    scores = jnp.array([0.9, 0.8, 0.7])
+    keep, idx = det.nms(boxes, scores, iou_threshold=0.5, top_k=3)
+    keep, idx = np.asarray(keep), np.asarray(idx)
+    kept = set(idx[keep])
+    assert kept == {0, 2}
+
+
+def test_detection_output_shape_and_content(np_rng):
+    priors_np, var_np = det.prior_boxes(
+        (2, 2), (32, 32), min_sizes=[12], max_sizes=[], aspect_ratios=[]
+    )
+    p = priors_np.shape[0]
+    loc = jnp.zeros((1, p, 4))
+    conf = np.full((1, p, 3), -4.0, np.float32)
+    conf[0, 0, 2] = 6.0  # prior 0 confidently class 2
+    out = np.asarray(
+        det.detection_output(
+            loc,
+            jnp.asarray(conf),
+            jnp.asarray(priors_np),
+            jnp.asarray(var_np),
+            num_classes=3,
+            keep_top_k=10,
+        )
+    )
+    assert out.shape == (1, 10, 6)
+    top = out[0, 0]
+    assert top[0] == 2.0 and top[1] > 0.9
+    np.testing.assert_allclose(top[2:], priors_np[0], atol=1e-5)
+
+
+def test_ssd_layers_end_to_end(np_rng):
+    import jax
+
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn import detection_layers as D
+    from paddle_tpu.nn.graph import Network, reset_name_scope
+
+    reset_name_scope()
+    img = L.Data("image", shape=(16, 16, 3))
+    feat = L.Conv2D(img, 8, 3, padding=1, act="relu", name="feat")
+    down = L.Pool2D(feat, 2, "max", name="down")
+    n_cls, k1, k2 = 4, 4, 4  # 4 priors/cell (1 min + 1 maxgeo + 2 ar)
+    loc1 = L.Conv2D(feat, 4 * k1, 3, padding=1, act=None, name="loc1")
+    conf1 = L.Conv2D(feat, n_cls * k1, 3, padding=1, act=None, name="conf1")
+    loc2 = L.Conv2D(down, 4 * k2, 3, padding=1, act=None, name="loc2")
+    conf2 = L.Conv2D(down, n_cls * k2, 3, padding=1, act=None, name="conf2")
+    pb1 = D.PriorBox(feat, (16, 16), [4], [8], [2.0], name="pb1")
+    pb2 = D.PriorBox(down, (16, 16), [8], [12], [2.0], name="pb2")
+    gtb = L.Data("gt_boxes", shape=(None, 4))
+    gtl = L.Data("gt_labels", shape=(None,))
+    cost = D.MultiBoxLoss(
+        [loc1, loc2], [conf1, conf2], [pb1, pb2], gtb, gtl, num_classes=n_cls,
+        name="mbloss",
+    )
+    out = D.DetectionOutput(
+        [loc1, loc2], [conf1, conf2], [pb1, pb2], num_classes=n_cls,
+        keep_top_k=20, name="detout",
+    )
+    net = Network([cost, out])
+    batch = {
+        "image": np_rng.randn(2, 16, 16, 3).astype(np.float32),
+        "gt_boxes": np.array(
+            [[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.8, 0.9]],
+             [[0.2, 0.3, 0.7, 0.8], [0.0, 0.0, 0.0, 0.0]]],
+            np.float32,
+        ),
+        "gt_boxes.lengths": np.array([2, 1]),
+        "gt_labels": np.array([[1, 2], [3, 0]]),
+        "gt_labels.lengths": np.array([2, 1]),
+    }
+    params, states = net.init(jax.random.PRNGKey(0), batch)
+
+    @jax.jit
+    def step(p):
+        def f(p):
+            outs, _ = net.apply(p, states, batch, train=True)
+            return outs["mbloss"].value
+
+        l, g = jax.value_and_grad(f)(p)
+        return jax.tree.map(lambda a, b: a - 0.05 * b, p, g), l
+
+    p = params
+    l0 = None
+    for _ in range(12):
+        p, l = step(p)
+        if l0 is None:
+            l0 = float(l)
+    assert np.isfinite(l0) and float(l) < l0
+
+    outs, _ = net.apply(p, states, batch)
+    assert outs["detout"].value.shape == (2, 20, 6)
+
+
+def test_detection_map_evaluator():
+    from paddle_tpu.metrics.evaluators import DetectionMAPEvaluator
+
+    ev = DetectionMAPEvaluator(ap_type="integral")
+    ev.start()
+    dets = np.zeros((1, 3, 6), np.float32)
+    dets[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]   # TP for gt 0
+    dets[0, 1] = [1, 0.8, 0.6, 0.6, 0.9, 0.9]   # FP (no overlap)
+    dets[0, 2] = [2, 0.7, 0.5, 0.5, 0.8, 0.8]   # TP for gt 1
+    gtb = np.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.8, 0.8]]], np.float32)
+    gtl = np.array([[1, 2]])
+    ev.update(detections=dets, gt_boxes=gtb, gt_labels=gtl, gt_lengths=np.array([2]))
+    # class 1: AP = 1.0 (first det TP, recall 1 at precision 1); class 2: AP = 1.0
+    np.testing.assert_allclose(ev.finish(), 1.0)
