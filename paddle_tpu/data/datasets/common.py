@@ -1,0 +1,85 @@
+"""Dataset cache/download plumbing (python/paddle/v2/dataset/common.py).
+
+DATA_HOME caching + md5-checked download, with one deliberate divergence: in
+airgapped environments (no egress) every dataset falls back to a deterministic
+synthetic sample generator with the exact same record schema, clearly flagged
+via the SYNTHETIC global and a log line — training pipelines stay runnable
+end-to-end without network access.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+log = logging.getLogger("paddle_tpu.dataset")
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
+)
+
+# Set to True the first time a download fails and a synthetic fallback engages.
+SYNTHETIC = False
+
+
+def data_path(module_name: str, filename: str) -> str:
+    d = os.path.join(DATA_HOME, module_name)
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, filename)
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: Optional[str] = None) -> str:
+    """Fetch url into the cache; raises DownloadUnavailable when offline."""
+    filename = data_path(module_name, url.split("/")[-1])
+    if os.path.exists(filename) and (md5sum is None or md5file(filename) == md5sum):
+        return filename
+    try:
+        import urllib.request
+
+        tmp = filename + ".part"
+        urllib.request.urlretrieve(url, tmp)  # nosec - dataset mirror fetch
+        if md5sum is not None and md5file(tmp) != md5sum:
+            os.remove(tmp)
+            raise DownloadUnavailable(f"md5 mismatch for {url}")
+        os.replace(tmp, filename)
+        return filename
+    except DownloadUnavailable:
+        raise
+    except Exception as e:  # no egress, DNS failure, 403, ...
+        raise DownloadUnavailable(f"cannot fetch {url}: {e}") from e
+
+
+class DownloadUnavailable(RuntimeError):
+    pass
+
+
+def fetch_or_synthetic(fetch: Callable[[], Callable], synth: Callable[[], Callable], what: str):
+    """Return fetch() if the real data can be obtained, else synth().
+
+    Both arguments are thunks returning reader creators."""
+    global SYNTHETIC
+    try:
+        return fetch()
+    except (DownloadUnavailable, OSError) as e:
+        SYNTHETIC = True
+        log.warning("%s: real dataset unavailable (%s); using deterministic "
+                    "synthetic data with the same schema", what, e)
+        return synth()
+
+
+def rng(seed_tag: str) -> np.random.RandomState:
+    """Deterministic per-dataset RandomState (stable across runs/processes)."""
+    h = int(hashlib.md5(seed_tag.encode()).hexdigest()[:8], 16)
+    return np.random.RandomState(h)

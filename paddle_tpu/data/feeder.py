@@ -120,6 +120,16 @@ class DataFeeder:
                 for i, idxs in enumerate(vals):
                     out[i, np.asarray(idxs, np.int64)] = 1.0
                 batch[n] = out
+            elif spec.kind == "sparse_binary_seq":
+                # vals[i] is a list of per-timestep index lists
+                lengths = np.asarray([len(v) for v in vals], np.int32)
+                max_len = _bucket_len(int(lengths.max()) if len(vals) else 1, spec.seq_bucket)
+                out = np.zeros((len(vals), max_len, spec.dim), np.float32)
+                for i, steps in enumerate(vals):
+                    for t, idxs in enumerate(steps[:max_len]):
+                        out[i, t, np.asarray(idxs, np.int64)] = 1.0
+                batch[n] = out
+                batch[n + ".lengths"] = np.minimum(lengths, max_len)
             elif spec.kind == "sparse_value":
                 out = np.zeros((len(vals), spec.dim), np.float32)
                 for i, pairs in enumerate(vals):

@@ -1019,3 +1019,117 @@ class PrintLayer(Layer):
         msg = self.message.replace("{", "{{").replace("}", "}}")
         jax.debug.print((msg + " {x}").lstrip(), x=ins[0].value)
         return ins[0]
+
+
+@LAYERS.register("block_expand")
+class BlockExpand(Layer):
+    """Image → sequence of flattened blocks (BlockExpandLayer.cpp +
+    paddle/function/BlockExpandOp.cpp, the im2col exposed as a layer — feeds
+    OCR CRNN stacks). Input [B, H, W, C] → sequence [B, T, block_y*block_x*C]
+    where T = out_h*out_w, scanned row-major like the reference."""
+
+    type_name = "block_expand"
+
+    def __init__(self, input: Layer, block_x: int, block_y: int,
+                 stride_x: int = 0, stride_y: int = 0,
+                 padding_x: int = 0, padding_y: int = 0, name=None):
+        super().__init__(input, name=name)
+        self.block = (block_y, block_x)
+        self.stride = (stride_y or block_y, stride_x or block_x)
+        self.padding = (padding_y, padding_x)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        b, h, w, c = x.shape
+        (by, bx), (sy, sx), (py, px) = self.block, self.stride, self.padding
+        x = jnp.pad(x, ((0, 0), (py, py), (px, px), (0, 0)))
+        # XLA's patch extraction: conv_general_dilated_patches keeps it on MXU-
+        # friendly layouts instead of a scalar gather loop
+        patches = jax.lax.conv_general_dilated_patches(
+            x, filter_shape=(by, bx), window_strides=(sy, sx), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )  # [B, out_h, out_w, C*by*bx]
+        oh, ow = patches.shape[1], patches.shape[2]
+        t = oh * ow
+        seq = patches.reshape(b, t, patches.shape[-1])
+        lengths = jnp.full((b,), t, jnp.int32)
+        return Argument(seq, lengths)
+
+
+@LAYERS.register("row_conv")
+class RowConv(Layer):
+    """Lookahead row convolution (RowConvLayer.cpp + function/RowConvOp.cpp,
+    from DeepSpeech2): y[t] = sum_{i=0..ctx-1} x[t+i] * w[i], per feature —
+    a depthwise causal-in-reverse conv done as one lax conv over time."""
+
+    type_name = "row_conv"
+
+    def __init__(self, input: Layer, context_len: int, act: Any = None,
+                 param_attr: Any = None, name=None):
+        super().__init__(input, name=name)
+        self.context_len = context_len
+        self.act = act
+        self.param_attr = _attr(param_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        arg = ins[0]
+        x = arg.value  # [B, T, D]
+        b, t, d = x.shape
+        w = ctx.param(self, "w", (self.context_len, d), init_mod.smart_normal,
+                      self.param_attr)
+        # zero-pad the future edge; mask invalid (padded) timesteps so lookahead
+        # never reads beyond a sequence's true length
+        if arg.lengths is not None:
+            x = x * arg.mask(x.dtype)[..., None]
+        xp = jnp.pad(x, ((0, 0), (0, self.context_len - 1), (0, 0)))
+        windows = jnp.stack(
+            [xp[:, i : i + t, :] for i in range(self.context_len)], axis=0
+        )  # [ctx, B, T, D]
+        out = jnp.einsum("cbtd,cd->btd", windows, w.astype(x.dtype))
+        out = act_mod.apply(self.act, out)
+        return arg.with_value(out)
+
+
+@LAYERS.register("selective_fc")
+class SelectiveFc(Layer):
+    """SelectiveFullyConnectedLayer.cpp: fc where only a selected subset of
+    output columns is computed/valid. TPU-native form: compute the full matmul
+    (MXU-friendly dense GEMM) and mask unselected columns to -inf/0 — the
+    reference's sparse column GEMM is a bandwidth trick for CPUs that the MXU
+    does not need at these sizes."""
+
+    type_name = "selective_fc"
+
+    def __init__(self, input, size: int, act: Any = None, bias: bool = True,
+                 param_attr: Any = None, pass_generation: bool = False,
+                 has_selected_colums: bool = True, selection_mode: str = "mask",
+                 name=None):
+        ins = input if isinstance(input, (list, tuple)) else [input]
+        super().__init__(list(ins), name=name)
+        self.size = size
+        self.act = act
+        self.bias = bias
+        self.param_attr = _attr(param_attr)
+        self.has_select = len(self.inputs) > 1
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        w = ctx.param(self, "w", (x.shape[-1], self.size),
+                      init_mod.smart_normal, self.param_attr)
+        out = linalg.matmul(x, w, ctx.policy)
+        if self.bias:
+            bvec = ctx.param(self, "b", (self.size,), init_mod.zeros, None)
+            out = out + bvec
+        sel = ins[1].value.astype(out.dtype) if self.has_select else None
+        act_name = self.act if isinstance(self.act, str) else getattr(self.act, "name", self.act)
+        if sel is not None and act_name == "softmax":
+            # mask pre-activation so softmax normalizes over selected cols only
+            # (SelectiveFullyConnectedLayer computes softmax on the selected set)
+            out = jnp.where(sel > 0, out, jnp.asarray(-1e9, out.dtype))
+            out = act_mod.apply(self.act, out)
+            out = out * sel
+        else:
+            out = act_mod.apply(self.act, out)
+            if sel is not None:
+                out = out * sel
+        return ins[0].with_value(out)

@@ -1,0 +1,80 @@
+"""paddle.v2.inference analog (python/paddle/v2/inference.py).
+
+infer() compiles the output sub-graph once per batch shape and streams input
+chunks through it — the deployment path that replaces
+paddle_gradient_machine_forward (capi/gradient_machine.h:73).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from paddle_tpu.v2.parameters import Parameters
+from paddle_tpu.v2.topology import Topology
+
+
+class Inference:
+    def __init__(self, output_layer, parameters: Parameters):
+        outputs = output_layer if isinstance(output_layer, (list, tuple)) else [output_layer]
+        self.topology = Topology(list(outputs))
+        self.output_names = [l.name for l in outputs]
+        self.network = self.topology.network
+        self._params = {k: np.asarray(v) for k, v in parameters.as_dict().items()}
+        self._states: Dict[str, Any] = {}
+        self._apply = jax.jit(self._forward)
+        self._states_ready = False
+
+    def _forward(self, params, states, batch):
+        outs, _ = self.network.apply(params, states, batch, train=False)
+        return [outs[n].value for n in self.output_names]
+
+    def _ensure_states(self, batch) -> None:
+        if self._states_ready:
+            return
+        # batch-norm moving stats etc. default-initialize when the Parameters
+        # tar carries only trainable values
+        params, states = self.network.init(jax.random.PRNGKey(0), batch, train=False)
+        for k in params:
+            if k not in self._params:
+                self._params[k] = np.asarray(params[k])
+        self._states = {k: np.asarray(v) for k, v in states.items()}
+        self._states_ready = True
+
+    def infer(
+        self,
+        input: Union[List, Iterable],
+        feeding: Optional[Dict[str, int]] = None,
+        field: Union[str, Sequence[str]] = "value",
+        batch_size: int = 128,
+    ):
+        fields = [field] if isinstance(field, str) else list(field)
+        for f in fields:
+            if f not in ("value", "id"):
+                raise ValueError(f"unsupported infer field {f!r} (value|id)")
+        feeder = self.topology.make_feeder(feeding)
+        samples = list(input)
+        chunks: List[List[np.ndarray]] = []
+        for i in range(0, len(samples), batch_size):
+            batch = feeder(samples[i : i + batch_size])
+            self._ensure_states(batch)
+            vals = self._apply(self._params, self._states, batch)
+            chunks.append([np.asarray(v) for v in vals])
+        per_output = [np.concatenate([c[j] for c in chunks], axis=0)
+                      for j in range(len(self.output_names))]
+        results = []
+        for f in fields:
+            for out in per_output:
+                results.append(np.argmax(out, axis=-1) if f == "id" else out)
+        if len(results) == 1:
+            return results[0]
+        return results
+
+
+def infer(output_layer, parameters: Parameters, input, feeding=None,
+          field="value", batch_size: int = 128):
+    return Inference(output_layer, parameters).infer(
+        input, feeding=feeding, field=field, batch_size=batch_size
+    )

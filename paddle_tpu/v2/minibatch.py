@@ -1,0 +1,3 @@
+"""paddle.v2.minibatch analog."""
+
+from paddle_tpu.data.reader import batch  # noqa: F401

@@ -1,0 +1,40 @@
+"""paddle.v2.pooling analog (trainer_config_helpers/poolings.py)."""
+
+from __future__ import annotations
+
+
+class BasePoolingType:
+    name = "max"
+
+
+class Max(BasePoolingType):
+    name = "max"
+
+
+class Avg(BasePoolingType):
+    name = "avg"
+
+
+class Sum(BasePoolingType):
+    name = "sum"
+
+
+class SquareRootN(BasePoolingType):
+    name = "sqrt"
+
+
+# cuDNN variants in the reference are just kernels for the same math
+CudnnMax = Max
+CudnnAvg = Avg
+
+
+def resolve(p) -> str:
+    if p is None:
+        return "max"
+    if isinstance(p, str):
+        return p
+    if isinstance(p, BasePoolingType) or (
+        isinstance(p, type) and issubclass(p, BasePoolingType)
+    ):
+        return p.name
+    raise TypeError(f"not a pooling type: {p!r}")

@@ -1,0 +1,124 @@
+"""paddle.v2.trainer analog (python/paddle/v2/trainer.py:24 SGD, .train :124).
+
+SGD here drives the compiled-step SGDTrainer (paddle_tpu.trainer); the v2
+reader/event/feeding protocol is preserved exactly: reader yields minibatches
+(lists of sample tuples), `feeding` maps data-layer names to tuple positions,
+and `event_handler` receives BeginPass/EndIteration/EndPass (+ TestResult via
+`test()`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.core.init_ctx import flags
+from paddle_tpu.trainer.trainer import SGDTrainer
+from paddle_tpu.v2.event import TestResult
+from paddle_tpu.v2.parameters import Parameters
+from paddle_tpu.v2.topology import Topology
+
+
+class SGD:
+    def __init__(
+        self,
+        cost,
+        parameters: Optional[Parameters] = None,
+        update_equation=None,
+        extra_layers: Sequence = (),
+        is_local: bool = True,
+        **_compat,
+    ):
+        from paddle_tpu.v2 import optimizer as v2opt
+
+        if update_equation is None:
+            update_equation = v2opt.Momentum(learning_rate=0.01)
+        self.topology = Topology(cost, extra_layers=extra_layers)
+        self.parameters = parameters
+        self._update = update_equation
+
+        parallel = None
+        tc = flags().trainer_count
+        if tc and tc > 1:
+            from paddle_tpu.parallel import DataParallel, make_mesh
+
+            parallel = DataParallel(make_mesh({"data": tc}))
+
+        costs = cost if isinstance(cost, (list, tuple)) else [cost]
+        self._trainer = SGDTrainer(
+            list(costs),
+            update_equation.optimizer,
+            extra_outputs=list(extra_layers),
+            schedule=update_equation.schedule,
+            model_average=update_equation.model_average,
+            parallel=parallel,
+            seed=flags().seed,
+        )
+
+    # -- API -----------------------------------------------------------------
+    def train(
+        self,
+        reader: Callable,
+        num_passes: int = 1,
+        event_handler: Optional[Callable] = None,
+        feeding: Optional[Dict[str, int]] = None,
+    ):
+        feeder = self.topology.make_feeder(feeding)
+        if self.parameters is not None and self._trainer.state is None:
+            self._seed_state_from_parameters(reader, feeder)
+        state = self._trainer.train(
+            reader,
+            num_passes=num_passes,
+            event_handler=event_handler,
+            feeder=feeder,
+        )
+        self._sync_parameters_out()
+        return state
+
+    def test(self, reader: Callable, feeding: Optional[Dict[str, int]] = None) -> TestResult:
+        feeder = self.topology.make_feeder(feeding)
+        if self._trainer.state is None:
+            if self.parameters is None or not len(self.parameters):
+                raise ValueError(
+                    "test() before train(): pass trained Parameters to SGD(...) "
+                    "(e.g. Parameters.from_tar) or call train() first"
+                )
+            self._seed_state_from_parameters(reader, feeder)
+        res = self._trainer.test(reader, feeder)
+        return TestResult(pass_id=-1, cost=res["cost"], metrics=res)
+
+    def save_parameter_to_tar(self, f) -> None:
+        self._sync_parameters_out()
+        assert self.parameters is not None
+        self.parameters.to_tar(f)
+
+    # -- internals -----------------------------------------------------------
+    def _seed_state_from_parameters(self, reader, feeder) -> None:
+        """Initialize trainer state, then overwrite values with user-provided
+        Parameters (supports warm start / from_tar)."""
+        first = next(iter(reader()))
+        batch = feeder(first)
+        if self._trainer.parallel is not None:
+            batch = self._trainer.parallel.shard_batch(batch)
+        self._trainer.init_state(batch)
+        if self.parameters is not None and len(self.parameters):
+            import jax.numpy as jnp
+
+            params = dict(self._trainer.state["params"])
+            for k in params:
+                if k in self.parameters:
+                    params[k] = jnp.asarray(self.parameters.get(k))
+            self._trainer.state["params"] = params
+            if self._trainer.parallel is not None:
+                self._trainer.state = self._trainer.parallel.shard_state(
+                    self._trainer.state
+                )
+
+    def _sync_parameters_out(self) -> None:
+        if self._trainer.state is None:
+            return
+        if self.parameters is None:
+            self.parameters = Parameters()
+        for k, v in self._trainer.state["params"].items():
+            self.parameters.set(k, np.asarray(v))

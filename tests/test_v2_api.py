@@ -1,0 +1,147 @@
+"""Tests for the paddle.v2-style user API (python/paddle/v2 parity surface)."""
+
+import io
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.nn.graph import reset_name_scope
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    reset_name_scope()
+    yield
+
+
+def _mlp():
+    images = paddle.layer.data(name="pixel", type=paddle.data_type.dense_vector(784))
+    label = paddle.layer.data(name="label", type=paddle.data_type.integer_value(10))
+    h = paddle.layer.fc(input=images, size=32, act=paddle.activation.Tanh())
+    out = paddle.layer.fc(input=h, size=10, act=None, name="output")
+    cost = paddle.layer.classification_cost(input=out, label=label)
+    return images, label, out, cost
+
+
+def test_train_test_infer_roundtrip():
+    paddle.init(use_gpu=False, trainer_count=1)
+    _, _, out, cost = _mlp()
+    params = paddle.parameters.create(cost)
+    assert "output.w" in params.names()
+
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9),
+    )
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.mnist.train(), buf_size=500),
+        batch_size=64,
+    )
+    trainer.train(reader=lambda: itertools.islice(reader(), 12), num_passes=2,
+                  event_handler=handler)
+    assert costs[-1] < costs[0], f"no learning: {costs[0]} -> {costs[-1]}"
+
+    res = trainer.test(
+        reader=lambda: itertools.islice(paddle.batch(paddle.dataset.mnist.test(), 64)(), 3)
+    )
+    assert np.isfinite(res.cost)
+
+    samples = [(s,) for s, _ in itertools.islice(paddle.dataset.mnist.test()(), 8)]
+    probs = paddle.infer(output_layer=out, parameters=trainer.parameters,
+                         input=samples, feeding={"pixel": 0})
+    assert probs.shape == (8, 10)
+
+
+def test_parameters_tar_roundtrip():
+    _, _, out, cost = _mlp()
+    params = paddle.parameters.create(cost)
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    params2 = paddle.parameters.Parameters.from_tar(buf)
+    assert set(params2.names()) == set(params.names())
+    for k in params.names():
+        np.testing.assert_array_equal(params.get(k), params2.get(k))
+
+
+def test_topology_feeding_order():
+    images, label, out, cost = _mlp()
+    topo = paddle.topology.Topology(cost)
+    assert set(topo.data_layers()) == {"pixel", "label"}
+    feeder = topo.make_feeder({"label": 1, "pixel": 0})
+    batch = feeder([(np.zeros(784, np.float32), 3), (np.ones(784, np.float32), 5)])
+    assert batch["pixel"].shape == (2, 784)
+    np.testing.assert_array_equal(batch["label"], [3, 5])
+
+
+def test_sequence_layers_api():
+    paddle.init(use_gpu=False)
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(1000)
+    )
+    label = paddle.layer.data(name="label", type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=16)
+    lstm = paddle.layer.lstmemory(input=paddle.layer.fc(input=emb, size=64))
+    pooled = paddle.layer.pool(input=lstm, pooling_type=paddle.pooling.Max())
+    out = paddle.layer.fc(input=pooled, size=2, act=None)
+    cost = paddle.layer.classification_cost(input=out, label=label)
+
+    trainer = paddle.trainer.SGD(
+        cost=cost, update_equation=paddle.optimizer.Adam(learning_rate=1e-2)
+    )
+    reader = paddle.batch(paddle.dataset.imdb.train({f"w{i}": i for i in range(1000)}), 16)
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    trainer.train(reader=lambda: itertools.islice(reader(), 6), num_passes=1,
+                  event_handler=handler)
+    assert all(np.isfinite(c) for c in costs)
+
+
+def test_mixed_and_projections():
+    a = paddle.layer.data(name="a", type=paddle.data_type.dense_vector(8))
+    m = paddle.layer.mixed(
+        size=4,
+        input=[paddle.layer.full_matrix_projection(input=a)],
+        act=paddle.activation.Tanh(),
+    )
+    params = paddle.parameters.create(paddle.layer.sum_cost(input=m))
+    assert any("proj" in n for n in params.names())
+
+
+def test_optimizer_variants_build():
+    for cls in (paddle.optimizer.Momentum, paddle.optimizer.Adam,
+                paddle.optimizer.AdaGrad, paddle.optimizer.AdaDelta,
+                paddle.optimizer.RMSProp, paddle.optimizer.DecayedAdaGrad,
+                paddle.optimizer.AdaMax):
+        opt = cls(learning_rate=0.01,
+                  regularization=paddle.optimizer.L2Regularization(1e-4))
+        assert opt.optimizer is not None
+
+
+def test_datasets_schemas():
+    x, y = next(paddle.dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    img, lbl = next(paddle.dataset.cifar.train10()())
+    assert img.shape == (3072,) and 0 <= lbl < 10
+    ng = next(paddle.dataset.imikolov.train({f"w{i}": i for i in range(100)} | {"<unk>": 100}, 5)())
+    assert len(ng) == 5
+    rec = next(paddle.dataset.movielens.train()())
+    assert len(rec) == 8
+    srl = next(paddle.dataset.conll05.test()())
+    assert len(srl) == 9 and len(srl[0]) == len(srl[8])
+    s, t_in, t_out = next(paddle.dataset.wmt14.train(1000)())
+    assert t_in[0] == 0 and t_out[-1] == 1 and len(t_in) == len(t_out)
+    fa, fb = next(paddle.dataset.mq2007.train("pairwise")())
+    assert fa.shape == (46,) and fb.shape == (46,)
