@@ -167,7 +167,9 @@ class SGDTrainer:
             t0 = time.time()
             costs, costs_n, n_batches = 0.0, 0, 0
             for batch_id, raw in enumerate(reader()):
-                batch = feeder(raw) if feeder is not None else raw
+                # dict batches are already feed-ready (e.g. from a DoubleBuffer
+                # that ran the feeder on its prefetch thread)
+                batch = feeder(raw) if feeder is not None and not isinstance(raw, dict) else raw
                 if self.parallel is not None:
                     if not self.parallel.batch_divisible(batch):
                         # trailing partial batch not divisible by the mesh data
@@ -218,7 +220,7 @@ class SGDTrainer:
             self._eval_fn = self._make_eval()
         total, n = 0.0, 0
         for raw in reader():
-            batch = feeder(raw) if feeder is not None else raw
+            batch = feeder(raw) if feeder is not None and not isinstance(raw, dict) else raw
             if self.parallel is not None:
                 batch = self.parallel.shard_batch(batch)
             cost, _ = self._eval_fn(self.state, batch)

@@ -62,7 +62,7 @@ class Inference:
             self._ensure_states(batch)
             vals = self._apply(self._params, self._states, batch)
             chunks.append([np.asarray(v) for v in vals])
-        per_output = [np.concatenate([c[j] for c in chunks], axis=0)
+        per_output = [_concat_chunks([c[j] for c in chunks])
                       for j in range(len(self.output_names))]
         results = []
         for f in fields:
@@ -71,6 +71,21 @@ class Inference:
         if len(results) == 1:
             return results[0]
         return results
+
+
+def _concat_chunks(chunks):
+    """Concatenate per-batch outputs; sequence outputs may be padded to
+    different bucket lengths per chunk — zero-pad to the common max first."""
+    if len(chunks) == 1:
+        return chunks[0]
+    if chunks[0].ndim >= 2:
+        max_t = max(c.shape[1] for c in chunks)
+        if any(c.shape[1] != max_t for c in chunks):
+            chunks = [
+                np.pad(c, [(0, 0), (0, max_t - c.shape[1])] + [(0, 0)] * (c.ndim - 2))
+                for c in chunks
+            ]
+    return np.concatenate(chunks, axis=0)
 
 
 def infer(output_layer, parameters: Parameters, input, feeding=None,

@@ -81,6 +81,8 @@ def data(name: str, type: InputSpec, height: int = 0, width: int = 0) -> Layer:
         shape, is_seq = (), True
     elif spec.kind in ("sparse_binary", "sparse_value"):
         shape, is_seq = (int(spec.dim),), False
+    elif spec.kind == "sparse_binary_seq":
+        shape, is_seq = (int(spec.dim),), True
     else:
         raise ValueError(f"unknown input kind {spec.kind}")
     node = L.Data(name, shape=shape, is_seq=is_seq)

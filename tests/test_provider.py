@@ -1,0 +1,100 @@
+"""Tests for the PyDataProvider2-equivalent provider pipeline."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.data.feeder import DataFeeder, dense_vector, integer_value
+from paddle_tpu.data.provider import (
+    CacheType,
+    DataProviderConverter,
+    DoubleBuffer,
+    MultiDataProvider,
+    provider,
+)
+from paddle_tpu.data.reader import batch
+
+
+def test_provider_decorator_and_types():
+    @provider(
+        input_types={"x": dense_vector(4), "y": integer_value(3)},
+        should_shuffle=False,
+        check=True,
+    )
+    def process(settings, filename):
+        assert settings.input_types is not None
+        for i in range(5):
+            yield {"x": np.full(4, i, np.float32), "y": i % 3}
+
+    samples = list(process(file_list=["f0", "f1"]))
+    assert len(samples) == 10  # 5 per "file"
+    feeder = DataFeeder(process.input_types)
+    b = feeder(samples[:4])
+    assert b["x"].shape == (4, 4) and b["y"].dtype == np.int32
+
+
+def test_provider_check_rejects_bad_sample():
+    @provider(input_types=[dense_vector(4)], should_shuffle=False, check=True)
+    def bad(settings, filename):
+        yield (np.zeros(3, np.float32),)  # wrong dim
+
+    with pytest.raises(ValueError):
+        list(bad(file_list=["f"]))
+
+
+def test_provider_init_hook_and_cache():
+    calls = []
+
+    def init_hook(settings, obj, file_list, **kw):
+        calls.append(file_list)
+        settings.scale = 2.0
+
+    @provider(input_types=[dense_vector(1)], init_hook=init_hook,
+              should_shuffle=False, cache=CacheType.CACHE_PASS_IN_MEM)
+    def process(settings, filename):
+        for i in range(3):
+            yield (np.array([i * settings.scale], np.float32),)
+
+    first = list(process(file_list=["a"]))
+    second = list(process(file_list=["a"]))  # served from pass cache
+    assert [s[0][0] for s in first] == [0.0, 2.0, 4.0]
+    assert [s[0][0] for s in second] == [0.0, 2.0, 4.0]
+    assert len(calls) >= 1
+
+
+def test_multi_data_provider_ratio():
+    a = lambda: iter([("a",)] * 300)
+    b = lambda: iter([("b",)] * 100)
+    mixed = list(MultiDataProvider([(a, 3.0), (b, 1.0)])())
+    assert len(mixed) == 400
+    head = mixed[:100]
+    n_a = sum(1 for s in head if s[0] == "a")
+    assert 55 <= n_a <= 95  # ~75 expected at ratio 3:1
+
+
+def test_double_buffer_matches_sync():
+    def reader():
+        for i in range(20):
+            yield [(np.full(2, i, np.float32), i % 2)] * 3
+
+    feeder = DataFeeder({"x": dense_vector(2), "y": integer_value(2)})
+    sync = [feeder(r) for r in reader()]
+    buffered = list(DoubleBuffer(reader, feeder, capacity=2))
+    assert len(buffered) == len(sync)
+    for s, bch in zip(sync, buffered):
+        np.testing.assert_array_equal(s["x"], bch["x"])
+
+
+def test_double_buffer_propagates_errors():
+    def reader():
+        yield [(np.zeros(2, np.float32), 0)]
+        raise RuntimeError("boom")
+
+    feeder = DataFeeder({"x": dense_vector(2), "y": integer_value(2)})
+    with pytest.raises(RuntimeError, match="boom"):
+        list(DoubleBuffer(reader, feeder))
+
+
+def test_converter_list_types():
+    conv = DataProviderConverter([dense_vector(2), integer_value(5)], names=["img", "lbl"])
+    out = conv([(np.ones(2, np.float32), 4)])
+    assert out["img"].shape == (1, 2) and out["lbl"][0] == 4
