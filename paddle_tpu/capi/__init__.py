@@ -1,0 +1,15 @@
+"""Inference/deployment surface — paddle/capi parity (SURVEY §3.5).
+
+Reference: paddle_gradient_machine_create_for_inference_with_parameters
+(capi/gradient_machine.h:52) consumes a merged file (ModelConfig proto +
+parameter blobs, produced by MergeModel.cpp). Here the merged artifact packs
+the config script + serialized TrainerConfig + parameter/state arrays into one
+.npz; InferenceMachine rebuilds the graph by re-running the config (the
+reference likewise re-enters Python to parse configs) and serves compiled
+forward passes.
+"""
+
+from paddle_tpu.capi.merge_model import merge_model
+from paddle_tpu.capi.inference import InferenceMachine, create_for_inference
+
+__all__ = ["merge_model", "InferenceMachine", "create_for_inference"]

@@ -1,0 +1,61 @@
+"""merge_model: fold config + trained parameters into ONE deployable file.
+
+Parity: paddle/trainer/MergeModel.cpp + python/paddle/utils/merge_model.py
+(SURVEY §5 "Model export"). Artifact layout (single .npz):
+
+    __config_source__  : the config script text (re-executed at load)
+    __config_args__    : config_args string
+    __trainer_config__ : serialized TrainerConfig text (for inspection)
+    param/<name>       : parameter arrays
+    state/<name>       : non-trainable states (batch-norm moving stats)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def merge_model(
+    config_path: str,
+    model_dir: str,
+    output_path: str,
+    config_args: str = "",
+    pass_id: Optional[int] = None,
+) -> str:
+    from paddle_tpu import proto
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    with open(config_path) as f:
+        source = f.read()
+    pc = parse_config(config_path, config_args)
+
+    if os.path.isdir(os.path.join(model_dir, "pass-00000")) or any(
+        d.startswith("pass-") for d in os.listdir(model_dir)
+    ):
+        params, states, _opt, _manifest = ckpt.load_pass(model_dir, pass_id)
+    else:
+        # a bare pass dir (save_dir/pass-00042 passed directly)
+        parent, leaf = os.path.split(model_dir.rstrip("/"))
+        params, states, _opt, _manifest = ckpt.load_pass(
+            parent, int(leaf.split("-")[1])
+        )
+
+    payload: Dict[str, np.ndarray] = {
+        "__config_source__": np.asarray(source),
+        "__config_args__": np.asarray(config_args),
+        "__trainer_config__": np.asarray(proto.to_text(pc.trainer_config)),
+    }
+    for k, v in params.items():
+        payload[f"param/{k}"] = np.asarray(v)
+    for k, v in (states or {}).items():
+        payload[f"state/{k}"] = np.asarray(v)
+
+    tmp = output_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, output_path)
+    return output_path

@@ -1,0 +1,312 @@
+"""`paddle` CLI — TrainerMain parity.
+
+Reference: the `paddle train` entry (paddle/scripts/submit_local.sh.in:96-116 →
+paddle_trainer, paddle/trainer/TrainerMain.cpp:32) driven by gflags
+(utils/Flags.h:19-43), plus `--job=time` benchmarking (TrainerBenchmark.cpp)
+and model tools (MergeModel.cpp, python/paddle/utils/dump_config.py).
+
+Usage:
+    python -m paddle_tpu train --config=conf.py [--config_args=k=v,...]
+        [--num_passes=N] [--save_dir=DIR] [--trainer_count=N] [--use_tpu=1]
+        [--init_model_path=DIR] [--start_pass=N] [--log_period=N] [--job=train|test|time]
+    python -m paddle_tpu dump_config --config=conf.py
+    python -m paddle_tpu merge_model --config=conf.py --model_dir=DIR --output=FILE
+    python -m paddle_tpu version
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import time
+from typing import Any, Callable, List, Optional
+
+from paddle_tpu import proto
+
+
+def _str2bool(v: str) -> bool:
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def _train_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", required=True, help="config script path")
+    p.add_argument("--config_args", default="", help="k=v,... passed to get_config_arg")
+    p.add_argument("--use_tpu", type=_str2bool, default=True)
+    p.add_argument("--use_gpu", type=_str2bool, default=None, help="v1 alias of --use_tpu")
+    p.add_argument("--trainer_count", type=int, default=1)
+    p.add_argument("--num_passes", type=int, default=1)
+    p.add_argument("--save_dir", default=None)
+    p.add_argument("--init_model_path", default=None)
+    p.add_argument("--start_pass", type=int, default=0)
+    p.add_argument("--log_period", type=int, default=100)
+    p.add_argument("--test_period", type=int, default=0)
+    p.add_argument("--saving_period", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
+    p.add_argument("--job", default="train", choices=["train", "test", "time"])
+    p.add_argument("--num_batches", type=int, default=20, help="--job=time batches")
+
+
+def _load_provider(dc: proto.DataConfig):
+    """DataConfig → (provider, file_list, args) — the PyDataProvider2 load
+    path (gserver/dataproviders/PyDataProvider2.cpp:195 loads module.obj)."""
+    mod = importlib.import_module(dc.load_data_module)
+    provider = getattr(mod, dc.load_data_object)
+    files: List[str] = []
+    if dc.files and os.path.exists(dc.files):
+        with open(dc.files) as f:
+            files = [ln.strip() for ln in f if ln.strip()]
+    elif dc.files:
+        files = [dc.files]
+    args = json.loads(dc.load_data_args) if dc.load_data_args else None
+    return provider, files, args
+
+
+def _make_reader(dc: proto.DataConfig, batch_size: int, is_train: bool = True) -> Callable:
+    provider, files, args = _load_provider(dc)
+    kwargs = dict(args) if isinstance(args, dict) else {}
+    # @provider batching knobs (PyDataProvider2.py): calc_batch_size gives a
+    # per-sample cost (e.g. token count); can_over_batch_size controls whether
+    # the overflowing sample stays in the current batch or starts the next
+    calc = getattr(provider, "calc_batch_size", None)
+    can_over = getattr(provider, "can_over_batch_size", True)
+
+    def reader():
+        batch: List[Any] = []
+        acc = 0
+        for sample in provider(
+            obj=None, file_list=files or None, is_train=is_train, **kwargs
+        ):
+            cost = int(calc(sample)) if calc is not None else 1
+            if batch and not can_over and acc + cost > batch_size:
+                yield batch
+                batch, acc = [], 0
+            batch.append(sample)
+            acc += cost
+            if acc >= batch_size:
+                yield batch
+                batch, acc = [], 0
+        if batch:
+            yield batch
+
+    return reader
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    from paddle_tpu.core import init_ctx
+    from paddle_tpu.config import build_optimizer, parse_config
+    from paddle_tpu.metrics.evaluators import EVALUATORS
+    from paddle_tpu.trainer.trainer import SGDTrainer
+
+    use_tpu = args.use_gpu if args.use_gpu is not None else args.use_tpu
+    init_ctx.init(
+        use_tpu=use_tpu,
+        trainer_count=args.trainer_count,
+        log_period=args.log_period,
+        seed=args.seed,
+        **({"dtype_policy": args.dtype} if args.dtype else {}),
+    )
+    if not use_tpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    pc = parse_config(args.config, args.config_args, emit_proto=False)
+    oc = pc.trainer_config.opt_config
+    bundle = build_optimizer(oc)
+
+    parallel = None
+    if args.trainer_count > 1:
+        from paddle_tpu.parallel import DataParallel, make_mesh
+
+        parallel = DataParallel(make_mesh({"data": args.trainer_count}))
+
+    # evaluator outputs must be network outputs so the step returns them
+    extra_layers, seen = [], {l.name for l in pc.outputs}
+    eval_objs = []
+    net_layers = pc.topology.network.layers_by_name
+    for ec in pc.context.evaluators:
+        ins = [net_layers[n] for n in ec.input_layers if n in net_layers]
+        for l in ins:
+            if l.name not in seen:
+                seen.add(l.name)
+                extra_layers.append(l)
+        eval_objs.append((ec, [l.name for l in ins]))
+
+    trainer = SGDTrainer(
+        pc.outputs,
+        bundle.optimizer,
+        extra_outputs=extra_layers,
+        schedule=bundle.schedule,
+        model_average=bundle.model_average,
+        parallel=parallel,
+        seed=args.seed,
+    )
+    feeder = pc.topology.make_feeder()
+    batch_size = oc.batch_size or 32
+
+    if pc.trainer_config.data_config is None and args.job != "test":
+        print("config declares no data sources (define_py_data_sources2)", file=sys.stderr)
+        return 2
+    reader = (
+        _make_reader(pc.trainer_config.data_config, batch_size)
+        if pc.trainer_config.data_config
+        else None
+    )
+    test_reader = (
+        _make_reader(pc.trainer_config.test_data_config, batch_size, is_train=False)
+        if pc.trainer_config.test_data_config
+        else None
+    )
+
+    if args.init_model_path:
+        first = next(iter(reader() if reader else test_reader()))
+        batch = feeder(first)
+        if parallel is not None:
+            batch = parallel.shard_batch(batch)
+        trainer.init_state(batch)
+        trainer.load(args.init_model_path, args.start_pass - 1 if args.start_pass else None)
+
+    if args.job == "time":
+        return _job_time(trainer, reader, feeder, args.num_batches)
+    if args.job == "test":
+        if test_reader is None:
+            print("--job=test needs a test data source", file=sys.stderr)
+            return 2
+        res = trainer.test(test_reader, feeder)
+        print(json.dumps({"test_cost": res["cost"], "samples": res["samples"]}))
+        return 0
+
+    # evaluator accumulation through the event stream (Evaluator::start/eval/
+    # finish per pass, Evaluator.h:42)
+    from paddle_tpu.trainer.events import BeginPass, EndIteration, EndPass
+
+    active = [
+        (EVALUATORS.get(ec.type)(), names) for ec, names in eval_objs
+    ] if eval_objs else []
+
+    def handler(event):
+        if isinstance(event, BeginPass):
+            for ev, _ in active:
+                ev.start()
+        elif isinstance(event, EndIteration) and active:
+            for ev, names in active:
+                vals = [event.metrics.get(n) for n in names]
+                if vals and vals[0] is not None:
+                    kw = {"output": vals[0]}
+                    if len(vals) > 1:
+                        kw["label"] = vals[1]
+                    if len(vals) > 2:
+                        kw["weight"] = vals[2]
+                    try:
+                        ev.update(**kw)
+                    except Exception as e:  # metric failure must not kill training
+                        import logging
+
+                        logging.getLogger("paddle_tpu.cli").warning(
+                            "evaluator %s failed: %s", type(ev).__name__, e
+                        )
+        elif isinstance(event, EndPass):
+            stats = {type(ev).__name__: ev.finish() for ev, _ in active}
+            line = f"pass {event.pass_id}: avg_cost={event.metrics['avg_cost']:.6f}"
+            if "test_cost" in event.metrics:
+                line += f" test_cost={event.metrics['test_cost']:.6f}"
+            for k, v in stats.items():
+                line += f" {k}={v}"
+            print(line)
+
+    trainer.train(
+        reader,
+        num_passes=args.num_passes,
+        event_handler=handler if (active or True) else None,
+        feeder=feeder,
+        test_reader=test_reader,
+        save_dir=args.save_dir,
+        log_period=args.log_period,
+    )
+    return 0
+
+
+def _job_time(trainer, reader, feeder, num_batches: int) -> int:
+    """--job=time (TrainerBenchmark.cpp): time num_batches hot-loop batches."""
+    import jax
+
+    it = iter(reader())
+    batches = []
+    for _ in range(num_batches):
+        try:
+            batches.append(feeder(next(it)))
+        except StopIteration:
+            break
+    if not batches:
+        print("no data", file=sys.stderr)
+        return 2
+    if trainer.parallel is not None:
+        batches = [trainer.parallel.shard_batch(b) for b in batches]
+    trainer.init_state(batches[0])
+    step = trainer._make_step()
+    state = trainer.state
+    state, cost, _ = step(state, batches[0])  # compile
+    jax.block_until_ready(cost)
+    t0 = time.time()
+    for b in batches:
+        state, cost, _ = step(state, b)
+    jax.block_until_ready(cost)
+    dt = (time.time() - t0) / len(batches)
+    print(json.dumps({"ms_per_batch": dt * 1e3, "batches": len(batches)}))
+    return 0
+
+
+def cmd_dump_config(args: argparse.Namespace) -> int:
+    from paddle_tpu.config import parse_config
+
+    pc = parse_config(args.config, args.config_args)
+    sys.stdout.write(proto.to_text(pc.trainer_config))
+    return 0
+
+
+def cmd_merge_model(args: argparse.Namespace) -> int:
+    from paddle_tpu.capi.merge_model import merge_model
+
+    out = merge_model(args.config, args.model_dir, args.output, args.config_args)
+    print(out)
+    return 0
+
+
+def cmd_version(_args: argparse.Namespace) -> int:
+    from paddle_tpu import __version__
+
+    print(f"paddle-tpu {__version__}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="paddle_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_train = sub.add_parser("train", help="train/test/benchmark a config")
+    _train_args(p_train)
+    p_train.set_defaults(fn=cmd_train)
+
+    p_dump = sub.add_parser("dump_config", help="print TrainerConfig text")
+    p_dump.add_argument("--config", required=True)
+    p_dump.add_argument("--config_args", default="")
+    p_dump.set_defaults(fn=cmd_dump_config)
+
+    p_merge = sub.add_parser("merge_model", help="fold config+params into one file")
+    p_merge.add_argument("--config", required=True)
+    p_merge.add_argument("--model_dir", required=True)
+    p_merge.add_argument("--output", required=True)
+    p_merge.add_argument("--config_args", default="")
+    p_merge.set_defaults(fn=cmd_merge_model)
+
+    p_ver = sub.add_parser("version")
+    p_ver.set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""config_parser: execute a v1 Python config script → TrainerConfig.
+
+Parity with python/paddle/trainer/config_parser.py:4208 `parse_config` (the
+function the reference's C++ trainer calls through embedded Python,
+paddle/trainer/TrainerConfigHelper.cpp:34-56). The DSL names injected into the
+script's namespace are the trainer_config_helpers surface
+(paddle_tpu.config.helpers); layer calls build real graph nodes, so the
+"compile" step is just tracing the finished graph (dump.build_model_config)
+rather than a second shape-inference implementation.
+
+`parse_config_and_serialize` keeps the reference entry-point name for
+embedding parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from paddle_tpu import proto
+from paddle_tpu.nn.graph import Layer, reset_name_scope
+from paddle_tpu.v2.topology import Topology
+
+
+# ---------------------------------------------------------------------------
+# parsing context (the reference's g_config global, config_parser.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ParseContext:
+    outputs: List[Layer] = dataclasses.field(default_factory=list)
+    inputs: List[Layer] = dataclasses.field(default_factory=list)
+    opt_config: Optional[proto.OptimizationConfig] = None
+    data_config: Optional[proto.DataConfig] = None
+    test_data_config: Optional[proto.DataConfig] = None
+    config_args: Dict[str, str] = dataclasses.field(default_factory=dict)
+    evaluators: List[proto.EvaluatorConfig] = dataclasses.field(default_factory=list)
+
+
+_tls = threading.local()
+
+
+def g_context() -> ParseContext:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        ctx = ParseContext()
+        _tls.ctx = ctx
+    return ctx
+
+
+@contextlib.contextmanager
+def fresh_context(config_args: Optional[Dict[str, str]] = None):
+    old = getattr(_tls, "ctx", None)
+    _tls.ctx = ParseContext(config_args=dict(config_args or {}))
+    try:
+        yield _tls.ctx
+    finally:
+        _tls.ctx = old
+
+
+# ---------------------------------------------------------------------------
+# DSL functions available inside config scripts
+# ---------------------------------------------------------------------------
+
+
+def outputs(*layers: Union[Layer, Sequence[Layer]]) -> None:
+    """Declare network outputs (config_parser outputs())."""
+    flat: List[Layer] = []
+    for l in layers:
+        if isinstance(l, Layer):
+            flat.append(l)
+        else:
+            flat.extend(l)
+    g_context().outputs.extend(flat)
+
+
+def inputs(*layers: Layer) -> None:
+    g_context().inputs.extend(layers)
+
+
+def get_config_arg(name: str, type_: type = str, default: Any = None) -> Any:
+    """Read a --config_args=k=v,... argument (config_parser get_config_arg)."""
+    raw = g_context().config_args.get(name)
+    if raw is None:
+        return default
+    if type_ is bool:
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    return type_(raw)
+
+
+def define_py_data_sources2(
+    train_list: Optional[str],
+    test_list: Optional[str],
+    module: Union[str, Sequence[str]],
+    obj: Union[str, Sequence[str]],
+    args: Optional[Any] = None,
+) -> None:
+    """Declare the @provider-based data sources
+    (trainer_config_helpers/data_sources.py define_py_data_sources2)."""
+    import json
+
+    ctx = g_context()
+
+    def mk(file_list, which) -> Optional[proto.DataConfig]:
+        if file_list is None:
+            return None
+        mod = module[which] if isinstance(module, (list, tuple)) else module
+        ob = obj[which] if isinstance(obj, (list, tuple)) else obj
+        a = args[which] if isinstance(args, (list, tuple)) else args
+        return proto.DataConfig(
+            type="py2",
+            files=file_list,
+            load_data_module=mod,
+            load_data_object=ob,
+            load_data_args=json.dumps(a) if a is not None else "",
+        )
+
+    ctx.data_config = mk(train_list, 0)
+    ctx.test_data_config = mk(test_list, 1)
+
+
+# ---------------------------------------------------------------------------
+# parse_config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ParsedConfig:
+    trainer_config: proto.TrainerConfig
+    topology: Topology
+    outputs: List[Layer]
+    context: ParseContext
+
+    @property
+    def model_config(self) -> proto.ModelConfig:
+        return self.trainer_config.model_config
+
+
+def _dsl_namespace() -> Dict[str, Any]:
+    import paddle_tpu.config.helpers as helpers
+
+    ns: Dict[str, Any] = {}
+    for name in helpers.__all__:
+        ns[name] = getattr(helpers, name)
+    ns.update(
+        outputs=outputs,
+        inputs=inputs,
+        get_config_arg=get_config_arg,
+        define_py_data_sources2=define_py_data_sources2,
+    )
+    return ns
+
+
+def _parse_arg_str(config_arg_str: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for part in (config_arg_str or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def parse_config(
+    config: Union[str, Callable[[], Any]],
+    config_arg_str: str = "",
+    emit_proto: bool = True,
+) -> ParsedConfig:
+    """Execute `config` (a .py file path or a zero-arg callable using the DSL)
+    and return the parsed result. Mirrors parse_config(trainer_config,
+    config_arg_str) → TrainerConfig proto."""
+    with fresh_context(_parse_arg_str(config_arg_str)) as ctx:
+        reset_name_scope()
+        if callable(config):
+            ret = config()
+            if ret is not None and not ctx.outputs:
+                outputs(ret)
+        else:
+            ns = _dsl_namespace()
+            ns["__file__"] = config
+            with open(config) as f:
+                code = compile(f.read(), config, "exec")
+            exec(code, ns)
+        if not ctx.outputs:
+            raise ValueError(
+                f"config {config!r} declared no outputs(); call outputs(cost)"
+            )
+        topology = Topology(ctx.outputs)
+        tc = proto.TrainerConfig(
+            opt_config=ctx.opt_config or proto.OptimizationConfig(),
+            data_config=ctx.data_config,
+            test_data_config=ctx.test_data_config,
+        )
+        if emit_proto:
+            from paddle_tpu.config.dump import build_model_config
+
+            tc.model_config = build_model_config(topology)
+            tc.model_config.evaluators = list(ctx.evaluators)
+        return ParsedConfig(tc, topology, list(ctx.outputs), ctx)
+
+
+def parse_config_and_serialize(config: Union[str, Callable], config_arg_str: str = "") -> str:
+    """Reference-named entry point: parse then serialize to text format."""
+    return proto.to_text(parse_config(config, config_arg_str).trainer_config)

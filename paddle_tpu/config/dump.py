@@ -1,0 +1,114 @@
+"""Layer graph → ModelConfig emission (dump_config parity).
+
+The reference's config_parser builds the protobuf as the DSL executes, doing
+shape inference per @config_layer class. Here the graph nodes already carry
+full shape-inference logic in their `forward`, so the emitter simply traces
+the network once on a synthetic batch (Topology.sample_batch) and reads every
+layer's concrete output shape and created parameters — one source of truth
+instead of two (python/paddle/utils/dump_config.py, config_parser.py:4208).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from paddle_tpu import proto
+from paddle_tpu.nn.graph import Context, Layer, Network
+from paddle_tpu.v2.topology import Topology
+
+
+def build_model_config(
+    topology: Union[Topology, Layer, Sequence[Layer]],
+    batch_size: int = 2,
+    seq_len: int = 8,
+) -> proto.ModelConfig:
+    if not isinstance(topology, Topology):
+        topology = Topology(topology)
+    net = topology.network
+
+    import jax
+
+    ctx = Context("init", {}, {}, jax.random.PRNGKey(0), train=False)
+    values = net._run(ctx, topology.sample_batch(batch_size, seq_len))
+
+    # group created parameters by owning layer (Context.param names them
+    # "{layer}.{pname}" unless shared via ParamAttr.name)
+    by_layer: Dict[str, Dict[str, str]] = {}
+    for full in ctx.params:
+        if "." in full:
+            lname, pname = full.rsplit(".", 1)
+            by_layer.setdefault(lname, {})[pname] = full
+
+    mc = proto.ModelConfig()
+    for layer in net.layer_order:
+        arg = values[layer.name]
+        shape = tuple(int(d) for d in arg.value.shape)
+        feat = shape[2:] if arg.is_seq else shape[1:]
+        size = int(np.prod(feat)) if feat else 1
+
+        lc = proto.LayerConfig(
+            name=layer.name,
+            type=layer.type_name,
+            size=size,
+            shape=list(feat),
+            active_type=layer.cfg.get("act"),
+            drop_rate=layer.cfg.get("dropout_rate"),
+        )
+        owned = by_layer.get(layer.name, {})
+        if "b" in owned:
+            lc.bias_parameter_name = owned.pop("b")
+        weight_names = sorted(owned.values())
+        for i, inp in enumerate(layer.inputs):
+            lic = proto.LayerInputConfig(input_layer_name=inp.name)
+            if i < len(weight_names):
+                lic.input_parameter_name = weight_names[i]
+            lc.inputs.append(lic)
+        # layer-specific scalars from the spec's cfg (filter_size, stride, ...)
+        for k, v in sorted(layer.cfg.items()):
+            if k in ("act", "dropout_rate", "param_attr", "bias_attr"):
+                continue
+            if isinstance(v, (int, float, bool, str)):
+                lc.attrs[k] = v
+            elif isinstance(v, (tuple, list)) and all(
+                isinstance(x, (int, float)) for x in v
+            ):
+                lc.attrs[k] = list(v)
+        mc.layers.append(lc)
+
+        if layer.type_name == "data":
+            mc.input_layer_names.append(layer.name)
+
+    mc.output_layer_names = [l.name for l in net.outputs]
+
+    for full, value in ctx.params.items():
+        attr = ctx.param_attrs.get(full)
+        pc = proto.ParameterConfig(
+            name=full,
+            size=int(np.prod(value.shape)),
+            dims=[int(d) for d in value.shape],
+        )
+        if attr is not None:
+            pc.learning_rate = attr.learning_rate
+            pc.momentum = attr.momentum
+            pc.decay_rate = attr.l2_decay
+            pc.decay_rate_l1 = attr.l1_decay
+            pc.initial_mean = attr.initial_mean
+            pc.initial_std = attr.initial_std
+            pc.is_static = attr.is_static
+            pc.is_sparse = attr.is_sparse
+            pc.gradient_clipping_threshold = attr.gradient_clipping_threshold
+            if attr.sharding:
+                pc.sharding = [a or "" for a in attr.sharding]
+        mc.parameters.append(pc)
+    return mc
+
+
+def dump_config(
+    topology: Union[Topology, Layer, Sequence[Layer]],
+    batch_size: int = 2,
+    seq_len: int = 8,
+) -> str:
+    """Text-format ModelConfig (python/paddle/utils/dump_config.py parity)."""
+    return proto.to_text(build_model_config(topology, batch_size, seq_len))

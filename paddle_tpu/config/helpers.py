@@ -1,0 +1,294 @@
+"""trainer_config_helpers surface — the v1 config-script DSL names.
+
+Parity with python/paddle/trainer_config_helpers/{layers.py, activations.py,
+poolings.py, attrs.py, evaluators.py, data_sources.py} (SURVEY §2.4): the
+classic `*_layer` constructors, activation/pooling tag classes, ParamAttr,
+evaluator declarations and `settings()`. Every constructor is the same graph
+node the v2 API builds (paddle_tpu.v2.layer), so v1 config scripts and v2
+programs produce identical networks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from paddle_tpu import proto
+from paddle_tpu.data import feeder as _feeder
+from paddle_tpu.v2 import layer as _v2
+from paddle_tpu.v2 import networks as _nets
+from paddle_tpu.v2.activation import (
+    Abs as AbsActivation,
+    BRelu as BReluActivation,
+    Exp as ExpActivation,
+    Linear as LinearActivation,
+    Log as LogActivation,
+    Relu as ReluActivation,
+    SequenceSoftmax as SequenceSoftmaxActivation,
+    Sigmoid as SigmoidActivation,
+    Softmax as SoftmaxActivation,
+    SoftRelu as SoftReluActivation,
+    Square as SquareActivation,
+    STanh as STanhActivation,
+    Tanh as TanhActivation,
+)
+from paddle_tpu.v2.attr import ExtraAttr as ExtraLayerAttribute
+from paddle_tpu.v2.attr import Param as ParamAttr
+from paddle_tpu.v2.pooling import Avg as AvgPooling
+from paddle_tpu.v2.pooling import Max as MaxPooling
+from paddle_tpu.v2.pooling import SquareRootN as SquareRootNPooling
+from paddle_tpu.v2.pooling import Sum as SumPooling
+from paddle_tpu.config.optimizers import (
+    AdaDeltaOptimizer,
+    AdaGradOptimizer,
+    AdamaxOptimizer,
+    AdamOptimizer,
+    DecayedAdaGradOptimizer,
+    GradientClippingThreshold,
+    L1Regularization,
+    L2Regularization,
+    ModelAverage,
+    MomentumOptimizer,
+    RmsPropOptimizer,
+    settings,
+)
+
+ParameterAttribute = ParamAttr
+
+# -- input types (PyDataProvider2.py:63-236) --------------------------------
+dense_vector = _feeder.dense_vector
+dense_array = _feeder.dense_array
+integer_value = _feeder.integer_value
+dense_vector_sequence = _feeder.dense_vector_sequence
+integer_value_sequence = _feeder.integer_value_sequence
+sparse_binary_vector = _feeder.sparse_binary_vector
+sparse_value_slot = _feeder.sparse_value_slot
+
+# -- layers (trainer_config_helpers/layers.py ~100 wrappers) ----------------
+data_layer = _v2.data
+fc_layer = _v2.fc
+embedding_layer = _v2.embedding
+img_conv_layer = _v2.img_conv
+img_pool_layer = _v2.img_pool
+batch_norm_layer = _v2.batch_norm
+dropout_layer = _v2.dropout
+addto_layer = _v2.addto
+concat_layer = _v2.concat
+seq_concat_layer = _v2.seq_concat
+lstmemory = _v2.lstmemory
+grumemory = _v2.grumemory
+recurrent_layer = _v2.recurrent
+gated_unit_layer = _v2.gated_unit
+pooling_layer = _v2.pool
+last_seq = _v2.last_seq
+first_seq = _v2.first_seq
+expand_layer = _v2.expand
+repeat_layer = _v2.repeat
+resize_layer = _v2.resize
+seq_reshape_layer = _v2.seq_reshape
+seq_slice_layer = _v2.seq_slice
+kmax_sequence_score_layer = _v2.kmax_seq_score
+sub_seq_layer = _v2.sub_seq
+cos_sim = _v2.cos_sim
+trans_layer = _v2.trans
+scaling_layer = _v2.scaling
+slope_intercept_layer = _v2.slope_intercept
+interpolation_layer = _v2.interpolation
+power_layer = _v2.power
+dot_prod_layer = _v2.dot_prod
+out_prod_layer = _v2.out_prod
+conv_shift_layer = _v2.conv_shift
+tensor_layer = _v2.tensor
+multiplex_layer = _v2.multiplex
+maxid_layer = _v2.max_id
+sampling_id_layer = _v2.sampling_id
+eos_layer = _v2.eos
+print_layer = _v2.print_layer
+clip_layer = _v2.clip
+scale_shift_layer = _v2.scale_shift
+prelu_layer = _v2.prelu
+maxout_layer = _v2.maxout
+spp_layer = _v2.spp
+img_cmrnorm_layer = _v2.img_cmrnorm
+sum_to_one_norm_layer = _v2.sum_to_one_norm
+row_l2_norm_layer = _v2.row_l2_norm
+cross_channel_norm_layer = _v2.cross_channel_norm
+data_norm_layer = _v2.data_norm
+bilinear_interp_layer = _v2.bilinear_interp
+pad_layer = _v2.pad
+crop_layer = _v2.crop
+rotate_layer = _v2.rotate
+switch_order_layer = _v2.switch_order
+block_expand_layer = _v2.block_expand
+row_conv_layer = _v2.row_conv
+selective_fc_layer = _v2.selective_fc
+bidirectional_lstm = _v2.bidirectional_lstm
+bidirectional_gru = _v2.bidirectional_gru
+simple_lstm = _v2.simple_lstm
+simple_gru = _v2.simple_gru
+
+# mixed layer + projections/operators
+mixed_layer = _v2.mixed
+full_matrix_projection = _v2.full_matrix_projection
+trans_full_matrix_projection = _v2.trans_full_matrix_projection
+identity_projection = _v2.identity_projection
+dotmul_projection = _v2.dotmul_projection
+table_projection = _v2.table_projection
+context_projection = _v2.context_projection
+scaling_projection = _v2.scaling_projection
+dotmul_operator = _v2.dotmul_operator
+
+# costs
+classification_cost = _v2.classification_cost
+cross_entropy = _v2.cross_entropy_cost
+cross_entropy_with_selfnorm = _v2.cross_entropy_with_selfnorm_cost
+multi_binary_label_cross_entropy = _v2.multi_binary_label_cross_entropy_cost
+soft_binary_class_cross_entropy = _v2.soft_binary_class_cross_entropy
+square_error_cost = _v2.square_error_cost
+regression_cost = _v2.square_error_cost
+mse_cost = _v2.square_error_cost
+huber_regression_cost = _v2.huber_regression_cost
+huber_classification_cost = _v2.huber_classification_cost
+smooth_l1_cost = _v2.smooth_l1_cost
+rank_cost = _v2.rank_cost
+lambda_cost = _v2.lambda_cost
+sum_cost = _v2.sum_cost
+crf_layer = _v2.crf
+crf_decoding_layer = _v2.crf_decoding
+ctc_layer = _v2.ctc
+warp_ctc_layer = _v2.warp_ctc
+nce_layer = _v2.nce
+hsigmoid = _v2.hsigmoid
+
+# detection
+priorbox_layer = _v2.priorbox
+multibox_loss_layer = _v2.multibox_loss
+detection_output_layer = _v2.detection_output
+
+# recurrent groups (nn/recurrent_group): the v1 dynamic-unroll API
+from paddle_tpu.v2.layer import (  # noqa: E402
+    recurrent_group,
+    memory,
+    StaticInput,
+    beam_search,
+    get_output_layer,
+)
+
+# prebuilt networks (trainer_config_helpers/networks.py)
+simple_img_conv_pool = _nets.simple_img_conv_pool
+img_conv_group = _nets.img_conv_group
+vgg_16_network = _nets.vgg_16_network
+text_conv_pool = _nets.text_conv_pool
+simple_attention = _nets.simple_attention
+
+
+# -- evaluator declarations (trainer_config_helpers/evaluators.py) ----------
+
+
+def _declare_evaluator(etype: str, *input_layers, name: Optional[str] = None, **_kw):
+    from paddle_tpu.config import config_parser as cp
+
+    cfg = proto.EvaluatorConfig(
+        name=name or f"__{etype}_{len(cp.g_context().evaluators)}__",
+        type=etype,
+        input_layers=[l.name for l in input_layers if l is not None],
+    )
+    cp.g_context().evaluators.append(cfg)
+    return cfg
+
+
+def classification_error_evaluator(input=None, label=None, name=None, **kw):
+    return _declare_evaluator("classification_error", input, label, name=name, **kw)
+
+
+def auc_evaluator(input=None, label=None, name=None, **kw):
+    return _declare_evaluator("auc", input, label, name=name, **kw)
+
+
+def precision_recall_evaluator(input=None, label=None, name=None, **kw):
+    return _declare_evaluator("precision_recall", input, label, name=name, **kw)
+
+
+def pnpair_evaluator(input=None, label=None, query_id=None, name=None, **kw):
+    return _declare_evaluator("pnpair", input, label, query_id, name=name, **kw)
+
+
+def sum_evaluator(input=None, name=None, **kw):
+    return _declare_evaluator("sum", input, name=name, **kw)
+
+
+def column_sum_evaluator(input=None, name=None, **kw):
+    return _declare_evaluator("column_sum", input, name=name, **kw)
+
+
+def chunk_evaluator(input=None, label=None, chunk_scheme="IOB", num_chunk_types=0, name=None, **kw):
+    cfg = _declare_evaluator("chunk", input, label, name=name)
+    return cfg
+
+
+def ctc_error_evaluator(input=None, label=None, name=None, **kw):
+    return _declare_evaluator("ctc_edit_distance", input, label, name=name, **kw)
+
+
+def detection_map_evaluator(input=None, label=None, name=None, **kw):
+    return _declare_evaluator("detection_map", input, label, name=name, **kw)
+
+
+__all__ = [
+    # attrs / activations / poolings
+    "ParamAttr", "ParameterAttribute", "ExtraLayerAttribute",
+    "LinearActivation", "SigmoidActivation", "SoftmaxActivation",
+    "SequenceSoftmaxActivation", "ReluActivation", "BReluActivation",
+    "TanhActivation", "STanhActivation", "SoftReluActivation", "AbsActivation",
+    "SquareActivation", "ExpActivation", "LogActivation",
+    "MaxPooling", "AvgPooling", "SumPooling", "SquareRootNPooling",
+    # input types
+    "dense_vector", "dense_array", "integer_value", "dense_vector_sequence",
+    "integer_value_sequence", "sparse_binary_vector", "sparse_value_slot",
+    # optimizers / settings
+    "settings", "MomentumOptimizer", "AdamOptimizer", "AdamaxOptimizer",
+    "AdaGradOptimizer", "DecayedAdaGradOptimizer", "AdaDeltaOptimizer",
+    "RmsPropOptimizer", "L1Regularization", "L2Regularization", "ModelAverage",
+    "GradientClippingThreshold",
+    # layers
+    "data_layer", "fc_layer", "embedding_layer", "img_conv_layer",
+    "img_pool_layer", "batch_norm_layer", "dropout_layer", "addto_layer",
+    "concat_layer", "seq_concat_layer", "lstmemory", "grumemory",
+    "recurrent_layer", "gated_unit_layer", "pooling_layer", "last_seq",
+    "first_seq", "expand_layer", "repeat_layer", "resize_layer",
+    "seq_reshape_layer", "seq_slice_layer", "kmax_sequence_score_layer",
+    "sub_seq_layer", "cos_sim", "trans_layer", "scaling_layer",
+    "slope_intercept_layer", "interpolation_layer", "power_layer",
+    "dot_prod_layer", "out_prod_layer", "conv_shift_layer", "tensor_layer",
+    "multiplex_layer", "maxid_layer", "sampling_id_layer", "eos_layer",
+    "print_layer", "clip_layer", "scale_shift_layer", "prelu_layer",
+    "maxout_layer", "spp_layer", "img_cmrnorm_layer", "sum_to_one_norm_layer",
+    "row_l2_norm_layer", "cross_channel_norm_layer", "data_norm_layer",
+    "bilinear_interp_layer", "pad_layer", "crop_layer", "rotate_layer",
+    "switch_order_layer", "block_expand_layer", "row_conv_layer",
+    "selective_fc_layer", "bidirectional_lstm", "bidirectional_gru",
+    "simple_lstm", "simple_gru",
+    # mixed
+    "mixed_layer", "full_matrix_projection", "trans_full_matrix_projection",
+    "identity_projection", "dotmul_projection", "table_projection",
+    "context_projection", "scaling_projection", "dotmul_operator",
+    # costs
+    "classification_cost", "cross_entropy", "cross_entropy_with_selfnorm",
+    "multi_binary_label_cross_entropy", "soft_binary_class_cross_entropy",
+    "square_error_cost", "regression_cost", "mse_cost",
+    "huber_regression_cost", "huber_classification_cost", "smooth_l1_cost",
+    "rank_cost", "lambda_cost", "sum_cost", "crf_layer", "crf_decoding_layer",
+    "ctc_layer", "warp_ctc_layer", "nce_layer", "hsigmoid",
+    # detection
+    "priorbox_layer", "multibox_loss_layer", "detection_output_layer",
+    # recurrent groups
+    "recurrent_group", "memory", "StaticInput", "beam_search",
+    "get_output_layer",
+    # networks
+    "simple_img_conv_pool", "img_conv_group", "vgg_16_network",
+    "text_conv_pool", "simple_attention",
+    # evaluators
+    "classification_error_evaluator", "auc_evaluator",
+    "precision_recall_evaluator", "pnpair_evaluator", "sum_evaluator",
+    "column_sum_evaluator", "chunk_evaluator", "ctc_error_evaluator",
+    "detection_map_evaluator",
+]

@@ -63,9 +63,13 @@ class DataProviderWrapper:
     ):
         self.generator = generator
         self.input_types = input_types
-        self.should_shuffle = True if should_shuffle is None else should_shuffle
+        # None keeps the reference semantics: shuffle during training only
+        # (PyDataProvider2.py provider(): should_shuffle=None → train-only)
+        self.should_shuffle = should_shuffle
         self.pool_size = pool_size
         self.min_pool_size = min_pool_size
+        self.can_over_batch_size = can_over_batch_size
+        self.calc_batch_size = calc_batch_size
         self.cache = cache
         self.init_hook = init_hook
         self.check = check
@@ -83,9 +87,17 @@ class DataProviderWrapper:
         return settings
 
     # -- iteration ----------------------------------------------------------
-    def __call__(self, obj=None, file_list: Union[str, Sequence[str], None] = None, **kwargs):
+    def __call__(
+        self,
+        obj=None,
+        file_list: Union[str, Sequence[str], None] = None,
+        is_train: bool = True,
+        **kwargs,
+    ):
         """Returns an iterator over samples from all files (shuffle-pooled like
-        the reference's pool_size window shuffle)."""
+        the reference's pool_size window shuffle). `is_train=False` (test /
+        inference readers) disables the default shuffle, matching the
+        reference's should_shuffle=None train-only semantics."""
         if isinstance(file_list, str):
             file_list = [file_list]
         file_list = list(file_list or [None])
@@ -117,8 +129,11 @@ class DataProviderWrapper:
                 self._pass_cache[cache_key] = collected
 
         it = iter_all()
-        if self.should_shuffle:
+        shuffle = is_train if self.should_shuffle is None else self.should_shuffle
+        if shuffle:
             pool = self.pool_size if self.pool_size > 0 else 1000
+            if self.min_pool_size > 0:
+                pool = max(pool, self.min_pool_size)
             self._epoch += 1
             return _pool_shuffle(it, pool, seed=self._epoch)
         return it
@@ -183,7 +198,9 @@ def _check_sample(input_types, sample) -> bool:
     if len(values) != len(specs):
         return False
     for v, spec in zip(values, specs):
-        if spec.kind == "index" and not np.isscalar(v):
+        if spec.kind == "index" and not (
+            np.isscalar(v) or (isinstance(v, np.ndarray) and v.ndim == 0)
+        ):
             return False
         if spec.kind == "dense":
             dim = spec.dim if isinstance(spec.dim, tuple) else (spec.dim,)
@@ -206,9 +223,11 @@ class MultiDataProvider:
         total = sum(r for _, r in self.entries)
         self.probs = [r / total for _, r in self.entries]
         self.seed = seed
+        self._epoch = 0  # vary the mixing order per pass
 
     def __call__(self):
-        rnd = random.Random(self.seed)
+        self._epoch += 1
+        rnd = random.Random(self.seed * 1000003 + self._epoch)
         iters = [iter(r()) for r, _ in self.entries]
         alive = list(range(len(iters)))
         while alive:

@@ -149,6 +149,10 @@ class Context:
         self.state_updates: Dict[str, Array] = {}
         self.param_attrs: Dict[str, ParamAttr] = {}
         self._rng_count = 0
+        # per-trace scratch for composite layers that compute several outputs
+        # at once (e.g. RecurrentGroup runs one scan shared by all its output
+        # nodes); keyed by (id(core), tag)
+        self.cache: Dict[Any, Any] = {}
 
     # -- rng ---------------------------------------------------------------
     def next_rng(self, tag: str) -> Array:

@@ -1,0 +1,560 @@
+"""recurrent_group / memory / beam_search — the v1 dynamic-unroll API.
+
+Parity with RecurrentGradientMachine (gserver/gradientmachines/
+RecurrentGradientMachine.h:32: per-timestep sub-network unrolling, memory
+links, generation + beam search) and the trainer_config_helpers surface
+(`recurrent_group`, `memory`, `StaticInput`, `GeneratedInput`, `beam_search`,
+`get_output_layer` — layers.py).
+
+TPU-native design: the reference builds a frame network per timestep on the
+host (dynamic topology). Here the user's `step` function is traced ONCE at
+graph-construction time into a static sub-graph of placeholder nodes; at
+runtime the whole unroll is a single `lax.scan` over the padded time axis with
+validity masking from sequence lengths — static shapes, one compiled program
+(SURVEY §7 hard-part (2)). Generation replaces the host-side frame loop with a
+scan carrying beam state (tokens/scores/memories), like nn/beam_search.py but
+for arbitrary user step nets.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.nn.graph import (
+    Argument,
+    Context,
+    Layer,
+    ParamAttr,
+    _topo_sort,
+)
+
+Array = jax.Array
+NEG_INF = -1e9
+
+
+# ---------------------------------------------------------------------------
+# step-net placeholders
+# ---------------------------------------------------------------------------
+
+
+class _Placeholder(Layer):
+    """A node whose value is injected by the owning group each timestep."""
+
+    type_name = "step_input"
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        raise RuntimeError(
+            f"placeholder {self.name} evaluated outside its recurrent group"
+        )
+
+
+class MemoryLayer(_Placeholder):
+    """`memory(name=X, size=...)`: value of step-layer X at t-1
+    (SubModelConfig memory links, ModelConfig.proto:608)."""
+
+    type_name = "memory"
+
+    def __init__(
+        self,
+        link_name: str,
+        size: int,
+        boot_layer: Optional[Layer] = None,
+        boot_bias: bool = False,
+        is_seq: bool = False,
+        name: Optional[str] = None,
+    ):
+        super().__init__(None, name=name)
+        self.link_name = link_name
+        self.size = size
+        self.boot_layer = boot_layer
+        self.boot_bias = boot_bias
+
+
+class StaticInput:
+    """Wrapper marking an outer-graph layer fed unchanged to every timestep
+    (layers.py StaticInput). is_seq=True feeds the full padded sequence —
+    the encoder-outputs-for-attention idiom."""
+
+    def __init__(self, input: Layer, is_seq: bool = False, size: Optional[int] = None):
+        self.input = input
+        self.is_seq = is_seq
+        self.size = size
+
+
+class GeneratedInput:
+    """Generation-time input: embedding of the previously generated token
+    (layers.py GeneratedInput)."""
+
+    def __init__(self, size: int, embedding_name: str, embedding_size: int):
+        self.size = size  # vocabulary size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+# ---------------------------------------------------------------------------
+# group build context: memory() must know the group being built
+# ---------------------------------------------------------------------------
+
+
+class _BuildCtx:
+    def __init__(self):
+        self.memories: List[MemoryLayer] = []
+
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def _building(bctx: _BuildCtx):
+    old = getattr(_tls, "bctx", None)
+    _tls.bctx = bctx
+    try:
+        yield
+    finally:
+        _tls.bctx = old
+
+
+def memory(
+    name: str,
+    size: int,
+    boot_layer: Optional[Layer] = None,
+    boot_bias: bool = False,
+    is_seq: bool = False,
+    **_compat,
+) -> MemoryLayer:
+    bctx = getattr(_tls, "bctx", None)
+    if bctx is None:
+        raise RuntimeError("memory() must be called inside a recurrent_group step")
+    m = MemoryLayer(name, size, boot_layer, boot_bias, is_seq)
+    bctx.memories.append(m)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# step sub-net evaluation
+# ---------------------------------------------------------------------------
+
+
+def _eval_subnet(
+    order: List[Layer], ctx: Context, seeded: Dict[str, Argument]
+) -> Dict[str, Argument]:
+    values = dict(seeded)
+    for layer in order:
+        if layer.name in values:
+            continue
+        if isinstance(layer, _Placeholder):
+            raise RuntimeError(f"unseeded placeholder {layer.name} in step net")
+        ins = [values[l.name] for l in layer.inputs]
+        values[layer.name] = layer.forward(ctx, ins)
+    return values
+
+
+class _GroupCore:
+    """Shared machinery: traces the user's step once, owns the scan."""
+
+    def __init__(
+        self,
+        step: Callable,
+        inputs: Sequence[Union[Layer, StaticInput, GeneratedInput]],
+        reverse: bool = False,
+    ):
+        self.reverse = reverse
+        self.seq_inputs: List[Layer] = []
+        self.static_inputs: List[StaticInput] = []
+        self.generated: Optional[GeneratedInput] = None
+
+        bctx = _BuildCtx()
+        step_args: List[Any] = []
+        self.placeholders: List[_Placeholder] = []
+        with _building(bctx):
+            for item in inputs if isinstance(inputs, (list, tuple)) else [inputs]:
+                if isinstance(item, StaticInput):
+                    ph = _Placeholder(None)
+                    ph.static = item
+                    self.static_inputs.append(item)
+                    self.placeholders.append(ph)
+                    step_args.append(ph)
+                elif isinstance(item, GeneratedInput):
+                    ph = _Placeholder(None)
+                    ph.static = None
+                    self.generated = item
+                    self.gen_placeholder = ph
+                    self.placeholders.append(ph)
+                    step_args.append(ph)
+                elif isinstance(item, Layer):
+                    ph = _Placeholder(None)
+                    ph.static = None
+                    self.seq_inputs.append(item)
+                    self.placeholders.append(ph)
+                    step_args.append(ph)
+                else:
+                    raise TypeError(f"bad recurrent_group input: {item!r}")
+            outs = step(*step_args)
+        self.memories: List[MemoryLayer] = bctx.memories
+        self.out_layers: List[Layer] = [outs] if isinstance(outs, Layer) else list(outs)
+
+        # resolve memory links: the step layer whose output feeds t+1
+        roots = list(self.out_layers)
+        self.order = _topo_sort(roots)
+        by_name = {l.name: l for l in self.order}
+        self.links: Dict[str, Layer] = {}
+        for m in self.memories:
+            link = by_name.get(m.link_name)
+            if link is None:
+                # the linked layer may only be reachable through the memory
+                # itself (pure self-recurrence outside the outputs); search
+                # again including all placeholders' consumers is not possible,
+                # so require it to be an output ancestor or an output itself
+                raise ValueError(
+                    f"memory links to {m.link_name!r} but no step layer has "
+                    f"that name (step outputs: {[l.name for l in self.out_layers]})"
+                )
+            self.links[m.name] = link
+
+    # -- helpers ------------------------------------------------------------
+    def outer_inputs(self) -> List[Layer]:
+        outer = list(self.seq_inputs) + [s.input for s in self.static_inputs]
+        outer += [m.boot_layer for m in self.memories if m.boot_layer is not None]
+        return outer
+
+    def split_outer(self, ins: List[Argument]):
+        n_seq = len(self.seq_inputs)
+        n_static = len(self.static_inputs)
+        seq = ins[:n_seq]
+        static = ins[n_seq : n_seq + n_static]
+        boots = ins[n_seq + n_static :]
+        boot_map: Dict[str, Argument] = {}
+        bi = 0
+        for m in self.memories:
+            if m.boot_layer is not None:
+                boot_map[m.name] = boots[bi]
+                bi += 1
+        return seq, static, boot_map
+
+    def seed_static(self, seeded: Dict[str, Argument], static_vals: List[Argument]):
+        si = 0
+        for ph in self.placeholders:
+            if getattr(ph, "static", None) is not None:
+                arg = static_vals[si]
+                seeded[ph.name] = arg if ph.static.is_seq else arg.as_non_seq()
+                si += 1
+
+    def init_carry(
+        self, ctx: Context, batch: int, boot_map: Dict[str, Argument]
+    ) -> Dict[str, Array]:
+        carry: Dict[str, Array] = {}
+        for m in self.memories:
+            if m.name in boot_map:
+                v = boot_map[m.name].value
+            else:
+                v = jnp.zeros((batch, m.size), jnp.float32)
+            if m.boot_bias:
+                b = ctx.param(
+                    m, "boot_b", (m.size,), lambda k, s, d: jnp.zeros(s, d),
+                    ParamAttr(),
+                )
+                v = v + b
+            carry[m.name] = v
+        return carry
+
+
+# ---------------------------------------------------------------------------
+# training-time group: scan over the padded time axis
+# ---------------------------------------------------------------------------
+
+
+class RecurrentGroup(Layer):
+    type_name = "recurrent_layer_group"
+
+    def __init__(self, core: _GroupCore, out_index: int, name: Optional[str] = None):
+        super().__init__(core.outer_inputs(), name=name)
+        self.core = core
+        self.out_index = out_index
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        key = (id(self.core), "train")
+        if key not in ctx.cache:
+            ctx.cache[key] = self._run_group(ctx, ins)
+        outs: Dict[str, Argument] = ctx.cache[key]
+        return outs[self.core.out_layers[self.out_index].name]
+
+    def _run_group(self, ctx: Context, ins: List[Argument]) -> Dict[str, Argument]:
+        core = self.core
+        seq, static, boot_map = core.split_outer(ins)
+        if not seq:
+            raise ValueError("recurrent_group needs at least one sequence input")
+        lengths = seq[0].lengths
+        if lengths is None:
+            raise ValueError("recurrent_group inputs must be sequences")
+        batch, t_max = seq[0].value.shape[:2]
+
+        seeded_static: Dict[str, Argument] = {}
+        core.seed_static(seeded_static, static)
+        carry0 = core.init_carry(ctx, batch, boot_map)
+
+        seq_phs = [
+            ph
+            for ph in core.placeholders
+            if getattr(ph, "static", None) is None
+        ]
+
+        def seed_t(xs_t: List[Array]) -> Dict[str, Argument]:
+            seeded = dict(seeded_static)
+            for ph, x in zip(seq_phs, xs_t):
+                seeded[ph.name] = Argument(x)
+            return seeded
+
+        out_names = [l.name for l in core.out_layers]
+
+        if ctx.mode == "init":
+            # one eager step creates all params; tile the result over time
+            seeded = seed_t([s.value[:, 0] for s in seq])
+            for m in core.memories:
+                seeded[m.name] = Argument(carry0[m.name])
+            values = _eval_subnet(core.order, ctx, seeded)
+            return {
+                n: Argument(
+                    jnp.repeat(values[n].value[:, None], t_max, axis=1), lengths
+                )
+                for n in out_names
+            }
+
+        # apply mode: one scan, masked carry updates on padded steps
+        ts = jnp.arange(t_max - 1, -1, -1) if core.reverse else jnp.arange(t_max)
+        keys0 = set(ctx.state_updates)
+
+        def body(carry: Dict[str, Array], t: Array):
+            seeded = seed_t([s.value[:, t] for s in seq])
+            for m in core.memories:
+                seeded[m.name] = Argument(carry[m.name])
+            values = _eval_subnet(core.order, ctx, seeded)
+            valid = (t < lengths)  # [B]
+            new_carry = {}
+            for m in core.memories:
+                new = values[core.links[m.name].name].value
+                old = carry[m.name]
+                mask = valid.reshape((-1,) + (1,) * (new.ndim - 1))
+                new_carry[m.name] = jnp.where(mask, new, old)
+            return new_carry, tuple(values[n].value for n in out_names)
+
+        _, stacked = lax.scan(body, carry0, ts)
+        # drop state updates traced inside the scan body (they'd leak tracers;
+        # stateful layers like BatchNorm are not supported in step nets, as in
+        # the reference's recurrent layer groups)
+        for k in list(ctx.state_updates):
+            if k not in keys0:
+                del ctx.state_updates[k]
+
+        outs: Dict[str, Argument] = {}
+        for n, ys in zip(out_names, stacked):
+            ys = jnp.swapaxes(ys, 0, 1)  # [B, T, ...]
+            if core.reverse:
+                ys = jnp.flip(ys, axis=1)
+            outs[n] = Argument(ys, lengths)
+        return outs
+
+
+def recurrent_group(
+    step: Callable,
+    input: Union[Layer, StaticInput, Sequence],
+    reverse: bool = False,
+    name: Optional[str] = None,
+    **_compat,
+) -> Layer:
+    """Build the group; returns the node for the step's first output. Extra
+    step outputs are reachable via get_output_layer."""
+    core = _GroupCore(step, input, reverse=reverse)
+    if core.generated is not None:
+        raise ValueError("GeneratedInput is only valid under beam_search")
+    node = RecurrentGroup(core, 0, name=name)
+    node._group_core = core
+    return node
+
+
+def get_output_layer(group: Layer, out_name: str, name: Optional[str] = None) -> Layer:
+    """Fetch another step-net output of a recurrent_group
+    (GetOutputLayer / get_output_layer parity)."""
+    core = getattr(group, "_group_core", None) or getattr(group, "core", None)
+    if core is None:
+        raise TypeError(f"{group!r} is not a recurrent_group output")
+    names = [l.name for l in core.out_layers]
+    if out_name not in names:
+        raise ValueError(f"step net has outputs {names}, not {out_name!r}")
+    node = RecurrentGroup(core, names.index(out_name), name=name)
+    node._group_core = core
+    return node
+
+
+# ---------------------------------------------------------------------------
+# generation: beam search over an arbitrary step net
+# ---------------------------------------------------------------------------
+
+
+class BeamSearchLayer(Layer):
+    """v1 beam_search(): generate with the traced step net.
+
+    Output Argument: value [B, max_length] int32 best-beam token ids,
+    lengths [B] (up to and including EOS). Scores for all beams are cached
+    under (id(core), "beam_scores") for SequenceGenerator-style access."""
+
+    type_name = "beam_search"
+
+    def __init__(
+        self,
+        core: _GroupCore,
+        bos_id: int,
+        eos_id: int,
+        beam_size: int,
+        max_length: int,
+        name: Optional[str] = None,
+    ):
+        super().__init__(core.outer_inputs(), name=name)
+        self.core = core
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.beam_size = beam_size
+        self.max_length = max_length
+
+    def _embed(self, ctx: Context, tokens: Array) -> Array:
+        gen = self.core.generated
+        table = ctx.param(
+            self,
+            "emb",
+            (gen.size, gen.embedding_size),
+            lambda k, s, d: 0.01 * jax.random.normal(k, s, d),
+            ParamAttr(name=gen.embedding_name),
+        )
+        return table[tokens]
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        core = self.core
+        if core.generated is None:
+            raise ValueError("beam_search step needs a GeneratedInput")
+        seq, static, boot_map = core.split_outer(ins)
+        if seq:
+            raise ValueError(
+                "beam_search inputs must be StaticInput/GeneratedInput only"
+            )
+        if static:
+            batch = static[0].value.shape[0]
+        elif boot_map:
+            batch = next(iter(boot_map.values())).value.shape[0]
+        else:
+            raise ValueError("beam_search needs a static or boot input for batch size")
+
+        k, L = self.beam_size, self.max_length
+        carry0 = core.init_carry(ctx, batch, boot_map)
+
+        if ctx.mode == "init":
+            seeded: Dict[str, Argument] = {}
+            core.seed_static(seeded, static)
+            seeded[core.gen_placeholder.name] = Argument(
+                self._embed(ctx, jnp.full((batch,), self.bos_id, jnp.int32))
+            )
+            for m in core.memories:
+                seeded[m.name] = Argument(carry0[m.name])
+            _eval_subnet(core.order, ctx, seeded)
+            return Argument(
+                jnp.zeros((batch, L), jnp.int32),
+                jnp.ones((batch,), jnp.int32),
+            )
+
+        # tile static inputs and carries across beams → batch axis B*K
+        def tile(x: Array) -> Array:
+            return jnp.repeat(x, k, axis=0)
+
+        static_tiled: Dict[str, Argument] = {}
+        core.seed_static(static_tiled, static)
+        static_tiled = {
+            n: Argument(
+                tile(a.value), None if a.lengths is None else tile(a.lengths)
+            )
+            for n, a in static_tiled.items()
+        }
+        carry_t = {n: tile(v) for n, v in carry0.items()}
+        vocab = core.generated.size
+        prob_layer = core.out_layers[0].name
+
+        tokens0 = jnp.full((batch, k), self.bos_id, jnp.int32)
+        scores0 = jnp.tile(
+            jnp.asarray([0.0] + [NEG_INF] * (k - 1), jnp.float32), (batch, 1)
+        )
+        finished0 = jnp.zeros((batch, k), bool)
+        history0 = jnp.zeros((batch, k, L), jnp.int32)
+
+        def gather_beams(x: Array, idx: Array) -> Array:
+            xb = x.reshape((batch, k) + x.shape[1:])
+            sel = jax.vmap(lambda xx, ii: xx[ii])(xb, idx)
+            return sel.reshape((batch * k,) + x.shape[1:])
+
+        def body(state, t):
+            tokens, scores, finished, history, carry = state
+            seeded = dict(static_tiled)
+            seeded[core.gen_placeholder.name] = Argument(
+                self._embed(ctx, tokens.reshape(-1))
+            )
+            for m in core.memories:
+                seeded[m.name] = Argument(carry[m.name])
+            values = _eval_subnet(core.order, ctx, seeded)
+            probs = values[prob_layer].value.reshape(batch, k, vocab)
+            logp = jnp.log(jnp.maximum(probs.astype(jnp.float32), 1e-20))
+            eos_only = jnp.full((vocab,), NEG_INF).at[self.eos_id].set(0.0)
+            logp = jnp.where(finished[:, :, None], eos_only[None, None, :], logp)
+            cand = (scores[:, :, None] + logp).reshape(batch, k * vocab)
+            top_scores, top_idx = lax.top_k(cand, k)
+            beam_idx = top_idx // vocab
+            tok_idx = (top_idx % vocab).astype(jnp.int32)
+
+            new_carry = {}
+            for m in core.memories:
+                nxt = values[core.links[m.name].name].value
+                new_carry[m.name] = gather_beams(nxt, beam_idx)
+            fin_sel = jax.vmap(lambda f, i: f[i])(finished, beam_idx)
+            hist_sel = jax.vmap(lambda h, i: h[i])(history, beam_idx)
+            hist_new = lax.dynamic_update_index_in_dim(
+                hist_sel.swapaxes(0, 2), tok_idx.swapaxes(0, 1), t, 0
+            ).swapaxes(0, 2)
+            new_finished = fin_sel | (tok_idx == self.eos_id)
+            return (
+                (tok_idx, top_scores, new_finished, hist_new, new_carry),
+                None,
+            )
+
+        keys0 = set(ctx.state_updates)
+        (tokens, scores, finished, history, _), _ = lax.scan(
+            body, (tokens0, scores0, finished0, history0, carry_t), jnp.arange(L)
+        )
+        for kk in list(ctx.state_updates):
+            if kk not in keys0:
+                del ctx.state_updates[kk]
+
+        best = jnp.argmax(scores, axis=-1)
+        ids = jax.vmap(lambda h, i: h[i])(history, best)  # [B, L]
+        is_eos = ids == self.eos_id
+        any_eos = jnp.any(is_eos, axis=-1)
+        first_eos = jnp.argmax(is_eos.astype(jnp.int32), axis=-1)
+        lengths = jnp.where(any_eos, first_eos + 1, L).astype(jnp.int32)
+        ctx.cache[(id(core), "beam_scores")] = scores
+        return Argument(ids, lengths)
+
+
+def beam_search(
+    step: Callable,
+    input: Sequence,
+    bos_id: int,
+    eos_id: int,
+    beam_size: int = 4,
+    max_length: int = 50,
+    name: Optional[str] = None,
+    **_compat,
+) -> Layer:
+    core = _GroupCore(step, input)
+    node = BeamSearchLayer(core, bos_id, eos_id, beam_size, max_length, name=name)
+    node._group_core = core
+    return node

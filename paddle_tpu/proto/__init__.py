@@ -1,0 +1,232 @@
+"""Config message layer — the contract between the Python config DSL and the
+runtime (reference: proto/ModelConfig.proto, TrainerConfig.proto,
+ParameterConfig.proto, DataConfig.proto; SURVEY §2.4).
+
+The reference compiles Python configs to protobuf and hands the bytes to C++
+(`parse_config_and_serialize`, config_parser.py:4208). Here the runtime is
+jax, so the wire format does not need protoc: these are plain dataclass
+messages with a protobuf-text-format serializer (`to_text`) and a dict form
+(`to_dict`) used by dump_config / merge_model / the C-API loader. Field names
+match the reference protos so dumped configs read like the originals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# generic text-format serialization
+# ---------------------------------------------------------------------------
+
+
+def _emit(value: Any, name: str, indent: int, out: List[str]) -> None:
+    pad = "  " * indent
+    if value is None:
+        return
+    if dataclasses.is_dataclass(value):
+        body: List[str] = []
+        for f in dataclasses.fields(value):
+            v = getattr(value, f.name)
+            if v is None or (isinstance(v, (list, dict)) and not v):
+                continue
+            _emit(v, f.name, indent + 1, body)
+        if body:
+            out.append(f"{pad}{name} {{")
+            out.extend(body)
+            out.append(f"{pad}}}")
+        else:
+            out.append(f"{pad}{name} {{}}")
+    elif isinstance(value, list):
+        for item in value:
+            _emit(item, name, indent, out)
+    elif isinstance(value, dict):
+        # free-form extras: emitted as key: value pairs under the field name
+        body = [f"{pad}  {k}: {_scalar(v)}" for k, v in sorted(value.items())]
+        out.append(f"{pad}{name} {{")
+        out.extend(body)
+        out.append(f"{pad}}}")
+    else:
+        out.append(f"{pad}{name}: {_scalar(value)}")
+
+
+def _scalar(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return json.dumps(v)
+    if isinstance(v, float):
+        return repr(v)
+    if isinstance(v, (tuple, list)):
+        return json.dumps(list(v))
+    return str(v)
+
+
+def to_text(msg: Any) -> str:
+    """Protobuf-text-format rendering of a message dataclass."""
+    out: List[str] = []
+    for f in dataclasses.fields(msg):
+        v = getattr(msg, f.name)
+        if v is None or (isinstance(v, (list, dict)) and not v):
+            continue
+        _emit(v, f.name, 0, out)
+    return "\n".join(out) + "\n"
+
+
+def to_dict(msg: Any) -> Dict[str, Any]:
+    return dataclasses.asdict(msg)
+
+
+# ---------------------------------------------------------------------------
+# ParameterConfig (proto/ParameterConfig.proto:34)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ParameterConfig:
+    name: str = ""
+    size: int = 0
+    dims: List[int] = field(default_factory=list)
+    learning_rate: float = 1.0
+    momentum: Optional[float] = None
+    decay_rate: Optional[float] = None      # L2
+    decay_rate_l1: Optional[float] = None
+    initial_mean: float = 0.0
+    initial_std: Optional[float] = None
+    is_static: bool = False
+    is_sparse: bool = False
+    sparse_remote_update: bool = False
+    gradient_clipping_threshold: Optional[float] = None
+    # TPU-native addition: logical mesh axes for pjit sharding, e.g. ["model", ""]
+    sharding: List[str] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# ModelConfig (proto/ModelConfig.proto:637 and friends)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ProjectionConfig:
+    type: str = ""
+    name: str = ""
+    input_size: int = 0
+    output_size: int = 0
+    context_start: Optional[int] = None
+    context_length: Optional[int] = None
+
+
+@dataclass
+class OperatorConfig:
+    type: str = ""
+    input_indices: List[int] = field(default_factory=list)
+    input_sizes: List[int] = field(default_factory=list)
+    output_size: int = 0
+
+
+@dataclass
+class LayerInputConfig:
+    input_layer_name: str = ""
+    input_parameter_name: Optional[str] = None
+    proj_conf: Optional[ProjectionConfig] = None
+
+
+@dataclass
+class LayerConfig:
+    name: str = ""
+    type: str = ""
+    size: int = 0
+    active_type: Optional[str] = None
+    inputs: List[LayerInputConfig] = field(default_factory=list)
+    bias_parameter_name: Optional[str] = None
+    drop_rate: Optional[float] = None
+    shape: List[int] = field(default_factory=list)  # full output shape sans batch
+    operator_confs: List[OperatorConfig] = field(default_factory=list)
+    # free-form layer-specific attributes (filter_size, stride, ...)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class EvaluatorConfig:
+    name: str = ""
+    type: str = ""
+    input_layers: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SubModelConfig:
+    name: str = ""
+    layer_names: List[str] = field(default_factory=list)
+    input_layer_names: List[str] = field(default_factory=list)
+    output_layer_names: List[str] = field(default_factory=list)
+    is_recurrent_layer_group: bool = False
+
+
+@dataclass
+class ModelConfig:
+    type: str = "nn"
+    layers: List[LayerConfig] = field(default_factory=list)
+    parameters: List[ParameterConfig] = field(default_factory=list)
+    input_layer_names: List[str] = field(default_factory=list)
+    output_layer_names: List[str] = field(default_factory=list)
+    evaluators: List[EvaluatorConfig] = field(default_factory=list)
+    sub_models: List[SubModelConfig] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# OptimizationConfig / TrainerConfig (proto/TrainerConfig.proto:21/:140)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OptimizationConfig:
+    batch_size: int = 1
+    algorithm: str = "sgd"
+    learning_method: str = "momentum"
+    learning_rate: float = 0.01
+    momentum: float = 0.0
+    learning_rate_decay_a: float = 0.0
+    learning_rate_decay_b: float = 0.0
+    learning_rate_schedule: str = "constant"
+    learning_rate_warmup_steps: int = 0
+    l1_weight_decay: float = 0.0
+    l2_weight_decay: float = 0.0
+    gradient_clipping_threshold: float = 0.0
+    average_window: float = 0.0
+    max_average_window: int = 0
+    ada_epsilon: float = 1e-6
+    ada_rou: float = 0.95
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_epsilon: float = 1e-8
+    # extra args threaded through to the optimizer constructor
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class DataConfig:
+    type: str = "py2"
+    files: Optional[str] = None
+    load_data_module: Optional[str] = None
+    load_data_object: Optional[str] = None
+    load_data_args: str = ""
+    async_load_data: bool = False
+
+
+@dataclass
+class TrainerConfig:
+    model_config: ModelConfig = field(default_factory=ModelConfig)
+    opt_config: OptimizationConfig = field(default_factory=OptimizationConfig)
+    data_config: Optional[DataConfig] = None
+    test_data_config: Optional[DataConfig] = None
+    save_dir: str = "./output"
+
+
+__all__ = [
+    "ParameterConfig", "ProjectionConfig", "OperatorConfig", "LayerInputConfig",
+    "LayerConfig", "EvaluatorConfig", "SubModelConfig", "ModelConfig",
+    "OptimizationConfig", "DataConfig", "TrainerConfig", "to_text", "to_dict",
+]

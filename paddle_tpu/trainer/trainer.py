@@ -169,7 +169,11 @@ class SGDTrainer:
             for batch_id, raw in enumerate(reader()):
                 # dict batches are already feed-ready (e.g. from a DoubleBuffer
                 # that ran the feeder on its prefetch thread)
-                batch = feeder(raw) if feeder is not None and not isinstance(raw, dict) else raw
+                batch = (
+                    feeder(raw)
+                    if feeder is not None and not isinstance(raw, dict)
+                    else _coerce_batch(raw)
+                )
                 if self.parallel is not None:
                     if not self.parallel.batch_divisible(batch):
                         # trailing partial batch not divisible by the mesh data
@@ -220,7 +224,11 @@ class SGDTrainer:
             self._eval_fn = self._make_eval()
         total, n = 0.0, 0
         for raw in reader():
-            batch = feeder(raw) if feeder is not None and not isinstance(raw, dict) else raw
+            batch = (
+                feeder(raw)
+                if feeder is not None and not isinstance(raw, dict)
+                else _coerce_batch(raw)
+            )
             if self.parallel is not None:
                 batch = self.parallel.shard_batch(batch)
             cost, _ = self._eval_fn(self.state, batch)
@@ -271,6 +279,24 @@ class SGDTrainer:
             # re-establish mesh placement (sharded head weights, replicated
             # slots) — plain asarray loads land unsharded otherwise
             self.state = self.parallel.shard_state(self.state)
+
+
+def _coerce_batch(batch: Dict[str, Any]) -> Dict[str, Any]:
+    """Make a dict batch feed-ready, failing fast on ragged/object slots
+    instead of letting the jitted step produce an opaque shape error."""
+    out: Dict[str, Any] = {}
+    for k, v in batch.items():
+        if isinstance(v, (np.ndarray, jax.Array)):
+            out[k] = v
+            continue
+        arr = np.asarray(v)
+        if arr.dtype == object:
+            raise ValueError(
+                f"batch slot {k!r} is ragged or non-numeric; feed it through a "
+                f"DataFeeder (which pads sequences) instead of a raw dict"
+            )
+        out[k] = arr
+    return out
 
 
 def _batch_size(batch: Dict[str, Any]) -> int:

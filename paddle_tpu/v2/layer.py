@@ -621,3 +621,20 @@ def _with_drop(node: Layer, layer_attr) -> Layer:
     if layer_attr is not None and getattr(layer_attr, "drop_rate", None):
         return L.Dropout(node, layer_attr.drop_rate, name=node.name + ".drop")
     return node
+
+
+# -- recurrent groups / generation (RecurrentGradientMachine parity) -------
+
+from paddle_tpu.nn.recurrent_group import (  # noqa: E402
+    GeneratedInput,
+    StaticInput,
+    beam_search,
+    get_output_layer,
+    memory,
+    recurrent_group,
+)
+
+__all__ += [
+    "recurrent_group", "memory", "StaticInput", "GeneratedInput",
+    "beam_search", "get_output_layer",
+]
