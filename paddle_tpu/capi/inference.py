@@ -9,6 +9,7 @@ any number of threads.
 
 from __future__ import annotations
 
+import os
 import tempfile
 from typing import Any, Dict, List, Optional
 
@@ -44,7 +45,10 @@ class InferenceMachine:
         with tempfile.NamedTemporaryFile("w", suffix="_conf.py", delete=False) as f:
             f.write(source)
             cfg_path = f.name
-        pc = parse_config(cfg_path, config_args, emit_proto=False)
+        try:
+            pc = parse_config(cfg_path, config_args, emit_proto=False)
+        finally:
+            os.unlink(cfg_path)
         return cls(pc.topology, params, states, pc.topology.make_feeder())
 
     # -- forward (capi/gradient_machine.h:73) -------------------------------

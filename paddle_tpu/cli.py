@@ -96,12 +96,21 @@ def _make_reader(dc: proto.DataConfig, batch_size: int, is_train: bool = True) -
 
 
 def cmd_train(args: argparse.Namespace) -> int:
+    use_tpu = args.use_gpu if args.use_gpu is not None else args.use_tpu
+    if not use_tpu:
+        # must happen before ANY jax import (jax reads JAX_PLATFORMS at
+        # import time); paddle_tpu.trainer/parallel import jax at module top.
+        # If something (e.g. a sitecustomize plugin) already imported jax,
+        # force the config back the way tests/conftest.py does.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        if "jax" in sys.modules:
+            sys.modules["jax"].config.update("jax_platforms", "cpu")
+
     from paddle_tpu.core import init_ctx
     from paddle_tpu.config import build_optimizer, parse_config
     from paddle_tpu.metrics.evaluators import EVALUATORS
     from paddle_tpu.trainer.trainer import SGDTrainer
 
-    use_tpu = args.use_gpu if args.use_gpu is not None else args.use_tpu
     init_ctx.init(
         use_tpu=use_tpu,
         trainer_count=args.trainer_count,
@@ -109,8 +118,6 @@ def cmd_train(args: argparse.Namespace) -> int:
         seed=args.seed,
         **({"dtype_policy": args.dtype} if args.dtype else {}),
     )
-    if not use_tpu:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     pc = parse_config(args.config, args.config_args, emit_proto=False)
     oc = pc.trainer_config.opt_config
@@ -219,7 +226,7 @@ def cmd_train(args: argparse.Namespace) -> int:
     trainer.train(
         reader,
         num_passes=args.num_passes,
-        event_handler=handler if (active or True) else None,
+        event_handler=handler,
         feeder=feeder,
         test_reader=test_reader,
         save_dir=args.save_dir,

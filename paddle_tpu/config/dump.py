@@ -19,6 +19,36 @@ from paddle_tpu.nn.graph import Context, Layer, Network
 from paddle_tpu.v2.topology import Topology
 
 
+_SKIP_ATTRS = {
+    "name", "type_name", "inputs", "cfg", "act", "param_attr", "bias_attr",
+    "data_type", "rate", "core",
+}
+
+
+def _scalar_attr(layer: Layer, *names: str):
+    for n in names:
+        v = getattr(layer, n, None)
+        if isinstance(v, (str, int, float, bool)):
+            return v
+    return None
+
+
+def _layer_attrs(layer: Layer) -> Dict[str, object]:
+    """Scalar/int-tuple hyperparameters from the spec's instance attributes
+    (layer constructors store e.g. filter_size/stride/padding as attributes)."""
+    out: Dict[str, object] = {}
+    for k, v in sorted(vars(layer).items()):
+        if k.startswith("_") or k in _SKIP_ATTRS:
+            continue
+        if isinstance(v, (bool, int, float, str)):
+            out[k] = v
+        elif isinstance(v, (tuple, list)) and v and all(
+            isinstance(x, (int, float)) for x in v
+        ):
+            out[k] = list(v)
+    return out
+
+
 def build_model_config(
     topology: Union[Topology, Layer, Sequence[Layer]],
     batch_size: int = 2,
@@ -53,8 +83,8 @@ def build_model_config(
             type=layer.type_name,
             size=size,
             shape=list(feat),
-            active_type=layer.cfg.get("act"),
-            drop_rate=layer.cfg.get("dropout_rate"),
+            active_type=_scalar_attr(layer, "act"),
+            drop_rate=_scalar_attr(layer, "rate", "dropout_rate"),
         )
         owned = by_layer.get(layer.name, {})
         if "b" in owned:
@@ -65,16 +95,10 @@ def build_model_config(
             if i < len(weight_names):
                 lic.input_parameter_name = weight_names[i]
             lc.inputs.append(lic)
-        # layer-specific scalars from the spec's cfg (filter_size, stride, ...)
-        for k, v in sorted(layer.cfg.items()):
-            if k in ("act", "dropout_rate", "param_attr", "bias_attr"):
-                continue
-            if isinstance(v, (int, float, bool, str)):
-                lc.attrs[k] = v
-            elif isinstance(v, (tuple, list)) and all(
-                isinstance(x, (int, float)) for x in v
-            ):
-                lc.attrs[k] = list(v)
+        # layer-specific scalars (filter_size, stride, ...): introspected from
+        # the spec's instance attributes — layer constructors store their
+        # hyperparameters as plain attributes, not via cfg kwargs
+        lc.attrs = _layer_attrs(layer)
         mc.layers.append(lc)
 
         if layer.type_name == "data":

@@ -1,0 +1,23 @@
+"""Native runtime (C++ csrc/ via ctypes): host memory pool, recordio dataset
+shards, elastic task master. SURVEY §2.1 paddle/memory, §2.2 go/master +
+recordio, §5 failure detection / checkpointed task queues."""
+
+from paddle_tpu.runtime.native import available
+from paddle_tpu.runtime import recordio
+from paddle_tpu.runtime.master import (
+    MasterClient,
+    MasterServer,
+    TaskMaster,
+    cluster_reader,
+)
+
+__all__ = [
+    "available", "recordio", "TaskMaster", "MasterServer", "MasterClient",
+    "cluster_reader",
+]
+
+
+def HostPool(*args, **kwargs):
+    from paddle_tpu.runtime.allocator import HostPool as _HostPool
+
+    return _HostPool(*args, **kwargs)

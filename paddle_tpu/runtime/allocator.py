@@ -1,0 +1,79 @@
+"""Host memory pool over the native buddy allocator (paddle/memory parity,
+memory.cc:61 GetGPUBuddyAllocator / detail/buddy_allocator.h:33).
+
+Serves numpy staging buffers for the feed path: `pool.ndarray(shape, dtype)`
+returns an array backed by pool memory so repeated batch assembly reuses the
+same arena instead of churning the Python heap."""
+
+from __future__ import annotations
+
+import ctypes as C
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.runtime import native
+
+
+class HostPool:
+    def __init__(self, total_bytes: int = 256 << 20, min_block: int = 256):
+        L = native.lib()
+        if L is None:
+            raise RuntimeError("native runtime unavailable (g++ build failed?)")
+        self._lib = L
+        self._pool = L.pt_pool_create(min_block, total_bytes)
+        if not self._pool:
+            raise MemoryError(f"cannot create {total_bytes}-byte host pool")
+        self._live: Dict[int, int] = {}  # addr -> nbytes
+
+    def alloc(self, nbytes: int) -> int:
+        addr = self._lib.pt_pool_alloc(self._pool, nbytes)
+        if not addr:
+            raise MemoryError(f"host pool exhausted allocating {nbytes} bytes")
+        self._live[addr] = nbytes
+        return addr
+
+    def free(self, addr: int) -> None:
+        if self._lib.pt_pool_free(self._pool, addr) != 0:
+            raise ValueError(f"invalid free of {addr:#x}")
+        self._live.pop(addr, None)
+
+    def ndarray(self, shape: Sequence[int], dtype=np.float32) -> np.ndarray:
+        """A numpy array over pool memory. Call release(arr) when done."""
+        dt = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        addr = self.alloc(max(nbytes, 1))
+        buf = (C.c_char * nbytes).from_address(addr)
+        arr = np.frombuffer(buf, dtype=dt).reshape(shape)
+        arr.flags.writeable = True
+        self._live[addr] = nbytes
+        return arr
+
+    def release(self, arr: np.ndarray) -> None:
+        # the view's data pointer is the pool block's base address
+        addr = arr.__array_interface__["data"][0]
+        if addr not in self._live:
+            raise ValueError("array was not allocated from this pool")
+        self.free(addr)
+
+    def stats(self) -> Dict[str, int]:
+        out = (C.c_uint64 * 5)()
+        self._lib.pt_pool_stats(self._pool, out)
+        return {
+            "arena_bytes": out[0],
+            "in_use": out[1],
+            "peak": out[2],
+            "n_allocs": out[3],
+            "n_frees": out[4],
+        }
+
+    def close(self) -> None:
+        if self._pool:
+            self._lib.pt_pool_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

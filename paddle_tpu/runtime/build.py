@@ -1,0 +1,64 @@
+"""Builds csrc/ into libpaddle_tpu_rt.so on first use (cached by mtime).
+
+The reference ships its native runtime as CMake targets; here the library is
+small enough that a single g++ invocation at import keeps the source tree the
+only build input. Set PADDLE_TPU_NO_NATIVE=1 to skip (pure-Python fallbacks
+are used where they exist)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CSRC = os.path.join(_REPO, "csrc")
+OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
+SO_PATH = os.path.join(OUT_DIR, "libpaddle_tpu_rt.so")
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(SO_PATH)
+    for fn in os.listdir(CSRC):
+        if fn.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(CSRC, fn)) > so_mtime:
+                return True
+    return False
+
+
+def ensure_built(verbose: bool = False) -> Optional[str]:
+    """Compile if needed; returns the .so path or None when unavailable."""
+    if os.environ.get("PADDLE_TPU_NO_NATIVE"):
+        return None
+    if not os.path.isdir(CSRC):
+        return None
+    if not _needs_build():
+        return SO_PATH
+    os.makedirs(OUT_DIR, exist_ok=True)
+    sources = sorted(
+        os.path.join(CSRC, f) for f in os.listdir(CSRC) if f.endswith(".cc")
+    )
+    tmp = SO_PATH + f".tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+        "-o", tmp, *sources,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        if verbose:
+            print(f"native build unavailable: {e}", file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        if verbose:
+            print(f"native build failed:\n{proc.stderr}", file=sys.stderr)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    os.replace(tmp, SO_PATH)
+    return SO_PATH

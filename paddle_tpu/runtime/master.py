@@ -1,0 +1,272 @@
+"""Elastic task master — go/master parity (SURVEY §2.2, §5 failure recovery).
+
+TaskMaster wraps the native dispatcher (csrc/master.cc): todo/pending/done
+queues, lease timeouts with re-queue, failureMax discard, snapshot/restore.
+MasterServer exposes it over TCP (newline-delimited JSON — the Go master's
+net/rpc role) so multi-host trainers share one queue; MasterClient +
+`cluster_reader` replace python/paddle/v2/master/client.py:15 (the ctypes→Go
+reader shim): trainers are stateless task consumers pulling recordio shard
+lists."""
+
+from __future__ import annotations
+
+import ctypes as C
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+from paddle_tpu.runtime import native
+from paddle_tpu.runtime import recordio
+
+
+class TaskMaster:
+    """In-process dispatcher. Payload per task = newline-joined shard paths."""
+
+    PASS_FINISHED = -2
+
+    def __init__(self, timeout_s: float = 60.0, failure_max: int = 3):
+        L = native.lib()
+        if L is None:
+            raise RuntimeError("native runtime unavailable (g++ build failed?)")
+        self._lib = L
+        self._m = L.pt_master_create(timeout_s, failure_max)
+        self._buf = C.create_string_buffer(1 << 20)
+
+    def set_dataset(
+        self, shard_paths: Sequence[str], chunks_per_task: int = 1
+    ) -> None:
+        """Group shards into tasks of `chunks_per_task` (go master
+        NewService(chunksPerTask), service.go:140)."""
+        payloads: List[str] = []
+        group: List[str] = []
+        for p in shard_paths:
+            group.append(p)
+            if len(group) >= chunks_per_task:
+                payloads.append("\n".join(group))
+                group = []
+        if group:
+            payloads.append("\n".join(group))
+        blob = b"".join(p.encode() + b"\0" for p in payloads)
+        self._lib.pt_master_set_dataset(self._m, blob, len(payloads))
+
+    def get_task(self) -> Optional[tuple]:
+        """→ (task_id, [shard paths]) | None (retry later) | raises StopIteration
+        on pass end? No — returns ('pass_finished') sentinel via id==-2."""
+        tid = self._lib.pt_master_get_task(self._m, self._buf, len(self._buf))
+        while tid == -3:  # buffer too small: grow until the payload fits
+            self._buf = C.create_string_buffer(len(self._buf) * 4)
+            tid = self._lib.pt_master_get_task(self._m, self._buf, len(self._buf))
+        if tid < 0:
+            return None if tid == -1 else (self.PASS_FINISHED, [])
+        return int(tid), self._buf.value.decode().split("\n")
+
+    def task_finished(self, task_id: int) -> bool:
+        return self._lib.pt_master_task_finished(self._m, task_id) == 0
+
+    def task_failed(self, task_id: int) -> bool:
+        return self._lib.pt_master_task_failed(self._m, task_id) == 0
+
+    def pass_finished(self, start_next: bool = False) -> bool:
+        return self._lib.pt_master_pass_finished(self._m, int(start_next)) == 1
+
+    def stats(self) -> dict:
+        out = (C.c_int64 * 5)()
+        self._lib.pt_master_stats(self._m, out)
+        return {
+            "todo": out[0], "pending": out[1], "done": out[2],
+            "discarded": out[3], "pass": out[4],
+        }
+
+    def snapshot(self, path: str) -> None:
+        if self._lib.pt_master_snapshot(self._m, path.encode()) != 0:
+            raise OSError(f"snapshot to {path} failed")
+
+    def restore(self, path: str) -> None:
+        if self._lib.pt_master_restore(self._m, path.encode()) != 0:
+            raise OSError(f"restore from {path} failed")
+
+    def close(self) -> None:
+        if self._m:
+            self._lib.pt_master_destroy(self._m)
+            self._m = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# TCP service (the Go master's RPC role), newline-delimited JSON
+# ---------------------------------------------------------------------------
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        master: TaskMaster = self.server.master  # type: ignore[attr-defined]
+        lock: threading.Lock = self.server.master_lock  # type: ignore[attr-defined]
+        snapshot_path = self.server.snapshot_path  # type: ignore[attr-defined]
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError:
+                self._reply({"err": "bad json"})
+                continue
+            method = req.get("method")
+            with lock:
+                if method == "get_task":
+                    got = master.get_task()
+                    if got is None:
+                        resp = {"retry": True}
+                    elif got[0] == TaskMaster.PASS_FINISHED:
+                        resp = {"pass_finished": True}
+                    else:
+                        resp = {"task_id": got[0], "shards": got[1]}
+                elif method == "task_finished":
+                    ok = master.task_finished(int(req["task_id"]))
+                    resp = {"ok": ok}
+                    if snapshot_path:
+                        try:
+                            master.snapshot(snapshot_path)
+                        except OSError:
+                            pass
+                elif method == "task_failed":
+                    resp = {"ok": master.task_failed(int(req["task_id"]))}
+                elif method == "set_dataset":
+                    master.set_dataset(
+                        req["shards"], int(req.get("chunks_per_task", 1))
+                    )
+                    resp = {"ok": True}
+                elif method == "pass_finished":
+                    resp = {
+                        "finished": master.pass_finished(
+                            bool(req.get("start_next", False))
+                        )
+                    }
+                elif method == "stats":
+                    resp = master.stats()
+                else:
+                    resp = {"err": f"unknown method {method!r}"}
+            self._reply(resp)
+
+    def _reply(self, obj: Any) -> None:
+        self.wfile.write(json.dumps(obj).encode() + b"\n")
+        self.wfile.flush()
+
+
+class MasterServer:
+    """Threaded TCP wrapper; start()/stop(); port 0 picks a free port (the
+    reference's in-process-localhost test idiom, test_CompareSparse.cpp:65)."""
+
+    def __init__(
+        self,
+        master: Optional[TaskMaster] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        snapshot_path: Optional[str] = None,
+    ):
+        self.master = master or TaskMaster()
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True
+        )
+        self._srv.daemon_threads = True
+        self._srv.master = self.master  # type: ignore[attr-defined]
+        self._srv.master_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._srv.snapshot_path = snapshot_path  # type: ignore[attr-defined]
+        if snapshot_path and os.path.exists(snapshot_path):
+            self.master.restore(snapshot_path)  # crash recovery (service.go:166)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> tuple:
+        return self._srv.server_address
+
+    def start(self) -> "MasterServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class MasterClient:
+    """Blocking line-JSON client with reconnect (go/master/client.go parity)."""
+
+    def __init__(self, address: tuple, timeout: float = 30.0):
+        self.address = tuple(address)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+
+    def _connect(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(self.address, timeout=self.timeout)
+            self._rfile = self._sock.makefile("rb")
+
+    def call(self, method: str, **kw) -> dict:
+        last_err: Optional[Exception] = None
+        for _ in range(3):  # auto-reconnect like the Go client
+            try:
+                self._connect()
+                msg = json.dumps({"method": method, **kw}).encode() + b"\n"
+                self._sock.sendall(msg)
+                line = self._rfile.readline()
+                if not line:
+                    raise ConnectionError("master closed connection")
+                return json.loads(line)
+            except (OSError, ConnectionError, json.JSONDecodeError) as e:
+                last_err = e
+                self.close()
+                time.sleep(0.1)
+        raise ConnectionError(f"master RPC {method} failed: {last_err}")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._rfile = None
+
+
+def cluster_reader(
+    master_address: tuple,
+    deserialize: Callable[[bytes], Any] = None,
+    poll_interval: float = 0.5,
+) -> Callable[[], Iterator[Any]]:
+    """v2 cluster reader (master/client.py:15): pull tasks from the master,
+    stream their recordio shards, ack on completion, report failures. One
+    call of the returned reader = one pass."""
+    import pickle
+
+    deserialize = deserialize or pickle.loads
+
+    def reader() -> Iterator[Any]:
+        client = MasterClient(master_address)
+        try:
+            while True:
+                resp = client.call("get_task")
+                if resp.get("pass_finished"):
+                    return
+                if resp.get("retry"):
+                    time.sleep(poll_interval)
+                    continue
+                task_id, shards = resp["task_id"], resp["shards"]
+                try:
+                    yield from recordio.read_shards(shards, deserialize)
+                except Exception:
+                    client.call("task_failed", task_id=task_id)
+                    raise
+                client.call("task_finished", task_id=task_id)
+        finally:
+            client.close()
+
+    return reader

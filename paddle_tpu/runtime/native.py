@@ -1,0 +1,79 @@
+"""ctypes bindings for libpaddle_tpu_rt (csrc/).
+
+The reference binds its native core via SWIG/pybind11; here the C ABI +
+ctypes avoids a binding-generator dependency (pybind11 is not in the image)
+while keeping the runtime genuinely native."""
+
+from __future__ import annotations
+
+import ctypes as C
+from typing import Optional
+
+from paddle_tpu.runtime.build import ensure_built
+
+_lib: Optional[C.CDLL] = None
+_tried = False
+
+
+def lib() -> Optional[C.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    so = ensure_built()
+    if so is None:
+        return None
+    L = C.CDLL(so)
+    # allocator
+    L.pt_pool_create.restype = C.c_void_p
+    L.pt_pool_create.argtypes = [C.c_size_t, C.c_size_t]
+    L.pt_pool_alloc.restype = C.c_void_p
+    L.pt_pool_alloc.argtypes = [C.c_void_p, C.c_size_t]
+    L.pt_pool_free.restype = C.c_int
+    L.pt_pool_free.argtypes = [C.c_void_p, C.c_void_p]
+    L.pt_pool_stats.restype = None
+    L.pt_pool_stats.argtypes = [C.c_void_p, C.POINTER(C.c_uint64)]
+    L.pt_pool_destroy.restype = None
+    L.pt_pool_destroy.argtypes = [C.c_void_p]
+    # recordio
+    L.pt_recordio_writer_open.restype = C.c_void_p
+    L.pt_recordio_writer_open.argtypes = [C.c_char_p, C.c_int, C.c_size_t]
+    L.pt_recordio_write.restype = C.c_int
+    L.pt_recordio_write.argtypes = [C.c_void_p, C.c_char_p, C.c_uint64]
+    L.pt_recordio_writer_close.restype = C.c_int
+    L.pt_recordio_writer_close.argtypes = [C.c_void_p]
+    L.pt_recordio_reader_open.restype = C.c_void_p
+    L.pt_recordio_reader_open.argtypes = [C.c_char_p]
+    L.pt_recordio_next.restype = C.c_int64
+    L.pt_recordio_next.argtypes = [C.c_void_p, C.POINTER(C.c_void_p)]
+    L.pt_recordio_errors.restype = C.c_uint64
+    L.pt_recordio_errors.argtypes = [C.c_void_p]
+    L.pt_recordio_reader_close.restype = None
+    L.pt_recordio_reader_close.argtypes = [C.c_void_p]
+    # master
+    L.pt_master_create.restype = C.c_void_p
+    L.pt_master_create.argtypes = [C.c_double, C.c_int]
+    L.pt_master_set_dataset.restype = None
+    L.pt_master_set_dataset.argtypes = [C.c_void_p, C.c_char_p, C.c_int]
+    L.pt_master_get_task.restype = C.c_int64
+    L.pt_master_get_task.argtypes = [C.c_void_p, C.c_char_p, C.c_int64]
+    L.pt_master_task_finished.restype = C.c_int
+    L.pt_master_task_finished.argtypes = [C.c_void_p, C.c_int64]
+    L.pt_master_task_failed.restype = C.c_int
+    L.pt_master_task_failed.argtypes = [C.c_void_p, C.c_int64]
+    L.pt_master_pass_finished.restype = C.c_int
+    L.pt_master_pass_finished.argtypes = [C.c_void_p, C.c_int]
+    L.pt_master_stats.restype = None
+    L.pt_master_stats.argtypes = [C.c_void_p, C.POINTER(C.c_int64)]
+    L.pt_master_snapshot.restype = C.c_int
+    L.pt_master_snapshot.argtypes = [C.c_void_p, C.c_char_p]
+    L.pt_master_restore.restype = C.c_int
+    L.pt_master_restore.argtypes = [C.c_void_p, C.c_char_p]
+    L.pt_master_destroy.restype = None
+    L.pt_master_destroy.argtypes = [C.c_void_p]
+    _lib = L
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None

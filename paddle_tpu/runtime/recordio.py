@@ -1,0 +1,217 @@
+"""RecordIO chunked dataset files (csrc/recordio.cc; Go recordio parity).
+
+The v2 dataset pipeline's `convert` (python/paddle/v2/dataset/common.py)
+shards datasets into recordio chunks that the elastic master hands out as
+tasks. Native reader/writer via ctypes with a pure-Python implementation of
+the SAME on-disk format as fallback (and as the cross-check oracle in tests,
+the CPU-reference idiom of SURVEY §4)."""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+from paddle_tpu.runtime import native
+
+_MAGIC = 0x50545243  # "PTRC"
+_HEAD = struct.Struct("<IIII")
+_LEN = struct.Struct("<I")
+
+
+class Writer:
+    """Writes length-prefixed records into CRC-checked chunks."""
+
+    def __init__(self, path: str, chunk_records: int = 1000, chunk_bytes: int = 8 << 20):
+        self._native = None
+        self._py = None
+        L = native.lib()
+        if L is not None:
+            h = L.pt_recordio_writer_open(
+                path.encode(), chunk_records, chunk_bytes
+            )
+            if not h:
+                raise OSError(f"cannot open {path} for writing")
+            self._native = (L, h)
+        else:
+            self._py = _PyWriter(path, chunk_records, chunk_bytes)
+
+    def write(self, record: bytes) -> None:
+        if self._native is not None:
+            L, h = self._native
+            if L.pt_recordio_write(h, record, len(record)) != 0:
+                raise OSError("recordio write failed")
+        else:
+            self._py.write(record)
+
+    def close(self) -> None:
+        if self._native is not None:
+            L, h = self._native
+            self._native = None
+            if L.pt_recordio_writer_close(h) != 0:
+                raise OSError("recordio close failed")
+        elif self._py is not None:
+            self._py.close()
+            self._py = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Reader:
+    """Iterates records; corrupt chunks are skipped and counted."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._native = None
+        self._py = None
+        L = native.lib()
+        if L is not None:
+            h = L.pt_recordio_reader_open(path.encode())
+            if not h:
+                raise OSError(f"cannot open {path}")
+            self._native = (L, h)
+        else:
+            self._py_error_box = [0]
+            self._py = _py_read(path, self._py_error_box)
+
+    def __iter__(self) -> Iterator[bytes]:
+        if self._native is not None:
+            L, h = self._native
+            out = C.c_void_p()
+            while True:
+                n = L.pt_recordio_next(h, C.byref(out))
+                if n < 0:
+                    return
+                yield C.string_at(out.value, n)
+        else:
+            yield from self._py
+
+    @property
+    def errors(self) -> int:
+        if self._native is not None:
+            L, h = self._native
+            return int(L.pt_recordio_errors(h))
+        return self._py_error_box[0]
+
+    def close(self) -> None:
+        if self._native is not None:
+            L, h = self._native
+            self._native = None
+            L.pt_recordio_reader_close(h)
+
+
+# -- pure-Python same-format implementation ---------------------------------
+
+
+class _PyWriter:
+    def __init__(self, path: str, chunk_records: int, chunk_bytes: int):
+        self.f = open(path, "wb")
+        self.chunk_records = chunk_records
+        self.chunk_bytes = chunk_bytes
+        self.pending: List[bytes] = []
+        self.pending_bytes = 0
+
+    def write(self, record: bytes) -> None:
+        self.pending.append(record)
+        self.pending_bytes += len(record)
+        if (
+            len(self.pending) >= self.chunk_records
+            or self.pending_bytes >= self.chunk_bytes
+        ):
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self.pending:
+            return
+        data = b"".join(_LEN.pack(len(r)) + r for r in self.pending)
+        self.f.write(
+            _HEAD.pack(_MAGIC, len(self.pending), len(data), zlib.crc32(data))
+        )
+        self.f.write(data)
+        self.pending, self.pending_bytes = [], 0
+
+    def close(self) -> None:
+        self._flush()
+        self.f.close()
+
+
+def _py_read(path: str, error_box: Optional[List[int]] = None) -> Iterator[bytes]:
+    """Same skip-and-count corrupt-chunk semantics as the native reader;
+    error_box[0] (when given) accumulates the bad-chunk count."""
+
+    def bad() -> None:
+        if error_box is not None:
+            error_box[0] += 1
+
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(_HEAD.size)
+            if len(head) < _HEAD.size:
+                return
+            magic, n_rec, data_len, crc = _HEAD.unpack(head)
+            if magic != _MAGIC:
+                bad()  # framing lost: stop rather than scan (native parity)
+                return
+            data = f.read(data_len)
+            if len(data) < data_len:
+                bad()
+                return
+            if zlib.crc32(data) != crc:
+                bad()
+                continue  # skip corrupt chunk
+            off = 0
+            for _ in range(n_rec):
+                (ln,) = _LEN.unpack_from(data, off)
+                off += _LEN.size
+                yield data[off : off + ln]
+                off += ln
+
+
+# -- dataset conversion (python/paddle/v2/dataset convert parity) -----------
+
+
+def convert(
+    output_dir: str,
+    reader: Callable[[], Iterable[Any]],
+    records_per_file: int = 4096,
+    prefix: str = "shard",
+    serialize: Callable[[Any], bytes] = lambda s: pickle.dumps(s, protocol=4),
+) -> List[str]:
+    """Shard a sample reader into recordio files; returns the shard paths."""
+    os.makedirs(output_dir, exist_ok=True)
+    paths: List[str] = []
+    w: Optional[Writer] = None
+    count = 0
+    for sample in reader():
+        if w is None:
+            p = os.path.join(output_dir, f"{prefix}-{len(paths):05d}.recordio")
+            paths.append(p)
+            w = Writer(p)
+        w.write(serialize(sample))
+        count += 1
+        if count >= records_per_file:
+            w.close()
+            w, count = None, 0
+    if w is not None:
+        w.close()
+    return paths
+
+
+def read_shards(
+    paths: Iterable[str],
+    deserialize: Callable[[bytes], Any] = pickle.loads,
+) -> Iterator[Any]:
+    for p in paths:
+        r = Reader(p)
+        try:
+            for rec in r:
+                yield deserialize(rec)
+        finally:
+            r.close()
