@@ -1,0 +1,39 @@
+"""Pallas TPU kernels for the hot fused ops (SURVEY §7: "Pallas kernels only
+where fusion matters — LSTM/GRU step").
+
+Dispatch policy: `enabled()` is on when running on TPU (or when
+PADDLE_TPU_PALLAS=1/interpret is forced); the lax.scan implementations in
+ops/rnn.py remain the oracle and the fallback for exotic activations /
+peepholes."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def _flag() -> str:
+    return os.environ.get("PADDLE_TPU_PALLAS", "auto").lower()
+
+
+def enabled() -> bool:
+    f = _flag()
+    if f in ("0", "off", "false"):
+        return False
+    if f in ("1", "on", "true", "interpret"):
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def interpret_mode() -> bool:
+    """Interpret on non-TPU backends so the same kernels are testable on CPU."""
+    if _flag() == "interpret":
+        return True
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True

@@ -1,0 +1,456 @@
+"""Fused whole-sequence LSTM/GRU Pallas kernels.
+
+Parity target: hl_cuda_lstm.cu (all four gates fused per step, 872 LoC) and
+hl_gpu_gru.cuh. TPU design: ONE pallas_call runs the entire time loop as a
+sequential grid over T; the recurrent state (h, c) lives in VMEM scratch for
+the whole sequence — zero HBM round-trips for the carry, one [B,H]x[H,4H]
+MXU matmul per step, VPU for the gate math. The backward pass is a second
+kernel walking the grid in reverse, accumulating dW in VMEM scratch.
+
+Time-major layout [T, B, ...] so each grid step's block is one timestep.
+Activations are fixed sigmoid/tanh (the reference's defaults); layers with
+exotic activations or peepholes use the lax.scan path (ops/rnn.py), which is
+also the numerical oracle for these kernels' tests."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas import interpret_mode
+
+Array = jax.Array
+
+
+def _sig(x):
+    return jax.nn.sigmoid(x)
+
+
+# ===========================================================================
+# LSTM
+# ===========================================================================
+
+
+def _lstm_fwd_kernel(proj_ref, mask_ref, whh_ref, b_ref, h0_ref, c0_ref,
+                     hs_ref, gates_ref, ct_ref, h_scr, c_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h = h_scr[:]
+    c = c_scr[:]
+    gates = proj_ref[0] + jnp.dot(
+        h, whh_ref[:], preferred_element_type=jnp.float32
+    ) + b_ref[:]
+    hdim = h.shape[-1]
+    i = _sig(gates[:, :hdim])
+    f = _sig(gates[:, hdim : 2 * hdim])
+    g = jnp.tanh(gates[:, 2 * hdim : 3 * hdim])
+    o = _sig(gates[:, 3 * hdim :])
+    c_tilde = f * c + i * g
+    h_tilde = o * jnp.tanh(c_tilde)
+    m = mask_ref[0]
+    h_new = m * h_tilde + (1.0 - m) * h
+    c_new = m * c_tilde + (1.0 - m) * c
+    # saved for backward: post-activation gates + pre-mask cell
+    gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1)
+    ct_ref[0] = c_tilde
+    hs_ref[0] = h_new
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+
+
+def _lstm_bwd_kernel(gates_ref, ct_ref, hprev_ref, cprev_ref, mask_ref,
+                     whh_ref, dhs_ref, dhlast_ref, dclast_ref,
+                     dproj_ref, dw_ref, db_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr, dw_scr, db_scr):
+    ti = pl.program_id(0)  # 0 .. T-1, walking t = T-1-ti via index maps
+    nt = pl.num_programs(0)
+
+    @pl.when(ti == 0)
+    def _init():
+        dh_scr[:] = dhlast_ref[:]
+        dc_scr[:] = dclast_ref[:]
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+        db_scr[:] = jnp.zeros_like(db_scr)
+
+    hdim = ct_ref.shape[-1]
+    gates = gates_ref[0]
+    i = gates[:, :hdim]
+    f = gates[:, hdim : 2 * hdim]
+    g = gates[:, 2 * hdim : 3 * hdim]
+    o = gates[:, 3 * hdim :]
+    c_tilde = ct_ref[0]
+    c_prev = cprev_ref[0]
+    h_prev = hprev_ref[0]
+    m = mask_ref[0]
+
+    dh = dh_scr[:] + dhs_ref[0]
+    dc = dc_scr[:]
+    tanh_ct = jnp.tanh(c_tilde)
+    dh_tilde = m * dh
+    dc_tilde = m * dc + dh_tilde * o * (1.0 - tanh_ct * tanh_ct)
+    do = dh_tilde * tanh_ct
+    di = dc_tilde * g
+    dg = dc_tilde * i
+    df = dc_tilde * c_prev
+    # pre-activation grads
+    dgi = di * i * (1.0 - i)
+    dgf = df * f * (1.0 - f)
+    dgg = dg * (1.0 - g * g)
+    dgo = do * o * (1.0 - o)
+    dgates = jnp.concatenate([dgi, dgf, dgg, dgo], axis=-1)
+
+    dproj_ref[0] = dgates
+    dh_prev = jnp.dot(
+        dgates, whh_ref[:].T, preferred_element_type=jnp.float32
+    ) + (1.0 - m) * dh
+    dc_prev = dc_tilde * f + (1.0 - m) * dc
+    dw_scr[:] = dw_scr[:] + jnp.dot(
+        h_prev.T, dgates, preferred_element_type=jnp.float32
+    )
+    db_scr[:] = db_scr[:] + jnp.sum(dgates, axis=0)
+    dh_scr[:] = dh_prev
+    dc_scr[:] = dc_prev
+
+    @pl.when(ti == nt - 1)
+    def _finish():
+        dw_ref[:] = dw_scr[:]
+        db_ref[:] = db_scr[:]
+        dh0_ref[:] = dh_scr[:]
+        dc0_ref[:] = dc_scr[:]
+
+
+def _lstm_fwd(proj_tm: Array, mask_tm: Array, w_hh: Array, bias: Array,
+              h0: Array, c0: Array):
+    t, b, h4 = proj_tm.shape
+    h = h4 // 4
+    f32 = jnp.float32
+    args = (proj_tm.astype(f32), mask_tm.astype(f32), w_hh.astype(f32),
+            bias.astype(f32), h0.astype(f32), c0.astype(f32))
+    out_shape = (
+        jax.ShapeDtypeStruct((t, b, h), f32),   # hs
+        jax.ShapeDtypeStruct((t, b, 4 * h), f32),  # post-act gates
+        jax.ShapeDtypeStruct((t, b, h), f32),   # c_tilde
+    )
+    step_specs = lambda width: pl.BlockSpec((1, b, width), lambda i: (i, 0, 0))
+    hs, gates, ct = pl.pallas_call(
+        _lstm_fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            step_specs(4 * h),                      # proj
+            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0)),  # mask
+            pl.BlockSpec((h, 4 * h), lambda i: (0, 0)),    # w_hh
+            pl.BlockSpec((4 * h,), lambda i: (0,)),        # bias
+            pl.BlockSpec((b, h), lambda i: (0, 0)),        # h0
+            pl.BlockSpec((b, h), lambda i: (0, 0)),        # c0
+        ],
+        out_specs=(
+            pl.BlockSpec((1, b, h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, 4 * h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, h), lambda i: (i, 0, 0)),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((b, h), f32),
+            pltpu.VMEM((b, h), f32),
+        ],
+        interpret=interpret_mode(),
+    )(*args)
+    return hs, gates, ct
+
+
+@functools.partial(jax.custom_vjp)
+def lstm_seq_fused(proj_tm: Array, mask_tm: Array, w_hh: Array, bias: Array,
+                   h0: Array, c0: Array) -> Tuple[Array, Array, Array]:
+    """Time-major fused LSTM: proj_tm [T,B,4H], mask_tm [T,B,1] →
+    (hs [T,B,H], h_last, c_last)."""
+    hs, gates, ct = _lstm_fwd(proj_tm, mask_tm, w_hh, bias, h0, c0)
+    return hs, hs[-1], _last_c(ct, mask_tm, c0)
+
+
+def _last_c(ct: Array, mask_tm: Array, c0: Array) -> Array:
+    # reconstruct masked c sequence cheaply: c_t = m*c_tilde + (1-m)*c_{t-1}
+    def step(c, xs):
+        c_tilde, m = xs
+        c = m * c_tilde + (1 - m) * c
+        return c, None
+    c_last, _ = jax.lax.scan(step, c0.astype(ct.dtype), (ct, mask_tm))
+    return c_last
+
+
+def _lstm_vjp_fwd(proj_tm, mask_tm, w_hh, bias, h0, c0):
+    hs, gates, ct = _lstm_fwd(proj_tm, mask_tm, w_hh, bias, h0, c0)
+    c_last = _last_c(ct, mask_tm, c0)
+    # zero-size carriers: dtype objects aren't valid pytree leaves
+    dtypes = tuple(jnp.zeros((0,), a.dtype) for a in (proj_tm, bias, h0, c0))
+    res = (proj_tm.shape, dtypes, mask_tm, w_hh, h0, c0, hs, gates, ct)
+    return (hs, hs[-1], c_last), res
+
+
+def _lstm_vjp_bwd(res, grads):
+
+    proj_shape, dtypes, mask_tm, w_hh, h0, c0, hs, gates, ct = res
+    dhs, dh_last, dc_last = grads
+    t, b, h4 = proj_shape
+    h = h4 // 4
+    f32 = jnp.float32
+    # grads on the hs output plus the explicit last-state grads
+    dhs = dhs.astype(f32).at[-1].add(dh_last.astype(f32))
+
+    # previous-step states (shift by one)
+    h_prev = jnp.concatenate([h0.astype(f32)[None], hs[:-1]], axis=0)
+    # masked c sequence for c_prev
+    def cseq_step(c, xs):
+        c_tilde, m = xs
+        c_new = m * c_tilde + (1 - m) * c
+        return c_new, c
+    _, c_prev = jax.lax.scan(
+        cseq_step, c0.astype(f32), (ct, mask_tm.astype(f32))
+    )
+
+    rev = lambda i: (t - 1 - i, 0, 0)
+    dproj, dw, db, dh0, dc0 = pl.pallas_call(
+        _lstm_bwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, 4 * h), rev),   # gates
+            pl.BlockSpec((1, b, h), rev),       # c_tilde
+            pl.BlockSpec((1, b, h), rev),       # h_prev
+            pl.BlockSpec((1, b, h), rev),       # c_prev
+            pl.BlockSpec((1, b, 1), rev),       # mask
+            pl.BlockSpec((h, 4 * h), lambda i: (0, 0)),  # w_hh
+            pl.BlockSpec((1, b, h), rev),       # dhs
+            pl.BlockSpec((b, h), lambda i: (0, 0)),  # dh_last → consumed via dhs[-1]; zeros
+            pl.BlockSpec((b, h), lambda i: (0, 0)),  # dc_last
+        ],
+        out_specs=(
+            pl.BlockSpec((1, b, 4 * h), rev),        # dproj
+            pl.BlockSpec((h, 4 * h), lambda i: (0, 0)),
+            pl.BlockSpec((4 * h,), lambda i: (0,)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, b, 4 * h), f32),
+            jax.ShapeDtypeStruct((h, 4 * h), f32),
+            jax.ShapeDtypeStruct((4 * h,), f32),
+            jax.ShapeDtypeStruct((b, h), f32),
+            jax.ShapeDtypeStruct((b, h), f32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((b, h), f32),
+            pltpu.VMEM((b, h), f32),
+            pltpu.VMEM((h, 4 * h), f32),
+            pltpu.VMEM((4 * h,), f32),
+        ],
+        interpret=interpret_mode(),
+    )(
+        gates, ct, h_prev, c_prev, mask_tm.astype(f32), w_hh.astype(f32),
+        dhs, jnp.zeros((b, h), f32), dc_last.astype(f32),
+    )
+    proj_dt, bias_dt, h0_dt, c0_dt = (a.dtype for a in dtypes)
+    # cotangent dtypes must match the primals (bf16 policy runs)
+    return (dproj.astype(proj_dt), jnp.zeros_like(mask_tm),
+            dw.astype(w_hh.dtype), db.astype(bias_dt),
+            dh0.astype(h0_dt), dc0.astype(c0_dt))
+
+
+lstm_seq_fused.defvjp(_lstm_vjp_fwd, _lstm_vjp_bwd)
+
+
+# ===========================================================================
+# GRU
+# ===========================================================================
+
+
+def _gru_fwd_kernel(proj_ref, mask_ref, wzr_ref, wc_ref, b_ref, h0_ref,
+                    hs_ref, zrc_ref, h_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:]
+
+    h = h_scr[:]
+    hdim = h.shape[-1]
+    p = proj_ref[0] + b_ref[:]
+    rz = jnp.dot(h, wzr_ref[:], preferred_element_type=jnp.float32)
+    z = _sig(p[:, :hdim] + rz[:, :hdim])
+    r = _sig(p[:, hdim : 2 * hdim] + rz[:, hdim:])
+    c = jnp.tanh(p[:, 2 * hdim :] + jnp.dot(
+        r * h, wc_ref[:], preferred_element_type=jnp.float32
+    ))
+    h_tilde = (1.0 - z) * h + z * c
+    m = mask_ref[0]
+    h_new = m * h_tilde + (1.0 - m) * h
+    zrc_ref[0] = jnp.concatenate([z, r, c], axis=-1)
+    hs_ref[0] = h_new
+    h_scr[:] = h_new
+
+
+def _gru_bwd_kernel(zrc_ref, hprev_ref, mask_ref, wzr_ref, wc_ref,
+                    dhs_ref, dhlast_ref,
+                    dproj_ref, dwzr_ref, dwc_ref, db_ref, dh0_ref,
+                    dh_scr, dwzr_scr, dwc_scr, db_scr):
+    ti = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(ti == 0)
+    def _init():
+        dh_scr[:] = dhlast_ref[:]
+        dwzr_scr[:] = jnp.zeros_like(dwzr_scr)
+        dwc_scr[:] = jnp.zeros_like(dwc_scr)
+        db_scr[:] = jnp.zeros_like(db_scr)
+
+    hdim = hprev_ref.shape[-1]
+    zrc = zrc_ref[0]
+    z = zrc[:, :hdim]
+    r = zrc[:, hdim : 2 * hdim]
+    c = zrc[:, 2 * hdim :]
+    h_prev = hprev_ref[0]
+    m = mask_ref[0]
+
+    dh = dh_scr[:] + dhs_ref[0]
+    dht = m * dh  # grad into h_tilde
+    dz = dht * (c - h_prev)
+    dc = dht * z
+    dgc = dc * (1.0 - c * c)  # pre-tanh candidate grad
+    # candidate path: c = tanh(pc + (r*h) Wc)
+    d_rh = jnp.dot(dgc, wc_ref[:].T, preferred_element_type=jnp.float32)
+    dr = d_rh * h_prev
+    dgz = dz * z * (1.0 - z)
+    dgr = dr * r * (1.0 - r)
+    dgzr = jnp.concatenate([dgz, dgr], axis=-1)
+
+    dproj_ref[0] = jnp.concatenate([dgz, dgr, dgc], axis=-1)
+    dh_prev = (
+        dht * (1.0 - z)
+        + d_rh * r
+        + jnp.dot(dgzr, wzr_ref[:].T, preferred_element_type=jnp.float32)
+        + (1.0 - m) * dh
+    )
+    dwzr_scr[:] = dwzr_scr[:] + jnp.dot(
+        h_prev.T, dgzr, preferred_element_type=jnp.float32
+    )
+    dwc_scr[:] = dwc_scr[:] + jnp.dot(
+        (r * h_prev).T, dgc, preferred_element_type=jnp.float32
+    )
+    db_scr[:] = db_scr[:] + jnp.sum(
+        jnp.concatenate([dgz, dgr, dgc], axis=-1), axis=0
+    )
+    dh_scr[:] = dh_prev
+
+    @pl.when(ti == nt - 1)
+    def _finish():
+        dwzr_ref[:] = dwzr_scr[:]
+        dwc_ref[:] = dwc_scr[:]
+        db_ref[:] = db_scr[:]
+        dh0_ref[:] = dh_scr[:]
+
+
+def _gru_fwd(proj_tm, mask_tm, w_hzr, w_hc, bias, h0):
+
+    t, b, h3 = proj_tm.shape
+    h = h3 // 3
+    f32 = jnp.float32
+    hs, zrc = pl.pallas_call(
+        _gru_fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, 3 * h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((h, 2 * h), lambda i: (0, 0)),
+            pl.BlockSpec((h, h), lambda i: (0, 0)),
+            pl.BlockSpec((3 * h,), lambda i: (0,)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, b, h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, 3 * h), lambda i: (i, 0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, b, h), f32),
+            jax.ShapeDtypeStruct((t, b, 3 * h), f32),
+        ),
+        scratch_shapes=[pltpu.VMEM((b, h), f32)],
+        interpret=interpret_mode(),
+    )(proj_tm.astype(f32), mask_tm.astype(f32), w_hzr.astype(f32),
+      w_hc.astype(f32), bias.astype(f32), h0.astype(f32))
+    return hs, zrc
+
+
+@jax.custom_vjp
+def gru_seq_fused(proj_tm, mask_tm, w_hzr, w_hc, bias, h0):
+    """Time-major fused GRU: proj_tm [T,B,3H] (gate order z,r,c), mask
+    [T,B,1] → (hs [T,B,H], h_last)."""
+    hs, _ = _gru_fwd(proj_tm, mask_tm, w_hzr, w_hc, bias, h0)
+    return hs, hs[-1]
+
+
+def _gru_vjp_fwd(proj_tm, mask_tm, w_hzr, w_hc, bias, h0):
+    hs, zrc = _gru_fwd(proj_tm, mask_tm, w_hzr, w_hc, bias, h0)
+    dtypes = tuple(jnp.zeros((0,), a.dtype) for a in (proj_tm, bias, h0))
+    return (hs, hs[-1]), (proj_tm.shape, dtypes, mask_tm, w_hzr, w_hc, h0, hs, zrc)
+
+
+def _gru_vjp_bwd(res, grads):
+
+    proj_shape, dtypes, mask_tm, w_hzr, w_hc, h0, hs, zrc = res
+    dhs, dh_last = grads
+    t, b, h3 = proj_shape
+    h = h3 // 3
+    f32 = jnp.float32
+    dhs = dhs.astype(f32).at[-1].add(dh_last.astype(f32))
+    h_prev = jnp.concatenate([h0.astype(f32)[None], hs[:-1]], axis=0)
+    rev = lambda i: (t - 1 - i, 0, 0)
+    dproj, dwzr, dwc, db, dh0 = pl.pallas_call(
+        _gru_bwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, 3 * h), rev),
+            pl.BlockSpec((1, b, h), rev),
+            pl.BlockSpec((1, b, 1), rev),
+            pl.BlockSpec((h, 2 * h), lambda i: (0, 0)),
+            pl.BlockSpec((h, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, b, h), rev),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, b, 3 * h), rev),
+            pl.BlockSpec((h, 2 * h), lambda i: (0, 0)),
+            pl.BlockSpec((h, h), lambda i: (0, 0)),
+            pl.BlockSpec((3 * h,), lambda i: (0,)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, b, 3 * h), f32),
+            jax.ShapeDtypeStruct((h, 2 * h), f32),
+            jax.ShapeDtypeStruct((h, h), f32),
+            jax.ShapeDtypeStruct((3 * h,), f32),
+            jax.ShapeDtypeStruct((b, h), f32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((b, h), f32),
+            pltpu.VMEM((h, 2 * h), f32),
+            pltpu.VMEM((h, h), f32),
+            pltpu.VMEM((3 * h,), f32),
+        ],
+        interpret=interpret_mode(),
+    )(zrc, h_prev, mask_tm.astype(f32), w_hzr.astype(f32), w_hc.astype(f32),
+      dhs, jnp.zeros((b, h), f32))
+    proj_dt, bias_dt, h0_dt = (a.dtype for a in dtypes)
+    return (dproj.astype(proj_dt), jnp.zeros_like(mask_tm),
+            dwzr.astype(w_hzr.dtype), dwc.astype(w_hc.dtype),
+            db.astype(bias_dt), dh0.astype(h0_dt))
+
+
+gru_seq_fused.defvjp(_gru_vjp_fwd, _gru_vjp_bwd)

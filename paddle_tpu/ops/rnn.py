@@ -27,6 +27,31 @@ from paddle_tpu.ops import linalg
 Array = jax.Array
 
 
+def _use_fused(standard_config: bool) -> bool:
+    """Route to the pallas whole-sequence kernel when on TPU (or forced) and
+    the layer uses the reference-default activations (no peepholes)."""
+    if not standard_config:
+        return False
+    from paddle_tpu.ops import pallas as pal
+
+    return pal.enabled()
+
+
+def _run_fused(proj: Array, mask: Array, reverse: bool, fn: Callable) -> Tuple:
+    """Shared fused-kernel dispatch: batch-major → time-major (+flip for
+    reverse), call `fn(proj_tm, mask_tm) -> (hs_tm, *finals)`, restore layout
+    and the caller's dtype."""
+    ptm = jnp.swapaxes(proj, 0, 1)
+    mtm = jnp.swapaxes(mask, 0, 1)[:, :, None]
+    if reverse:
+        ptm, mtm = jnp.flip(ptm, 0), jnp.flip(mtm, 0)
+    hs, *finals = fn(ptm, mtm)
+    if reverse:
+        hs = jnp.flip(hs, 0)
+    hs = jnp.swapaxes(hs, 0, 1).astype(proj.dtype)
+    return (hs, *(f.astype(proj.dtype) for f in finals))
+
+
 class LstmParams(NamedTuple):
     w_hh: Array  # [H, 4H] recurrent weights
     bias: Array  # [4H]
@@ -81,6 +106,17 @@ def lstm_scan(
     h0 = h0 if h0 is not None else jnp.zeros((b, hdim), proj.dtype)
     c0 = c0 if c0 is not None else jnp.zeros((b, hdim), proj.dtype)
 
+    if _use_fused(
+        gate_act == "sigmoid" and cell_act == "tanh" and state_act == "tanh"
+        and p.check_i is None and p.check_f is None and p.check_o is None
+    ):
+        from paddle_tpu.ops.pallas.rnn_kernels import lstm_seq_fused
+
+        return _run_fused(
+            proj, mask, reverse,
+            lambda ptm, mtm: lstm_seq_fused(ptm, mtm, p.w_hh, p.bias, h0, c0),
+        )
+
     def step(carry, xs):
         h, c = carry
         proj_t, m_t = xs
@@ -133,6 +169,14 @@ def gru_scan(
     b, t, h3 = proj.shape
     hdim = h3 // 3
     h0 = h0 if h0 is not None else jnp.zeros((b, hdim), proj.dtype)
+
+    if _use_fused(gate_act == "sigmoid" and cand_act == "tanh"):
+        from paddle_tpu.ops.pallas.rnn_kernels import gru_seq_fused
+
+        return _run_fused(
+            proj, mask, reverse,
+            lambda ptm, mtm: gru_seq_fused(ptm, mtm, p.w_hzr, p.w_hc, p.bias, h0),
+        )
 
     def step(h, xs):
         proj_t, m_t = xs
