@@ -1,0 +1,49 @@
+"""Deterministic multi-host data sharding (SURVEY §5 "deterministic data
+sharding by step" — the non-elastic half of the Go master's role; the elastic
+half is paddle_tpu.runtime.master.cluster_reader).
+
+Every host runs the same reader and keeps samples where
+`index % num_shards == shard_id` — no coordination, deterministic under
+restart, and exactly the v2 cluster_files_reader / recordio-dispatch
+semantics when pointed at the same file list."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+
+def shard_reader(
+    reader: Callable[[], Iterator[Any]],
+    num_shards: Optional[int] = None,
+    shard_id: Optional[int] = None,
+) -> Callable[[], Iterator[Any]]:
+    """Round-robin sample sharding. Defaults to jax process topology."""
+    import jax
+
+    n = num_shards if num_shards is not None else jax.process_count()
+    i = shard_id if shard_id is not None else jax.process_index()
+    if not 0 <= i < n:
+        raise ValueError(f"shard_id {i} out of range for {n} shards")
+
+    def sharded() -> Iterator[Any]:
+        for idx, sample in enumerate(reader()):
+            if idx % n == i:
+                yield sample
+
+    return sharded
+
+
+def shard_file_list(
+    files: Sequence[str],
+    num_shards: Optional[int] = None,
+    shard_id: Optional[int] = None,
+) -> list:
+    """File-granular sharding (cluster_files_reader parity,
+    python/paddle/v2/dataset/common.py): host i takes files i, i+n, ..."""
+    import jax
+
+    n = num_shards if num_shards is not None else jax.process_count()
+    i = shard_id if shard_id is not None else jax.process_index()
+    if not 0 <= i < n:
+        raise ValueError(f"shard_id {i} out of range for {n} shards")
+    return [f for idx, f in enumerate(files) if idx % n == i]

@@ -1,0 +1,23 @@
+"""Fluid-equivalent graph runtime (SURVEY §2.3): ProgramDesc/Block/OpDesc,
+Scope, op registry, Executor (whole-block jit), append_backward, layers API,
+optimizers. The reference's embryonic next-gen stack, rebuilt jax-native."""
+
+from paddle_tpu.fluid import layers, optimizer
+from paddle_tpu.fluid.backward import append_backward
+from paddle_tpu.fluid.executor import Executor
+from paddle_tpu.fluid.framework import (
+    Block,
+    OpDesc,
+    Program,
+    Scope,
+    VarDesc,
+    Variable,
+)
+from paddle_tpu.fluid.layers import default_main_program, reset_default_program
+from paddle_tpu.fluid.ops import OPS
+
+__all__ = [
+    "Program", "Block", "Variable", "VarDesc", "OpDesc", "Scope", "Executor",
+    "append_backward", "layers", "optimizer", "OPS",
+    "default_main_program", "reset_default_program",
+]

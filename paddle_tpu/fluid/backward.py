@@ -1,0 +1,51 @@
+"""append_backward — framework/backward.cc parity.
+
+The reference walks the forward ops in reverse, appending each op's
+registered grad op (op-level transposition). The TPU-native equivalent
+appends ONE `backward` region op that records (loss var, parameter list,
+forward op count); the Executor differentiates the traced forward region
+with jax autodiff, producing `<param>@GRAD` vars with identical semantics —
+and, under jit, a backward that XLA schedules jointly with the forward."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from paddle_tpu.fluid.framework import Program, Variable
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence[Variable]] = None,
+) -> List[tuple]:
+    """Returns [(param, grad_var)] like the reference's append_backward."""
+    program: Program = loss.block.program
+    block = program.global_block()
+    params = list(parameter_list) if parameter_list else [
+        v for v in block.vars.values() if v.persistable and not _is_slot(v.name)
+    ]
+    n_fwd = len(block.desc.ops)
+    grad_vars = []
+    for p in params:
+        g = block.create_var(p.name + "@GRAD", shape=p.desc.shape, dtype=p.desc.dtype)
+        grad_vars.append((p, g))
+    block.append_op(
+        "backward",
+        inputs={"Loss": loss, "Params": [p for p, _ in grad_vars]},
+        outputs={"Grads": [g for _, g in grad_vars]},
+        attrs={
+            "loss": loss.name,
+            "params": [p.name for p, _ in grad_vars],
+            "fwd_op_count": n_fwd,
+        },
+    )
+    return grad_vars
+
+
+def _is_slot(name: str) -> bool:
+    """Optimizer slot vars (moments, velocities, lr) are persistable but not
+    trainable parameters."""
+    return any(
+        tag in name
+        for tag in ("_moment", "_velocity", "_beta", "_lr", "_mean_square", "@GRAD")
+    ) or name.endswith(("_mean", "_variance"))

@@ -1,0 +1,207 @@
+"""Fluid-equivalent program representation (SURVEY §2.3 paddle/framework):
+ProgramDesc → blocks → OpDesc/VarDesc, Scope, and the Program/Block/Variable
+Python handles (framework.proto; python/paddle/v2/framework/framework.py).
+
+Design shift for TPU: the reference's Executor interprets ops one-by-one on
+device; here the program is a *description* that the Executor traces into one
+jittable jax function per (feed-shapes) signature — the whole block compiles
+to a single XLA program (SURVEY §7 hard-part (1)), while the desc layer keeps
+the reference's introspectable graph structure."""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+@dataclass
+class VarDesc:
+    name: str
+    shape: Optional[Sequence[int]] = None  # None → inferred at first write
+    dtype: Any = np.float32
+    persistable: bool = False  # parameters & optimizer slots
+    is_data: bool = False
+    lod_level: int = 0  # kept for LoDTensor parity (ragged inputs)
+    initializer: Optional[Any] = None  # ("uniform", lo, hi) | ("constant", v) | ndarray
+
+
+@dataclass
+class OpDesc:
+    type: str
+    inputs: Dict[str, List[str]] = field(default_factory=dict)
+    outputs: Dict[str, List[str]] = field(default_factory=dict)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class BlockDesc:
+    idx: int
+    parent_idx: int = -1
+    vars: Dict[str, VarDesc] = field(default_factory=dict)
+    ops: List[OpDesc] = field(default_factory=list)
+
+
+class Variable:
+    """Python handle to a VarDesc inside a block (framework.py Variable)."""
+
+    def __init__(self, block: "Block", desc: VarDesc):
+        self.block = block
+        self.desc = desc
+
+    @property
+    def name(self) -> str:
+        return self.desc.name
+
+    @property
+    def shape(self):
+        return tuple(self.desc.shape) if self.desc.shape is not None else None
+
+    @property
+    def dtype(self):
+        return self.desc.dtype
+
+    @property
+    def persistable(self) -> bool:
+        return self.desc.persistable
+
+    def __repr__(self):
+        return f"<Variable {self.name} shape={self.desc.shape}>"
+
+
+class Block:
+    def __init__(self, program: "Program", desc: BlockDesc):
+        self.program = program
+        self.desc = desc
+        self.vars: Dict[str, Variable] = {}
+
+    @property
+    def idx(self) -> int:
+        return self.desc.idx
+
+    def create_var(self, name: Optional[str] = None, **kw) -> Variable:
+        name = name or self.program.unique_name("tmp")
+        if name in self.vars:
+            return self.vars[name]
+        desc = VarDesc(name=name, **kw)
+        self.desc.vars[name] = desc
+        v = Variable(self, desc)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name: Optional[str] = None, **kw) -> Variable:
+        kw.setdefault("persistable", True)
+        name = name or self.program.unique_name("param")
+        return self.create_var(name, **kw)
+
+    def var(self, name: str) -> Variable:
+        b: Optional[Block] = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = (
+                self.program.blocks[b.desc.parent_idx]
+                if b.desc.parent_idx >= 0
+                else None
+            )
+        raise KeyError(f"variable {name!r} not found in block {self.idx}")
+
+    def append_op(
+        self,
+        type: str,  # noqa: A002
+        inputs: Optional[Dict[str, Any]] = None,
+        outputs: Optional[Dict[str, Any]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> OpDesc:
+        def names(d):
+            out = {}
+            for k, v in (d or {}).items():
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                out[k] = [x.name if isinstance(x, Variable) else str(x) for x in vs]
+            return out
+
+        op = OpDesc(type=type, inputs=names(inputs), outputs=names(outputs),
+                    attrs=dict(attrs or {}))
+        self.desc.ops.append(op)
+        return op
+
+
+class Program:
+    """ProgramDesc handle (framework/program_desc.h; framework.py Program)."""
+
+    def __init__(self):
+        self.blocks: List[Block] = []
+        self._counter = 0
+        root = BlockDesc(idx=0)
+        self.blocks.append(Block(self, root))
+        self._current = 0
+
+    def unique_name(self, prefix: str) -> str:
+        self._counter += 1
+        return f"{prefix}_{self._counter}"
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self._current]
+
+    def create_block(self) -> Block:
+        desc = BlockDesc(idx=len(self.blocks), parent_idx=self._current)
+        b = Block(self, desc)
+        self.blocks.append(b)
+        self._current = b.idx
+        return b
+
+    def rollback(self) -> None:
+        if self._current == 0:
+            raise RuntimeError("rollback() on the root block")
+        self._current = self.blocks[self._current].desc.parent_idx
+
+    # -- introspection -------------------------------------------------------
+    def parameters(self) -> List[Variable]:
+        return [v for v in self.global_block().vars.values() if v.persistable]
+
+    def to_string(self) -> str:
+        lines = []
+        for b in self.blocks:
+            lines.append(f"block {b.idx} (parent {b.desc.parent_idx}):")
+            for name, vd in b.desc.vars.items():
+                tag = " param" if vd.persistable else (" data" if vd.is_data else "")
+                lines.append(f"  var {name} shape={vd.shape}{tag}")
+            for op in b.desc.ops:
+                lines.append(
+                    f"  op {op.type}({op.inputs}) -> {op.outputs} {op.attrs}"
+                )
+        return "\n".join(lines)
+
+
+class Scope:
+    """Name → value store with parent chain (framework/scope.h:38)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self.values: Dict[str, Any] = {}
+
+    def find(self, name: str) -> Any:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.values:
+                return s.values[name]
+            s = s.parent
+        raise KeyError(f"variable {name!r} not in scope")
+
+    def has(self, name: str) -> bool:
+        try:
+            self.find(name)
+            return True
+        except KeyError:
+            return False
+
+    def set(self, name: str, value: Any) -> None:
+        self.values[name] = value
+
+    def new_child(self) -> "Scope":
+        return Scope(parent=self)

@@ -1,0 +1,457 @@
+"""Fluid operator registry (SURVEY §2.3 paddle/operators: 97 REGISTER_OP
+triples). Each op is a pure jax-traceable function `fn(ctx, ins, attrs) ->
+{slot: array}` keyed by the reference's op type names and input/output slot
+names (X/Y/Out, Input/Filter/Output, Param/Grad/ParamOut...), so programs
+written against the reference's op vocabulary execute unchanged.
+
+No per-op backward implementations: append_backward (backward.py) transposes
+whole traced regions with jax autodiff — the TPU-native replacement of
+framework/backward.cc's op-level transposition."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import Registry
+
+OPS = Registry("fluid op")
+
+Ins = Dict[str, List[Any]]
+
+
+class OpContext:
+    """Per-execution context: rng + training flag."""
+
+    def __init__(self, rng=None, train: bool = True):
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._count = 0
+        self.train = train
+
+    def next_rng(self):
+        self._count += 1
+        return jax.random.fold_in(self._rng, self._count)
+
+
+def op(name: str, **meta):
+    def deco(fn):
+        fn.op_meta = meta
+        OPS.register(name)(fn)
+        return fn
+
+    return deco
+
+
+def _one(ins: Ins, slot: str):
+    v = ins.get(slot, [])
+    return v[0] if v else None
+
+
+def _bcast(x, y, axis: int):
+    """The reference's elementwise broadcast: Y's shape must match a
+    contiguous suffix/infix of X starting at `axis` (elementwise_op.h)."""
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    shape = [1] * x.ndim
+    for i, d in enumerate(y.shape):
+        shape[axis + i] = d
+    return y.reshape(shape)
+
+
+# -- elementwise ------------------------------------------------------------
+
+for _nm, _f in [
+    ("elementwise_add", jnp.add), ("elementwise_sub", jnp.subtract),
+    ("elementwise_mul", jnp.multiply), ("elementwise_div", jnp.divide),
+    ("elementwise_max", jnp.maximum), ("elementwise_min", jnp.minimum),
+    ("elementwise_pow", jnp.power),
+]:
+    def _mk(f):
+        def fn(ctx, ins, attrs):
+            x, y = _one(ins, "X"), _one(ins, "Y")
+            return {"Out": f(x, _bcast(x, y, attrs.get("axis", -1)))}
+        return fn
+    op(_nm)(_mk(_f))
+
+
+# -- activations ------------------------------------------------------------
+
+for _nm, _f in [
+    ("relu", jax.nn.relu), ("sigmoid", jax.nn.sigmoid), ("tanh", jnp.tanh),
+    ("sqrt", jnp.sqrt), ("abs", jnp.abs), ("exp", jnp.exp), ("log", jnp.log),
+    ("square", jnp.square), ("reciprocal", lambda x: 1.0 / x),
+    ("softsign", lambda x: x / (1 + jnp.abs(x))),
+    ("soft_relu", lambda x: jnp.log1p(jnp.exp(jnp.clip(x, -40, 40)))),
+]:
+    def _mka(f):
+        def fn(ctx, ins, attrs):
+            return {"Y": f(_one(ins, "X"))}
+        return fn
+    op(_nm)(_mka(_f))
+
+
+@op("brelu")
+def _brelu(ctx, ins, attrs):
+    return {"Y": jnp.clip(_one(ins, "X"), attrs.get("t_min", 0.0), attrs.get("t_max", 24.0))}
+
+
+@op("leaky_relu")
+def _leaky(ctx, ins, attrs):
+    a = attrs.get("alpha", 0.02)
+    x = _one(ins, "X")
+    return {"Y": jnp.where(x >= 0, x, a * x)}
+
+
+# -- linear algebra ---------------------------------------------------------
+
+
+@op("mul")
+def _mul(ctx, ins, attrs):
+    """X [flattened to 2D at x_num_col_dims] @ Y (mul_op.cc)."""
+    x, y = _one(ins, "X"), _one(ins, "Y")
+    xd = attrs.get("x_num_col_dims", 1)
+    yd = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape(int(np.prod(xs[:xd])), -1)
+    y2 = y.reshape(int(np.prod(ys[:yd])), -1)
+    out = x2 @ y2
+    return {"Out": out.reshape(xs[:xd] + ys[yd:])}
+
+
+@op("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = _one(ins, "X"), _one(ins, "Y")
+    if attrs.get("transpose_X"):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("transpose_Y"):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": x @ y}
+
+
+# -- shape ops --------------------------------------------------------------
+
+
+@op("reshape")
+def _reshape(ctx, ins, attrs):
+    return {"Out": _one(ins, "X").reshape(attrs["shape"])}
+
+
+@op("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": jnp.transpose(_one(ins, "X"), attrs["axis"])}
+
+
+@op("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@op("split")
+def _split(ctx, ins, attrs):
+    x = _one(ins, "X")
+    axis = attrs.get("axis", 0)
+    if "sections" in attrs and attrs["sections"]:
+        idx = np.cumsum(attrs["sections"])[:-1]
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, attrs["num"], axis=axis)
+    return {"Out": list(parts)}
+
+
+@op("slice")
+def _slice(ctx, ins, attrs):
+    x = _one(ins, "X")
+    sl = [slice(None)] * x.ndim
+    for ax, st, en in zip(attrs["axes"], attrs["starts"], attrs["ends"]):
+        sl[ax] = slice(st, en)
+    return {"Out": x[tuple(sl)]}
+
+
+@op("cast")
+def _cast(ctx, ins, attrs):
+    return {"Out": _one(ins, "X").astype(attrs["dtype"])}
+
+
+@op("scale")
+def _scale(ctx, ins, attrs):
+    return {"Out": _one(ins, "X") * attrs.get("scale", 1.0)}
+
+
+# -- reductions / metrics ---------------------------------------------------
+
+
+@op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": jnp.mean(_one(ins, "X"))}
+
+
+@op("sum")
+def _sum(ctx, ins, attrs):
+    out = ins["X"][0]
+    for x in ins["X"][1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@op("reduce_sum")
+def _rsum(ctx, ins, attrs):
+    return {"Out": jnp.sum(_one(ins, "X"), axis=attrs.get("dim"),
+                           keepdims=attrs.get("keep_dim", False))}
+
+
+@op("reduce_mean")
+def _rmean(ctx, ins, attrs):
+    return {"Out": jnp.mean(_one(ins, "X"), axis=attrs.get("dim"),
+                            keepdims=attrs.get("keep_dim", False))}
+
+
+@op("top_k")
+def _topk(ctx, ins, attrs):
+    vals, idx = jax.lax.top_k(_one(ins, "X"), attrs.get("k", 1))
+    return {"Out": vals, "Indices": idx.astype(jnp.int32)}
+
+
+@op("accuracy")
+def _accuracy(ctx, ins, attrs):
+    """Top-k accuracy: label anywhere in the Indices columns counts
+    (accuracy_op semantics)."""
+    pred = _one(ins, "Indices")
+    if pred is None:
+        pred = _one(ins, "Out")
+    label = _one(ins, "Label").reshape(-1)
+    if pred.ndim == 1:
+        pred = pred[:, None]
+    hit = jnp.any(pred == label[:, None], axis=-1)
+    return {"Accuracy": jnp.mean(hit.astype(jnp.float32))}
+
+
+# -- nn ---------------------------------------------------------------------
+
+
+@op("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Y": jax.nn.softmax(_one(ins, "X"), axis=-1)}
+
+
+@op("cross_entropy")
+def _xent(ctx, ins, attrs):
+    x = _one(ins, "X")  # probabilities [N, C] (the reference takes probs)
+    label = _one(ins, "Label")
+    if attrs.get("soft_label"):
+        return {"Y": -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), -1, keepdims=True)}
+    idx = label.reshape(-1).astype(jnp.int32)
+    picked = jnp.take_along_axis(x, idx[:, None], axis=-1)
+    return {"Y": -jnp.log(jnp.maximum(picked, 1e-20))}
+
+
+@op("softmax_with_cross_entropy")
+def _smxent(ctx, ins, attrs):
+    logits = _one(ins, "Logits")
+    label = _one(ins, "Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    idx = label.reshape(-1).astype(jnp.int32)
+    loss = -jnp.take_along_axis(logp, idx[:, None], axis=-1)
+    return {"Loss": loss, "Softmax": jnp.exp(logp)}
+
+
+@op("conv2d")
+def _conv2d(ctx, ins, attrs):
+    """NCHW conv (conv_op.cc). Lowered to lax.conv_general_dilated — XLA maps
+    it onto the MXU; the reference's im2col+gemm is a GPU idiom."""
+    x = _one(ins, "Input")
+    w = _one(ins, "Filter")  # [O, I/g, kH, kW]
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    groups = attrs.get("groups", 1)
+    dil = attrs.get("dilations", [1, 1])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return {"Output": out}
+
+
+@op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = _one(ins, "X")
+    ksize = attrs.get("ksize", [2, 2])
+    strides = attrs.get("strides", ksize)
+    pads = attrs.get("paddings", [0, 0])
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling"):
+        ksize = list(x.shape[2:])
+        strides, pads = ksize, [0, 0]
+    window = (1, 1, *ksize)
+    stride = (1, 1, *strides)
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, stride, padding)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride, padding)
+        out = s / float(np.prod(ksize))
+    return {"Out": out}
+
+
+@op("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    x = _one(ins, "X")  # NCHW or NC
+    scale, bias = _one(ins, "Scale"), _one(ins, "Bias")
+    mean, var = _one(ins, "Mean"), _one(ins, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    if ctx.train and not attrs.get("is_test", False):
+        bm = jnp.mean(x, axis=axes)
+        bv = jnp.var(x, axis=axes)
+        y = (x - bm.reshape(shape)) / jnp.sqrt(bv.reshape(shape) + eps)
+        new_mean = momentum * mean + (1 - momentum) * bm
+        new_var = momentum * var + (1 - momentum) * bv
+        out = {"Y": y * scale.reshape(shape) + bias.reshape(shape),
+               "MeanOut": new_mean, "VarianceOut": new_var,
+               "SavedMean": bm, "SavedVariance": bv}
+    else:
+        y = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+        out = {"Y": y * scale.reshape(shape) + bias.reshape(shape),
+               "MeanOut": mean, "VarianceOut": var,
+               "SavedMean": mean, "SavedVariance": var}
+    return out
+
+
+@op("dropout")
+def _dropout(ctx, ins, attrs):
+    x = _one(ins, "X")
+    p = attrs.get("dropout_prob", 0.5)
+    if not ctx.train or attrs.get("is_test", False) or p == 0.0:
+        return {"Out": x, "Mask": jnp.ones_like(x)}
+    keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype) / (1.0 - p)
+    return {"Out": x * mask, "Mask": mask}
+
+
+@op("lookup_table")
+def _lookup(ctx, ins, attrs):
+    w = _one(ins, "W")
+    ids = _one(ins, "Ids")
+    # the reference feeds ids as [N, 1] (LoD column); squeeze only that case
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    return {"Out": w[ids]}
+
+
+@op("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    x = _one(ins, "X")
+    scale, bias = _one(ins, "Scale"), _one(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mu) / jnp.sqrt(var + eps)
+    if scale is not None:
+        y = y * scale
+    if bias is not None:
+        y = y + bias
+    return {"Y": y, "Mean": mu.squeeze(-1), "Variance": var.squeeze(-1)}
+
+
+# -- fills / random ---------------------------------------------------------
+
+
+@op("fill_constant")
+def _fill(ctx, ins, attrs):
+    return {"Out": jnp.full(attrs["shape"], attrs.get("value", 0.0),
+                            dtype=attrs.get("dtype", jnp.float32))}
+
+
+@op("uniform_random")
+def _uniform(ctx, ins, attrs):
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    return {"Out": jax.random.uniform(ctx.next_rng(), tuple(attrs["shape"]),
+                                      minval=lo, maxval=hi)}
+
+
+@op("gaussian_random")
+def _gauss(ctx, ins, attrs):
+    return {"Out": attrs.get("mean", 0.0) + attrs.get("std", 1.0)
+            * jax.random.normal(ctx.next_rng(), tuple(attrs["shape"]))}
+
+
+# -- control-flow helpers ---------------------------------------------------
+
+
+@op("less_than")
+def _less(ctx, ins, attrs):
+    return {"Out": _one(ins, "X") < _one(ins, "Y")}
+
+
+@op("increment")
+def _incr(ctx, ins, attrs):
+    return {"Out": _one(ins, "X") + attrs.get("step", 1.0)}
+
+
+# -- optimizer ops (sgd_op.cc, momentum_op.cc, adam_op.cc ...) --------------
+
+
+@op("sgd")
+def _sgd(ctx, ins, attrs):
+    p, g, lr = _one(ins, "Param"), _one(ins, "Grad"), _one(ins, "LearningRate")
+    return {"ParamOut": p - lr * g}
+
+
+@op("momentum")
+def _momentum(ctx, ins, attrs):
+    p, g, v = _one(ins, "Param"), _one(ins, "Grad"), _one(ins, "Velocity")
+    lr = _one(ins, "LearningRate")
+    mu = attrs.get("mu", 0.9)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov"):
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": p_new, "VelocityOut": v_new}
+
+
+@op("adam")
+def _adam(ctx, ins, attrs):
+    p, g = _one(ins, "Param"), _one(ins, "Grad")
+    m, v = _one(ins, "Moment1"), _one(ins, "Moment2")
+    b1p, b2p = _one(ins, "Beta1Pow"), _one(ins, "Beta2Pow")
+    lr = _one(ins, "LearningRate")
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    mhat = m_new / (1 - b1p)
+    vhat = v_new / (1 - b2p)
+    p_new = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return {"ParamOut": p_new, "Moment1Out": m_new, "Moment2Out": v_new,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+@op("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = _one(ins, "Param"), _one(ins, "Grad"), _one(ins, "Moment")
+    lr = _one(ins, "LearningRate")
+    eps = attrs.get("epsilon", 1e-6)
+    mom_new = mom + g * g
+    return {"ParamOut": p - lr * g / (jnp.sqrt(mom_new) + eps),
+            "MomentOut": mom_new}
+
+
+@op("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p, g = _one(ins, "Param"), _one(ins, "Grad")
+    ms, mom = _one(ins, "MeanSquare"), _one(ins, "Moment")
+    lr = _one(ins, "LearningRate")
+    rho = attrs.get("decay", 0.9)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * g * g
+    mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": p - mom_new, "MeanSquareOut": ms_new, "MomentOut": mom_new}

@@ -37,7 +37,7 @@ def _sig(x):
 
 
 def _lstm_fwd_kernel(proj_ref, mask_ref, whh_ref, b_ref, h0_ref, c0_ref,
-                     hs_ref, gates_ref, ct_ref, h_scr, c_scr):
+                     hs_ref, gates_ref, ct_ref, cs_ref, h_scr, c_scr):
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -60,9 +60,10 @@ def _lstm_fwd_kernel(proj_ref, mask_ref, whh_ref, b_ref, h0_ref, c0_ref,
     m = mask_ref[0]
     h_new = m * h_tilde + (1.0 - m) * h
     c_new = m * c_tilde + (1.0 - m) * c
-    # saved for backward: post-activation gates + pre-mask cell
+    # saved for backward: post-activation gates, pre-mask cell, masked cell
     gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1)
     ct_ref[0] = c_tilde
+    cs_ref[0] = c_new
     hs_ref[0] = h_new
     h_scr[:] = h_new
     c_scr[:] = c_new
@@ -139,10 +140,11 @@ def _lstm_fwd(proj_tm: Array, mask_tm: Array, w_hh: Array, bias: Array,
     out_shape = (
         jax.ShapeDtypeStruct((t, b, h), f32),   # hs
         jax.ShapeDtypeStruct((t, b, 4 * h), f32),  # post-act gates
-        jax.ShapeDtypeStruct((t, b, h), f32),   # c_tilde
+        jax.ShapeDtypeStruct((t, b, h), f32),   # c_tilde (pre-mask)
+        jax.ShapeDtypeStruct((t, b, h), f32),   # c sequence (masked)
     )
     step_specs = lambda width: pl.BlockSpec((1, b, width), lambda i: (i, 0, 0))
-    hs, gates, ct = pl.pallas_call(
+    hs, gates, ct, cs = pl.pallas_call(
         _lstm_fwd_kernel,
         grid=(t,),
         in_specs=[
@@ -157,6 +159,7 @@ def _lstm_fwd(proj_tm: Array, mask_tm: Array, w_hh: Array, bias: Array,
             pl.BlockSpec((1, b, h), lambda i: (i, 0, 0)),
             pl.BlockSpec((1, b, 4 * h), lambda i: (i, 0, 0)),
             pl.BlockSpec((1, b, h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, h), lambda i: (i, 0, 0)),
         ),
         out_shape=out_shape,
         scratch_shapes=[
@@ -165,7 +168,7 @@ def _lstm_fwd(proj_tm: Array, mask_tm: Array, w_hh: Array, bias: Array,
         ],
         interpret=interpret_mode(),
     )(*args)
-    return hs, gates, ct
+    return hs, gates, ct, cs
 
 
 @functools.partial(jax.custom_vjp)
@@ -173,32 +176,21 @@ def lstm_seq_fused(proj_tm: Array, mask_tm: Array, w_hh: Array, bias: Array,
                    h0: Array, c0: Array) -> Tuple[Array, Array, Array]:
     """Time-major fused LSTM: proj_tm [T,B,4H], mask_tm [T,B,1] →
     (hs [T,B,H], h_last, c_last)."""
-    hs, gates, ct = _lstm_fwd(proj_tm, mask_tm, w_hh, bias, h0, c0)
-    return hs, hs[-1], _last_c(ct, mask_tm, c0)
-
-
-def _last_c(ct: Array, mask_tm: Array, c0: Array) -> Array:
-    # reconstruct masked c sequence cheaply: c_t = m*c_tilde + (1-m)*c_{t-1}
-    def step(c, xs):
-        c_tilde, m = xs
-        c = m * c_tilde + (1 - m) * c
-        return c, None
-    c_last, _ = jax.lax.scan(step, c0.astype(ct.dtype), (ct, mask_tm))
-    return c_last
+    hs, gates, ct, cs = _lstm_fwd(proj_tm, mask_tm, w_hh, bias, h0, c0)
+    return hs, hs[-1], cs[-1]
 
 
 def _lstm_vjp_fwd(proj_tm, mask_tm, w_hh, bias, h0, c0):
-    hs, gates, ct = _lstm_fwd(proj_tm, mask_tm, w_hh, bias, h0, c0)
-    c_last = _last_c(ct, mask_tm, c0)
+    hs, gates, ct, cs = _lstm_fwd(proj_tm, mask_tm, w_hh, bias, h0, c0)
     # zero-size carriers: dtype objects aren't valid pytree leaves
     dtypes = tuple(jnp.zeros((0,), a.dtype) for a in (proj_tm, bias, h0, c0))
-    res = (proj_tm.shape, dtypes, mask_tm, w_hh, h0, c0, hs, gates, ct)
-    return (hs, hs[-1], c_last), res
+    res = (proj_tm.shape, dtypes, mask_tm, w_hh, h0, c0, hs, gates, ct, cs)
+    return (hs, hs[-1], cs[-1]), res
 
 
 def _lstm_vjp_bwd(res, grads):
 
-    proj_shape, dtypes, mask_tm, w_hh, h0, c0, hs, gates, ct = res
+    proj_shape, dtypes, mask_tm, w_hh, h0, c0, hs, gates, ct, cs = res
     dhs, dh_last, dc_last = grads
     t, b, h4 = proj_shape
     h = h4 // 4
@@ -206,16 +198,10 @@ def _lstm_vjp_bwd(res, grads):
     # grads on the hs output plus the explicit last-state grads
     dhs = dhs.astype(f32).at[-1].add(dh_last.astype(f32))
 
-    # previous-step states (shift by one)
+    # previous-step states (shift by one; cs is the masked cell sequence
+    # the forward kernel saved — no reconstruction scan needed)
     h_prev = jnp.concatenate([h0.astype(f32)[None], hs[:-1]], axis=0)
-    # masked c sequence for c_prev
-    def cseq_step(c, xs):
-        c_tilde, m = xs
-        c_new = m * c_tilde + (1 - m) * c
-        return c_new, c
-    _, c_prev = jax.lax.scan(
-        cseq_step, c0.astype(f32), (ct, mask_tm.astype(f32))
-    )
+    c_prev = jnp.concatenate([c0.astype(f32)[None], cs[:-1]], axis=0)
 
     rev = lambda i: (t - 1 - i, 0, 0)
     dproj, dw, db, dh0, dc0 = pl.pallas_call(

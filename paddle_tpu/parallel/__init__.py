@@ -1,3 +1,18 @@
 from paddle_tpu.parallel.mesh import make_mesh  # noqa: F401
 from paddle_tpu.parallel.data_parallel import DataParallel  # noqa: F401
 from paddle_tpu.parallel import distributed as distributed  # noqa: F401
+from paddle_tpu.parallel.sequence_parallel import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)
+from paddle_tpu.parallel.embedding import (  # noqa: F401
+    ShardedEmbeddingState,
+    shard_table,
+    sharded_lookup,
+)
+from paddle_tpu.parallel.updaters import (  # noqa: F401
+    IciAllReduceUpdater,
+    ParameterUpdater,
+    SgdLocalUpdater,
+    SparseShardedUpdater,
+)

@@ -1,0 +1,91 @@
+"""ParameterUpdater hierarchy — interface parity with the reference's updater
+stack (trainer/ParameterUpdater.h:38 SgdLocalUpdater, ThreadParameterUpdater.h:41
+SgdThreadUpdater, RemoteParameterUpdater.h:55/180/265, NewRemoteParameterUpdater).
+
+In the reference the updater is where parallelism plugs into the trainer: the
+same `init/startPass/startBatch/update/finishBatch/finishPass` protocol hides
+local SGD, the multi-thread ring, or the pserver RPC. Here the heavy lifting
+(grad all-reduce, sharded placement) is compiled INTO the step by
+DataParallel, so these classes keep the protocol for API parity and host-side
+orchestration: pass/batch bookkeeping, barriers across hosts, and the hook
+point for custom update policies."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optim.optimizers import Optimizer
+from paddle_tpu.parallel import distributed
+
+
+class ParameterUpdater:
+    """The reference protocol (ParameterUpdater.h:38)."""
+
+    def init(self, params: Dict[str, Any]) -> None:  # noqa: A003
+        pass
+
+    def start_pass(self) -> None:
+        pass
+
+    def finish_pass(self) -> None:
+        pass
+
+    def start_batch(self, batch_size: int) -> None:
+        pass
+
+    def finish_batch(self, cost: float) -> None:
+        pass
+
+    def apply(self, grads, opt_state, params, lr):
+        raise NotImplementedError
+
+
+class SgdLocalUpdater(ParameterUpdater):
+    """Single-replica updater (ParameterUpdater.h:38 SgdLocalUpdater): the
+    optimizer update runs inside the compiled step; no collectives."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+
+    def apply(self, grads, opt_state, params, lr):
+        return self.optimizer.update(grads, opt_state, params, lr)
+
+
+class IciAllReduceUpdater(SgdLocalUpdater):
+    """The pserver/ring replacement (SURVEY §2.5 rows 1-2): gradients are
+    mean-reduced over the mesh data axis by pjit's SPMD partitioner (see
+    DataParallel.reduce_grads), then updated locally-identically on every
+    replica — semantically the synchronous pserver round-trip
+    (ParameterServer2::addGradient + ThreadBarrier) with the barrier provided
+    by the collective itself."""
+
+    def __init__(self, optimizer: Optimizer, parallel):
+        super().__init__(optimizer)
+        self.parallel = parallel
+
+    def start_pass(self) -> None:
+        # host-level sync at pass boundaries, the synchronize() RPC parity
+        if distributed.process_count() > 1:
+            distributed.barrier("start_pass")
+
+    def finish_pass(self) -> None:
+        if distributed.process_count() > 1:
+            distributed.barrier("finish_pass")
+
+
+class SparseShardedUpdater(ParameterUpdater):
+    """SparseRemoteParameterUpdater parity (RemoteParameterUpdater.h:265):
+    embedding tables live row-sharded on the mesh (parallel/embedding.py);
+    the 'prefetch' pass of the reference (pull the rows this batch touches)
+    is unnecessary — the sharded lookup's gather touches only owned rows, and
+    its transpose is the row-sparse scatter-add the pserver applied by hand."""
+
+    def __init__(self, optimizer: Optimizer, table_params: Optional[set] = None):
+        self.optimizer = optimizer
+        self.table_params = table_params or set()
+
+    def apply(self, grads, opt_state, params, lr):
+        return self.optimizer.update(grads, opt_state, params, lr)

@@ -1,0 +1,173 @@
+"""Fluid-equivalent stack tests (SURVEY §2.3): ProgramDesc construction,
+Executor (jit AND eager — the eager interpreter is the oracle, mirroring the
+reference's CPU-oracle idiom), append_backward autodiff region, optimizer
+ops, batch-norm running stats, dropout train/test."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers as L
+
+
+@pytest.fixture(autouse=True)
+def _fresh_program():
+    fluid.reset_default_program()
+    yield
+
+
+def _toy_classification(n=32, d=16, c=4, seed=0):
+    rs = np.random.RandomState(seed)
+    lbl = rs.randint(0, c, (n, 1))
+    feat = rs.randn(n, d).astype(np.float32) * 0.1
+    for i, l in enumerate(lbl[:, 0]):
+        feat[i, l] += 2.0
+    return feat, lbl
+
+
+def _build_mlp(c=4):
+    x = L.data("x", shape=[16])
+    y = L.data("y", shape=[1], dtype=np.int32)
+    h = L.fc(x, 32, act="tanh")
+    out = L.fc(h, c, act="softmax")
+    loss = L.mean(L.cross_entropy(out, y))
+    acc = L.accuracy(out, y)
+    return x, y, out, loss, acc
+
+
+def test_program_desc_structure():
+    _build_mlp()
+    prog = fluid.default_main_program()
+    s = prog.to_string()
+    assert "op mul" in s and "op cross_entropy" in s
+    types = [op.type for op in prog.global_block().desc.ops]
+    assert types.count("mul") == 2 and "softmax" in types
+    params = {p.name for p in prog.parameters()}
+    assert any(n.endswith(".w") for n in params)
+
+
+def test_mlp_trains_with_each_optimizer():
+    feat, lbl = _toy_classification()
+    for opt_cls, kw in [
+        (fluid.optimizer.SGDOptimizer, {"learning_rate": 0.5}),
+        (fluid.optimizer.MomentumOptimizer, {"learning_rate": 0.2, "momentum": 0.9}),
+        (fluid.optimizer.AdamOptimizer, {"learning_rate": 0.05}),
+        (fluid.optimizer.AdagradOptimizer, {"learning_rate": 0.3}),
+    ]:
+        fluid.reset_default_program()
+        _, _, _, loss, acc = _build_mlp()
+        prog = fluid.default_main_program()
+        opt_cls(**kw).minimize(loss)
+        exe = fluid.Executor()
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(prog, feed={"x": feat, "y": lbl}, fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] / 2, (opt_cls.__name__, losses[0], losses[-1])
+
+
+def test_jit_matches_eager():
+    feat, lbl = _toy_classification(seed=3)
+    _, _, out, loss, _ = _build_mlp()
+    prog = fluid.default_main_program()
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    exe_jit = fluid.Executor(seed=7)
+    exe_eager = fluid.Executor(seed=7)
+    for step in range(3):
+        (l_jit,) = exe_jit.run(prog, feed={"x": feat, "y": lbl}, fetch_list=[loss])
+        (l_eager,) = exe_eager.run(
+            prog, feed={"x": feat, "y": lbl}, fetch_list=[loss], use_jit=False
+        )
+        np.testing.assert_allclose(l_jit, l_eager, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_pool_batchnorm_pipeline():
+    rs = np.random.RandomState(0)
+    img = L.data("img", shape=[3, 8, 8])
+    y = L.data("y", shape=[1], dtype=np.int32)
+    c = L.conv2d(img, 8, 3, padding=1, act="relu")
+    bn = L.batch_norm(c)
+    p = L.pool2d(bn, 2)
+    flat = L.reshape(p, [-1, 8 * 4 * 4])
+    out = L.fc(flat, 2, act="softmax")
+    loss = L.mean(L.cross_entropy(out, y))
+    prog = fluid.default_main_program()
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    feed = {
+        "img": rs.randn(4, 3, 8, 8).astype(np.float32),
+        "y": rs.randint(0, 2, (4, 1)),
+    }
+    scope = fluid.Scope()
+    (l0,) = exe.run(prog, feed=feed, fetch_list=[loss], scope=scope)
+    bn_mean_name = next(n for n in scope.values if n.endswith("_mean"))
+    m_before = np.asarray(scope.find(bn_mean_name))
+    (l1,) = exe.run(prog, feed=feed, fetch_list=[loss], scope=scope)
+    m_after = np.asarray(scope.find(bn_mean_name))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert not np.allclose(m_before, m_after)  # running stats moved
+
+
+def test_backward_grads_match_manual():
+    """sgd step on y = mean((x@w)^2): grad = 2/N * x^T (x w) — closed form."""
+    rs = np.random.RandomState(1)
+    xv = rs.randn(8, 4).astype(np.float32)
+    wv = rs.randn(4, 1).astype(np.float32)
+
+    x = L.data("x", shape=[4])
+    block = fluid.default_main_program().global_block()
+    w = block.create_parameter("w", shape=[4, 1], initializer=wv)
+    out = block.create_var("out")
+    block.append_op("mul", {"X": x, "Y": w}, {"Out": out}, {})
+    sq = block.create_var("sq")
+    block.append_op("square", {"X": out}, {"Y": sq}, {})
+    loss = L.mean(sq)
+    prog = fluid.default_main_program()
+    fluid.append_backward(loss, [w])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(prog, feed={"x": xv}, fetch_list=[loss], scope=scope, use_jit=False)
+    g = np.asarray(scope.find("w@GRAD")) if scope.has("w@GRAD") else None
+    # eager path stores grads in the transient values only; re-run via jit path
+    # fetches instead:
+    (gfetch,) = exe.run(prog, feed={"x": xv}, fetch_list=["w@GRAD"], scope=scope)
+    manual = 2.0 / 8.0 * xv.T @ (xv @ wv)
+    np.testing.assert_allclose(gfetch, manual, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_train_vs_test():
+    x = L.data("x", shape=[64])
+    d = L.dropout(x, 0.5)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor()
+    xv = np.ones((4, 64), np.float32)
+    (train_out,) = exe.run(prog, feed={"x": xv}, fetch_list=[d], train=True)
+    (test_out,) = exe.run(prog, feed={"x": xv}, fetch_list=[d], train=False)
+    assert (train_out == 0).any()  # some units dropped
+    np.testing.assert_allclose(test_out, xv)  # identity at inference
+
+
+def test_scope_persistence_across_runs():
+    feat, lbl = _toy_classification(seed=5)
+    _, _, _, loss, _ = _build_mlp()
+    prog = fluid.default_main_program()
+    fluid.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    (l0,) = exe.run(prog, feed={"x": feat, "y": lbl}, fetch_list=[loss], scope=scope)
+    (l1,) = exe.run(prog, feed={"x": feat, "y": lbl}, fetch_list=[loss], scope=scope)
+    assert float(l1) < float(l0)  # params persisted and updated in the scope
+
+
+def test_elementwise_axis_broadcast():
+    """The reference's mid-axis broadcast (elementwise_op.h)."""
+    import jax.numpy as jnp
+    from paddle_tpu.fluid.ops import OPS, OpContext
+
+    x = jnp.ones((2, 3, 4))
+    y = jnp.asarray(np.arange(3.0, dtype=np.float32))
+    fn = OPS.get("elementwise_add")
+    out = fn(OpContext(), {"X": [x], "Y": [y]}, {"axis": 1})["Out"]
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_allclose(np.asarray(out[0, :, 0]), [1.0, 2.0, 3.0])
