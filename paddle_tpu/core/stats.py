@@ -1,0 +1,140 @@
+"""Timers + profiler hooks (SURVEY §5 tracing/profiling).
+
+Parity: utils/Stat.h:63 StatSet / :114 Stat / :189 TimerOnce and the
+REGISTER_TIMER* macros (:215-224) that the hot loop stamps
+(TrainerInternal.cpp:94-152, per-layer timers NeuralNetwork.cpp:258/298);
+hl_profiler_start/end (hl_cuda.h:338) maps to jax.profiler traces.
+
+Gating: the reference compiles timers out unless WITH_TIMER=ON; here the
+equivalent is the PADDLE_TPU_TIMER env var / enable_timers() — disabled
+timers cost one dict lookup and a truth test."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+
+class Stat:
+    """Accumulates wall time + call count for one named timer (Stat.h:114)."""
+
+    __slots__ = ("name", "total", "count", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+
+    def add(self, seconds: float) -> None:
+        self.total += seconds
+        self.count += 1
+        if seconds > self.max:
+            self.max = seconds
+
+    def __repr__(self):
+        avg = self.total / max(self.count, 1)
+        return (
+            f"{self.name}: total={self.total * 1e3:.2f}ms count={self.count} "
+            f"avg={avg * 1e3:.3f}ms max={self.max * 1e3:.3f}ms"
+        )
+
+
+class StatSet:
+    """Global registry of Stats (Stat.h:63 StatSet + BarrierStatSet)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Stat] = {}
+        self.enabled = os.environ.get("PADDLE_TPU_TIMER", "").lower() not in (
+            "", "0", "false", "off",
+        )
+
+    def get(self, name: str) -> Stat:
+        with self._lock:
+            s = self._stats.get(name)
+            if s is None:
+                s = self._stats[name] = Stat(name)
+            return s
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    def report(self) -> str:
+        with self._lock:
+            stats = sorted(self._stats.values(), key=lambda s: -s.total)
+        lines = ["======= StatSet: [GlobalStatInfo] status ======"]
+        lines += [f"  {s!r}" for s in stats]
+        return "\n".join(lines)
+
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                n: {"total_ms": s.total * 1e3, "count": s.count, "max_ms": s.max * 1e3}
+                for n, s in self._stats.items()
+            }
+
+
+GLOBAL_STATS = StatSet()
+
+
+def enable_timers(on: bool = True) -> None:
+    GLOBAL_STATS.enabled = on
+
+
+@contextlib.contextmanager
+def timer(name: str) -> Iterator[None]:
+    """REGISTER_TIMER_INFO analog: `with timer("forwardBackward"): ...`."""
+    if not GLOBAL_STATS.enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        GLOBAL_STATS.get(name).add(time.perf_counter() - t0)
+
+
+class TimerOnce:
+    """Stat.h:189 TimerOnce: manual start/stop object form."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0: Optional[float] = None
+
+    def start(self) -> "TimerOnce":
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self) -> None:
+        if self._t0 is not None and GLOBAL_STATS.enabled:
+            GLOBAL_STATS.get(self.name).add(time.perf_counter() - self._t0)
+        self._t0 = None
+
+
+# -- device profiler (hl_profiler_start/end → jax.profiler) -----------------
+
+
+def profiler_start(logdir: str = "/tmp/paddle_tpu_profile") -> None:
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def profiler_stop() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def profile_region(name: str) -> Iterator[None]:
+    """Named trace annotation inside a profiler capture."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

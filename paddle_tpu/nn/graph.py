@@ -340,18 +340,30 @@ class Network:
         return outs, new_states
 
     def _run(self, ctx: Context, batch: Dict[str, Any]) -> Dict[str, Argument]:
+        from paddle_tpu.core import stack_trace
+
         values: Dict[str, Argument] = {}
         for layer in self.layer_order:
             if layer.type_name == "data":
                 values[layer.name] = _feed_to_argument(batch, layer)
-            else:
-                ins = [values[l.name] for l in layer.inputs]
-                out = layer.forward(ctx, ins)
-                if not isinstance(out, Argument):
-                    raise TypeError(
-                        f"layer {layer.name} forward returned {type(out).__name__}"
-                    )
-                values[layer.name] = out
+                continue
+            ins = [values[l.name] for l in layer.inputs]
+            # layer-name crash context (CustomStackTrace parity,
+            # NeuralNetwork.cpp:259-261)
+            with stack_trace.layer_frame(layer.name):
+                try:
+                    out = layer.forward(ctx, ins)
+                except stack_trace.LayerError:
+                    raise
+                except Exception as e:
+                    raise stack_trace.LayerError(
+                        layer.name, stack_trace.current_stack(), e
+                    ) from e
+            if not isinstance(out, Argument):
+                raise TypeError(
+                    f"layer {layer.name} forward returned {type(out).__name__}"
+                )
+            values[layer.name] = out
         return values
 
 

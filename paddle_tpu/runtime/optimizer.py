@@ -1,0 +1,131 @@
+"""Native optimizer library binding (csrc/optimizer.cc; paddle/optimizer
+parity — the C ABI the reference's Go pserver consumes via cgo). Host-side
+parameter updates with checkpointable slot state; the jax optim package is
+the numerical oracle in tests."""
+
+from __future__ import annotations
+
+import ctypes as C
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu.runtime import native
+
+_TYPES = {"sgd": 0, "adagrad": 1, "adadelta": 2, "adam": 3}
+_LR_POLICIES = {"const": 0, "linear": 1}
+
+
+def _lib():
+    L = native.lib()
+    if L is None:
+        raise RuntimeError("native runtime unavailable (g++ build failed?)")
+    if not hasattr(L, "_opt_bound"):
+        L.pt_opt_create.restype = C.c_void_p
+        L.pt_opt_create.argtypes = [C.c_int] + [C.c_double] * 7 + [C.c_int]
+        L.pt_opt_set_lr_policy.restype = None
+        L.pt_opt_set_lr_policy.argtypes = [C.c_void_p, C.c_int, C.c_double, C.c_double]
+        L.pt_opt_update.restype = C.c_int
+        L.pt_opt_update.argtypes = [
+            C.c_void_p,
+            C.POINTER(C.c_float),
+            C.POINTER(C.c_float),
+            C.c_uint64,
+        ]
+        L.pt_opt_current_lr.restype = C.c_double
+        L.pt_opt_current_lr.argtypes = [C.c_void_p]
+        L.pt_opt_serialize.restype = C.c_int64
+        L.pt_opt_serialize.argtypes = [C.c_void_p, C.c_char_p, C.c_int64]
+        L.pt_opt_deserialize.restype = C.c_int
+        L.pt_opt_deserialize.argtypes = [C.c_void_p, C.c_char_p, C.c_int64]
+        L.pt_opt_destroy.restype = None
+        L.pt_opt_destroy.argtypes = [C.c_void_p]
+        L._opt_bound = True
+    return L
+
+
+class NativeOptimizer:
+    def __init__(
+        self,
+        kind: str = "sgd",
+        learning_rate: float = 0.01,
+        momentum: float = 0.0,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        epsilon: float = 1e-8,
+        rho: float = 0.95,
+        weight_decay: float = 0.0,
+        nesterov: bool = False,
+        lr_policy: str = "const",
+        lr_decay_a: float = 0.0,
+        lr_decay_b: float = 0.0,
+    ):
+        if kind not in _TYPES:
+            raise ValueError(f"unknown optimizer kind {kind!r}; got {sorted(_TYPES)}")
+        self._lib = _lib()
+        self.kind = kind
+        self._h = self._lib.pt_opt_create(
+            _TYPES[kind], learning_rate, momentum, beta1, beta2, epsilon,
+            rho, weight_decay, int(nesterov),
+        )
+        if lr_policy != "const":
+            self._lib.pt_opt_set_lr_policy(
+                self._h, _LR_POLICIES[lr_policy], lr_decay_a, lr_decay_b
+            )
+
+    def update(self, param: np.ndarray, grad: np.ndarray) -> np.ndarray:
+        """In-place update of a contiguous float32 parameter array; returns
+        it. Raises TypeError rather than silently updating a copy."""
+        if not (
+            isinstance(param, np.ndarray)
+            and param.dtype == np.float32
+            and param.flags["C_CONTIGUOUS"]
+            and param.flags["WRITEABLE"]
+        ):
+            raise TypeError(
+                "param must be a writeable contiguous float32 ndarray "
+                "(in-place update); convert with np.ascontiguousarray(p, np.float32)"
+            )
+        g = np.ascontiguousarray(grad, np.float32)
+        if param.shape != g.shape:
+            raise ValueError(f"param {param.shape} vs grad {g.shape}")
+        rc = self._lib.pt_opt_update(
+            self._h,
+            param.ctypes.data_as(C.POINTER(C.c_float)),
+            g.ctypes.data_as(C.POINTER(C.c_float)),
+            param.size,
+        )
+        if rc != 0:
+            raise ValueError(
+                f"optimizer slot state sized for a different parameter "
+                f"(got {param.size} elements)"
+            )
+        return param
+
+    @property
+    def current_lr(self) -> float:
+        return float(self._lib.pt_opt_current_lr(self._h))
+
+    # -- checkpointable state (OptimizerConfig.proto state parity) ----------
+    def serialize(self) -> bytes:
+        need = self._lib.pt_opt_serialize(self._h, None, 0)
+        buf = C.create_string_buffer(need)
+        wrote = self._lib.pt_opt_serialize(self._h, buf, need)
+        if wrote != need:
+            raise RuntimeError("optimizer serialization failed")
+        return buf.raw
+
+    def deserialize(self, blob: bytes) -> None:
+        if self._lib.pt_opt_deserialize(self._h, blob, len(blob)) != 0:
+            raise ValueError("bad optimizer state blob (magic/type mismatch)")
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.pt_opt_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

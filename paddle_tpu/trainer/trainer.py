@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.core import stats
 from paddle_tpu.nn.graph import Argument, Layer, Network
 from paddle_tpu.optim.optimizers import Optimizer
 from paddle_tpu.optim.average import ModelAverage
@@ -190,7 +191,14 @@ class SGDTrainer:
                 if self._step_fn is None:
                     self._step_fn = self._make_step()
                 event_handler(BeginIteration(pass_id, batch_id))
-                self.state, cost, extras = self._step_fn(self.state, batch)
+                # REGISTER_TIMER_INFO("forwardBackward") parity
+                # (TrainerInternal.cpp:94-152); enable via PADDLE_TPU_TIMER.
+                # Timing is opt-in, so when enabled we sync the device inside
+                # the timer — otherwise it would measure only async dispatch.
+                with stats.timer("forwardBackward"):
+                    self.state, cost, extras = self._step_fn(self.state, batch)
+                    if stats.GLOBAL_STATS.enabled:
+                        jax.block_until_ready(cost)
                 n_batches += 1
                 # only sync the device when someone will look at the value —
                 # otherwise keep the async dispatch pipeline running

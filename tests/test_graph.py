@@ -44,7 +44,11 @@ def test_shared_param_shape_mismatch(rng):
     a = L.Fc(data, size=8, act=None, bias=False, param_attr=shared)
     b = L.Fc(a, size=4, act=None, bias=False, param_attr=shared)
     net = Network(b)
-    with pytest.raises(ValueError, match="mismatch"):
+    # wrapped in LayerError carrying the failing layer's name
+    # (CustomStackTrace parity)
+    from paddle_tpu.core.stack_trace import LayerError
+
+    with pytest.raises(LayerError, match="mismatch"):
         net.init(jax.random.PRNGKey(0), {"x": np.zeros((2, 8), np.float32)})
 
 
