@@ -28,7 +28,7 @@ def main() -> None:
     from paddle_tpu.parallel import DataParallel, make_mesh
     from paddle_tpu.trainer import SGDTrainer
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
+    batch_size = int(os.environ.get("BENCH_BATCH", "256"))
     image_size = int(os.environ.get("BENCH_IMAGE", "224"))
     steps = max(1, int(os.environ.get("BENCH_STEPS", "20")))
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))
@@ -54,16 +54,12 @@ def main() -> None:
     trainer.init_state(batch)
     step = trainer._make_step()
 
-    state = trainer.state
-    for _ in range(warmup):
-        state, cost_v, _ = step(state, batch)
-    jax.block_until_ready(cost_v)
+    from paddle_tpu.core.benchmark import time_train_steps
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, cost_v, _ = step(state, batch)
-    jax.block_until_ready(cost_v)
-    dt = time.perf_counter() - t0
+    sec_per_step, _ = time_train_steps(
+        step, trainer.state, batch, steps=steps, warmup=warmup
+    )
+    dt = sec_per_step * steps
 
     images_per_sec = batch_size * steps / dt
     images_per_sec_chip = images_per_sec / n_dev

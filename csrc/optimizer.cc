@@ -134,6 +134,7 @@ using pt::Optimizer;
 PT_EXPORT void* pt_opt_create(int type, double lr, double momentum,
                               double beta1, double beta2, double epsilon,
                               double rho, double decay, int nesterov) {
+  if (type < pt::SGD || type > pt::ADAM) return nullptr;  // unknown type
   auto* o = new (std::nothrow) Optimizer();
   if (!o) return nullptr;
   o->type = type;

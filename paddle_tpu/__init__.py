@@ -16,6 +16,14 @@ not its implementation:
 - ``paddle_tpu.metrics``  — evaluators (paddle/gserver/evaluators).
 - ``paddle_tpu.models``   — model zoo for the BASELINE configs.
 - ``paddle_tpu.v2``       — the user-facing v2-style API (python/paddle/v2).
+- ``paddle_tpu.config``   — the v1 config-script pipeline (config_parser,
+                            trainer_config_helpers; SURVEY §2.4).
+- ``paddle_tpu.proto``    — config messages (proto/ parity).
+- ``paddle_tpu.fluid``    — ProgramDesc/Executor graph runtime (SURVEY §2.3).
+- ``paddle_tpu.runtime``  — native C++ runtime via ctypes: allocator, recordio,
+                            elastic task master, host optimizer lib (csrc/).
+- ``paddle_tpu.capi``     — merged-model inference (paddle/capi).
+- ``paddle_tpu.utils``    — tooling (diagrams, model inspection).
 """
 
 __version__ = "0.1.0"

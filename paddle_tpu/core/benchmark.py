@@ -1,0 +1,37 @@
+"""Shared step-timing harness for all benchmark entry points (bench.py,
+benchmarks/*.py).
+
+The execution barrier is a VALUE fetch (float(cost)), not
+jax.block_until_ready: on the remote-tunnel TPU backend block_until_ready
+returns before the work runs, which produced impossible >100%-MFU readings.
+Fetching the final cost forces the whole dependent step chain."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+
+def time_train_steps(
+    step: Callable,
+    state: Any,
+    batch: Dict[str, Any],
+    steps: int = 10,
+    warmup: int = 2,
+) -> Tuple[float, Any]:
+    """Returns (seconds_per_step, final_state). `step(state, batch)` must
+    return (new_state, cost_scalar, extras)."""
+    for _ in range(max(warmup, 1)):
+        state, cost, _ = step(state, batch)
+    cost_v = float(cost)  # barrier: forces compile + warmup chain
+    assert np.isfinite(cost_v), f"non-finite cost during warmup: {cost_v}"
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, cost, _ = step(state, batch)
+    final = float(cost)  # barrier: forces the timed chain
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final), f"non-finite cost during timing: {final}"
+    return dt / steps, state

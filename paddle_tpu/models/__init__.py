@@ -10,3 +10,5 @@ from paddle_tpu.models.googlenet import googlenet  # noqa: F401
 from paddle_tpu.models.seq2seq import seq2seq, Seq2SeqModel  # noqa: F401
 from paddle_tpu.models.text_lstm import text_lstm  # noqa: F401
 from paddle_tpu.models.ssd import ssd  # noqa: F401
+from paddle_tpu.models.ctr import ctr_wide_deep  # noqa: F401
+from paddle_tpu.models.ocr_crnn import ocr_crnn  # noqa: F401

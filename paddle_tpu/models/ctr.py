@@ -1,0 +1,57 @@
+"""CTR wide&deep (BASELINE config #4; reference demo/ctr + the sparse
+pserver path it exercises, SURVEY §2.5 sparse/EP row).
+
+Wide part: multi-hot sparse feature vector through a linear projection (the
+reference's sparse_binary_vector → fc). Deep part: per-slot categorical ids
+through embeddings (the row-sharded pserver tables; shard over the mesh
+'expert' axis via ParamAttr(sharding=...) for the EP-parity path) → MLP.
+Output: sigmoid CTR estimate, soft binary cross-entropy loss."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.graph import ParamAttr
+
+
+def ctr_wide_deep(
+    wide_dim: int = 1000,
+    slot_vocab_sizes: Sequence[int] = (1000, 1000, 500, 100),
+    embed_dim: int = 32,
+    hidden_dims: Sequence[int] = (128, 64),
+    embedding_sharding: Optional[Tuple] = None,
+):
+    """Returns (inputs, label, prediction, cost). inputs = [wide_input,
+    slot0_ids, slot1_ids, ...]. embedding_sharding e.g. ("expert", None)
+    shards every deep table row-wise over the mesh."""
+    wide_in = L.Data("wide_features", shape=(wide_dim,))
+    slot_ids = [
+        L.Data(f"slot{i}_id", shape=()) for i in range(len(slot_vocab_sizes))
+    ]
+    label = L.Data("click", shape=(1,))
+
+    # wide: linear on the multi-hot vector
+    wide = L.Fc(wide_in, 1, act=None, name="wide_lr")
+
+    # deep: embeddings (optionally sharded like the pserver row-shards) + MLP
+    embeds = []
+    for i, (ids, vocab) in enumerate(zip(slot_ids, slot_vocab_sizes)):
+        attr = (
+            ParamAttr(sharding=embedding_sharding)
+            if embedding_sharding is not None
+            else None
+        )
+        embeds.append(
+            L.Embedding(ids, embed_dim, vocab_size=vocab,
+                        param_attr=attr, name=f"slot{i}_emb")
+        )
+    deep = L.Concat(embeds, name="deep_concat")
+    for j, h in enumerate(hidden_dims):
+        deep = L.Fc(deep, h, act="relu", name=f"deep_fc{j}")
+    deep_out = L.Fc(deep, 1, act=None, name="deep_out")
+
+    logit = L.Addto([wide, deep_out], act="sigmoid", name="ctr_prob")
+    cost = C.SoftBinaryCrossEntropy(logit, label, name="cost")
+    return [wide_in] + slot_ids, label, logit, cost

@@ -1,0 +1,41 @@
+"""OCR CRNN + CTC (BASELINE config #5; the reference composes this from
+ExpandConvLayer + BlockExpandLayer (im2seq) + bidirectional lstmemory +
+CTCLayer/WarpCTCLayer — v1 demo 'ocr' pattern, SURVEY §2.1 hl_sequence ops).
+
+Conv stack halves height to 1-ish, BlockExpand turns the feature map into a
+width-major sequence, a bidirectional LSTM reads it, and CTC aligns the
+frame-wise class posteriors to the unsegmented label string."""
+
+from __future__ import annotations
+
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn import struct_costs as SC
+from paddle_tpu.nn.recurrent import bidirectional_lstm
+
+
+def ocr_crnn(
+    image_height: int = 32,
+    image_width: int = 128,
+    num_channels: int = 1,
+    num_classes: int = 80,  # charset size; CTC blank is class 0
+    rnn_hidden: int = 96,
+):
+    """Returns (image, label, frame_logits, cost). label: int sequence."""
+    img = L.Data("image", shape=(image_height, image_width, num_channels))
+    label = L.Data("label", shape=(), is_seq=True)
+
+    x = L.Conv2D(img, 32, 3, padding=1, act="relu", name="c1")
+    x = L.Pool2D(x, 2, "max", name="p1")             # H/2, W/2
+    x = L.Conv2D(x, 64, 3, padding=1, act="relu", name="c2")
+    x = L.Pool2D(x, 2, "max", name="p2")             # H/4, W/4
+    x = L.Conv2D(x, 128, 3, padding=1, act="relu", name="c3")
+    x = L.BatchNorm(x, act="relu", name="bn3")
+    # pool height only: keep width (time) resolution
+    x = L.Pool2D(x, (2, 1), "max", stride=(2, 1), name="p3")  # H/8, W/4
+
+    # im2seq: each width position's full-height column becomes one timestep
+    seq = L.BlockExpand(x, block_x=1, block_y=image_height // 8, name="im2seq")
+    rnn = bidirectional_lstm(seq, rnn_hidden, name="blstm")
+    logits = L.Fc(rnn, num_classes + 1, act=None, name="frame_logits")
+    cost = SC.CTCCost(logits, label, blank=0, name="cost")
+    return img, label, logits, cost

@@ -1,0 +1,6 @@
+"""Tooling (python/paddle/utils parity, SURVEY §2.4 'tooling only'):
+dump_config lives on the CLI; here: model diagrams, training-curve plotting,
+merged-model inspection."""
+
+from paddle_tpu.utils.make_model_diagram import make_diagram, to_dot  # noqa: F401
+from paddle_tpu.utils.show_pb import show_merged_model  # noqa: F401

@@ -1,0 +1,27 @@
+"""Inspect a merged model file (python/paddle/utils/show_pb.py parity):
+prints the stored TrainerConfig text + parameter table."""
+
+from __future__ import annotations
+
+from typing import TextIO
+
+import numpy as np
+
+
+def show_merged_model(path: str, out: TextIO = None) -> str:
+    import io
+    import sys
+
+    buf = io.StringIO()
+    with np.load(path, allow_pickle=False) as z:
+        if "__trainer_config__" in z.files:
+            buf.write(str(z["__trainer_config__"]))
+            buf.write("\n")
+        buf.write("parameters:\n")
+        for k in sorted(z.files):
+            if k.startswith("param/"):
+                a = z[k]
+                buf.write(f"  {k[6:]}: shape={tuple(a.shape)} dtype={a.dtype}\n")
+    text = buf.getvalue()
+    (out or sys.stdout).write(text)
+    return text

@@ -22,11 +22,13 @@ from paddle_tpu.v2 import parameters as parameters  # noqa: F401
 from paddle_tpu.v2 import pooling as pooling  # noqa: F401
 from paddle_tpu.v2 import topology as topology  # noqa: F401
 from paddle_tpu.v2 import trainer as trainer  # noqa: F401
+from paddle_tpu.v2 import plot as plot  # noqa: F401
 from paddle_tpu.v2.inference import infer as infer  # noqa: F401
 from paddle_tpu.v2.minibatch import batch as batch  # noqa: F401
 
 from paddle_tpu.data import reader as reader  # noqa: F401
 from paddle_tpu.data import datasets as dataset  # noqa: F401
+from paddle_tpu.data import image as image  # noqa: F401
 
 
 def init(use_gpu: bool = False, trainer_count: int = 1, seed: int = 0, **kwargs):

@@ -75,3 +75,79 @@ def test_lenet_converges():
     state = trainer.train(rd.batch(reader, 32, drop_last=True), num_passes=6, feeder=feeder)
     res = trainer.test(rd.batch(reader, 32, drop_last=True), feeder)
     assert res["cost"] < 0.5, f"LeNet failed to learn: {res}"
+
+
+def test_ctr_wide_deep_trains():
+    """BASELINE config #4: wide&deep overfits a separable click pattern."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import models
+    from paddle_tpu.nn.graph import Network, reset_name_scope
+
+    reset_name_scope()
+    ins, label, prob, cost = models.ctr_wide_deep(
+        wide_dim=32, slot_vocab_sizes=(16, 16), embed_dim=8, hidden_dims=(16,)
+    )
+    net = Network([cost, prob])
+    rs = np.random.RandomState(0)
+    slot0 = rs.randint(0, 16, 32)
+    click = (slot0 % 2).astype(np.float32)[:, None]  # click ⇔ even slot0 id
+    batch = {
+        "wide_features": rs.rand(32, 32).astype(np.float32) * 0.1,
+        "slot0_id": slot0,
+        "slot1_id": rs.randint(0, 16, 32),
+        "click": click,
+    }
+    params, states = net.init(jax.random.PRNGKey(0), batch)
+
+    @jax.jit
+    def step(p):
+        l, g = jax.value_and_grad(
+            lambda p: net.apply(p, states, batch)[0][cost.name].value
+        )(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g), l
+
+    l0 = None
+    for _ in range(60):
+        params, l = step(params)
+        l0 = l0 if l0 is not None else float(l)
+    assert l0 / float(l) > 2.0, (l0, float(l))
+    outs, _ = net.apply(params, states, batch)
+    pred = (np.asarray(outs[prob.name].value) > 0.5).astype(np.float32)
+    assert (pred == click).mean() > 0.9
+
+
+def test_ocr_crnn_ctc_trains():
+    """BASELINE config #5: CRNN+CTC loss drops on a fixed batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import models
+    from paddle_tpu.nn.graph import Network, reset_name_scope
+
+    reset_name_scope()
+    img, lbl, logits, cost = models.ocr_crnn(
+        image_height=32, image_width=64, num_classes=10, rnn_hidden=16
+    )
+    net = Network([cost])
+    rs = np.random.RandomState(0)
+    batch = {
+        "image": rs.randn(2, 32, 64, 1).astype(np.float32),
+        "label": rs.randint(1, 11, (2, 6)).astype(np.int32),
+        "label.lengths": np.asarray([6, 4], np.int32),
+    }
+    params, states = net.init(jax.random.PRNGKey(0), batch)
+
+    @jax.jit
+    def step(p):
+        l, g = jax.value_and_grad(
+            lambda p: net.apply(p, states, batch, train=False)[0][cost.name].value
+        )(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.01 * b, p, g), l
+
+    l0 = None
+    for _ in range(25):
+        params, l = step(params)
+        l0 = l0 if l0 is not None else float(l)
+    assert float(l) < l0 * 0.8, (l0, float(l))
