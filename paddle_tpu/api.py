@@ -1,0 +1,175 @@
+"""swig_paddle-shaped compatibility surface (paddle/api/PaddleAPI.h parity,
+SURVEY §2.1 `paddle/api` + py_paddle).
+
+The reference exposes trainer internals to Python through SWIG classes
+(`GradientMachine` :720, `Arguments` :402, `SequenceGenerator` :1025). Here
+those internals ARE Python; this module provides the same class shapes for
+scripts/tools written against py_paddle. Heavy lifting delegates to the
+layer-graph Network and the compiled-step machinery."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.graph import Argument, Layer, Network
+
+
+class Arguments:
+    """Batch container (PaddleAPI.h:402): per-slot value/ids + sequence start
+    positions. Internally a dict batch; seq start positions convert to the
+    padded+lengths encoding."""
+
+    def __init__(self, batch: Optional[Dict[str, Any]] = None):
+        self._batch: Dict[str, Any] = dict(batch or {})
+
+    @classmethod
+    def createArguments(cls, _size: int = 0) -> "Arguments":
+        return cls()
+
+    def setSlotValue(self, name: str, value: np.ndarray) -> None:
+        self._batch[name] = np.asarray(value)
+
+    def setSlotIds(self, name: str, ids: np.ndarray) -> None:
+        self._batch[name] = np.asarray(ids, np.int32)
+
+    def setSlotSequenceStartPositions(self, name: str, starts: Sequence[int]) -> None:
+        """v1 ragged encoding: starts [0, l0, l0+l1, ...] → pad + lengths."""
+        starts = list(starts)
+        lengths = np.diff(starts).astype(np.int32)
+        flat = self._batch.get(name)
+        if flat is None:
+            raise ValueError(f"set slot {name!r} value/ids before start positions")
+        flat = np.asarray(flat)
+        max_len = int(lengths.max()) if len(lengths) else 1
+        out = np.zeros((len(lengths), max_len) + flat.shape[1:], flat.dtype)
+        for i, (s, l) in enumerate(zip(starts[:-1], lengths)):
+            out[i, :l] = flat[s : s + l]
+        self._batch[name] = out
+        self._batch[name + ".lengths"] = lengths
+
+    def getSlotValue(self, name: str) -> np.ndarray:
+        return np.asarray(self._batch[name])
+
+    def as_batch(self) -> Dict[str, Any]:
+        return dict(self._batch)
+
+
+class Evaluator:
+    """makeEvaluator() result: start/finish + printStats over the streaming
+    metrics package."""
+
+    def __init__(self, machine: "GradientMachine"):
+        self.machine = machine
+        self._metrics: Dict[str, float] = {}
+
+    def start(self) -> None:
+        self._metrics.clear()
+
+    def finish(self) -> None:
+        pass
+
+    def printStats(self) -> str:
+        return " ".join(f"{k}={v}" for k, v in self._metrics.items())
+
+
+class GradientMachine:
+    """PaddleAPI.h:720: forward / backward / forwardBackward over a topology.
+
+    `backward` returns parameter gradients (the reference mutates grad
+    buffers; functionally that's the return value)."""
+
+    def __init__(self, outputs: Sequence[Layer], seed: int = 0):
+        self.network = Network(list(outputs))
+        self.seed = seed
+        self.params: Dict[str, jax.Array] = {}
+        self.states: Dict[str, jax.Array] = {}
+        self._fwd = jax.jit(
+            lambda p, s, b: self.network.apply(p, s, b, train=False)[0]
+        )
+
+    # -- creation (createFromConfigProto parity: from a parsed config) ------
+    @classmethod
+    def createFromConfigProto(cls, parsed_config) -> "GradientMachine":
+        """Accepts paddle_tpu.config.ParsedConfig (the proto's owner)."""
+        return cls(parsed_config.outputs)
+
+    def initParams(self, batch: Dict[str, Any]) -> None:
+        self.params, self.states = self.network.init(
+            jax.random.PRNGKey(self.seed), batch
+        )
+
+    # -- execution -----------------------------------------------------------
+    def forward(self, in_args: Any, _out_args: Any = None, _pass_type: Any = None):
+        batch = in_args.as_batch() if isinstance(in_args, Arguments) else in_args
+        if not self.params:
+            self.initParams(batch)
+        outs = self._fwd(self.params, self.states, batch)
+        return {k: np.asarray(v.value) for k, v in outs.items()}
+
+    def forwardBackward(self, in_args: Any, _out=None, _pt=None):
+        batch = in_args.as_batch() if isinstance(in_args, Arguments) else in_args
+        if not self.params:
+            self.initParams(batch)
+        cost_name = self.network.outputs[0].name
+
+        def loss(p):
+            outs, _ = self.network.apply(p, self.states, batch, train=True,
+                                         rng=jax.random.PRNGKey(self.seed))
+            return outs[cost_name].value
+
+        cost, grads = jax.value_and_grad(loss)(self.params)
+        return float(cost), {k: np.asarray(v) for k, v in grads.items()}
+
+    backward = forwardBackward  # the reference splits them; semantics match
+
+    def getLayerOutput(self, name: str, in_args: Any) -> np.ndarray:
+        batch = in_args.as_batch() if isinstance(in_args, Arguments) else in_args
+        if not self.params:
+            self.initParams(batch)
+        sub = Network([self.network.layers_by_name[name]])
+        outs, _ = sub.apply(self.params, self.states, batch, train=False)
+        return np.asarray(outs[name].value)
+
+    def getParameters(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def setParameters(self, params: Dict[str, np.ndarray]) -> None:
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+
+    def makeEvaluator(self) -> Evaluator:
+        return Evaluator(self)
+
+
+class SequenceGenerator:
+    """PaddleAPI.h:1025: beam-search text generation over a graph containing a
+    beam_search layer (nn/recurrent_group.BeamSearchLayer)."""
+
+    def __init__(self, machine: GradientMachine, beam_layer: Layer,
+                 dict_file: Optional[Sequence[str]] = None):
+        self.machine = machine
+        self.beam_layer = beam_layer
+        self.vocab = list(dict_file) if dict_file else None
+
+    def generate(self, in_args: Any) -> List[List[int]]:
+        batch = in_args.as_batch() if isinstance(in_args, Arguments) else in_args
+        if not self.machine.params:
+            self.machine.initParams(batch)
+        outs, _ = self.machine.network.apply(
+            self.machine.params, self.machine.states, batch, train=False
+        )
+        arg: Argument = outs[self.beam_layer.name]
+        ids = np.asarray(arg.value)
+        lens = np.asarray(arg.lengths)
+        return [list(map(int, ids[i, : lens[i]])) for i in range(len(ids))]
+
+    def generateText(self, in_args: Any) -> List[str]:
+        assert self.vocab is not None, "pass dict_file to decode text"
+        return [
+            " ".join(self.vocab[t] for t in seq if t < len(self.vocab))
+            for seq in self.generate(in_args)
+        ]

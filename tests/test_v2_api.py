@@ -145,3 +145,66 @@ def test_datasets_schemas():
     assert t_in[0] == 0 and t_out[-1] == 1 and len(t_in) == len(t_out)
     fa, fb = next(paddle.dataset.mq2007.train("pairwise")())
     assert fa.shape == (46,) and fb.shape == (46,)
+
+
+def test_swig_api_shapes():
+    """paddle/api SWIG-surface parity: GradientMachine forward/backward,
+    Arguments seq start positions, SequenceGenerator over a beam layer."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.api import Arguments, GradientMachine, SequenceGenerator
+    from paddle_tpu.nn.graph import reset_name_scope
+    from paddle_tpu.v2 import layer as vl
+    from paddle_tpu.data.feeder import dense_vector, dense_vector_sequence, integer_value
+
+    reset_name_scope()
+    x = vl.data(name="x", type=dense_vector(8))
+    y = vl.data(name="y", type=integer_value(3))
+    out = vl.fc(input=x, size=3, act="softmax", name="out")
+    cost = vl.classification_cost(input=out, label=y)
+    gm = GradientMachine([cost, out])
+
+    args = Arguments()
+    rs = np.random.RandomState(0)
+    args.setSlotValue("x", rs.randn(4, 8).astype(np.float32))
+    args.setSlotIds("y", rs.randint(0, 3, 4))
+    outs = gm.forward(args)
+    assert outs["out"].shape == (4, 3)
+    c, grads = gm.forwardBackward(args)
+    assert np.isfinite(c) and "out.w" in grads
+    assert gm.getLayerOutput("out", args).shape == (4, 3)
+
+    # ragged start positions → padded + lengths
+    a2 = Arguments()
+    flat = rs.randn(5, 2).astype(np.float32)
+    a2.setSlotValue("s", flat)
+    a2.setSlotSequenceStartPositions("s", [0, 2, 5])
+    b = a2.as_batch()
+    assert b["s"].shape == (2, 3, 2)
+    np.testing.assert_array_equal(b["s.lengths"], [2, 3])
+
+    # sequence generation
+    reset_name_scope()
+    enc = vl.data(name="enc", type=dense_vector_sequence(4))
+    boot = vl.last_seq(input=enc)
+
+    def step(enc_s, cur):
+        mem = vl.memory(name="m", size=4, boot_layer=boot)
+        h = vl.fc(input=[cur, mem], size=4, act="tanh", name="m")
+        return vl.fc(input=h, size=6, act="softmax", name="probs")
+
+    gen = vl.beam_search(
+        step,
+        input=[vl.StaticInput(enc, is_seq=True),
+               vl.GeneratedInput(size=6, embedding_name="emb", embedding_size=4)],
+        bos_id=0, eos_id=1, beam_size=2, max_length=5,
+    )
+    gm2 = GradientMachine([gen])
+    sg = SequenceGenerator(gm2, gen, dict_file=[f"w{i}" for i in range(6)])
+    batch = {"enc": rs.randn(2, 3, 4).astype(np.float32),
+             "enc.lengths": np.asarray([3, 2], np.int32)}
+    seqs = sg.generate(batch)
+    assert len(seqs) == 2 and all(len(s) <= 5 for s in seqs)
+    texts = sg.generateText(batch)
+    assert all(isinstance(t, str) for t in texts)
