@@ -2,36 +2,121 @@
 accelerator (the BASELINE.json north-star metric: images/sec/chip and MFU vs
 the ≥50% target).
 
-Prints exactly ONE JSON line:
+Prints exactly ONE JSON line no matter what happens:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 vs_baseline = achieved_MFU / 0.50 (the north-star MFU target), so 1.0 means
-"hit the 50%-MFU goal"; extra keys are informational.
+"hit the 50%-MFU goal"; extra keys are informational. On any failure the line
+still appears, with an "error" key describing what went wrong.
+
+Resilience (round-1 postmortem: the TPU tunnel backend raised UNAVAILABLE and
+the script died with rc=1 and no JSON): backend init is probed in a child
+process with a hard timeout and retried with backoff; if the accelerator never
+comes up we fall back to the CPU backend with small shapes so a measured
+number is still emitted, flagged with "error".
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
+import traceback
+
+_PROBE_SNIPPET = (
+    "import jax, json, sys;"
+    "d = jax.devices();"
+    "sys.stdout.write(json.dumps({'platform': d[0].platform, 'n': len(d)}))"
+)
 
 
-def main() -> None:
+def _emit(obj: dict) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def _error_payload(msg: str) -> dict:
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": msg[-800:],
+    }
+
+
+def probe_backend() -> dict | None:
+    """Try to bring up the default (TPU/axon) backend in a child process.
+
+    The tunnel backend has two observed failure modes: a fast UNAVAILABLE
+    raise, and an indefinite hang inside PJRT client init (C code, holds the
+    GIL — unkillable from a thread, hence the child process). Returns
+    {'platform', 'n'} on success, None when every attempt fails.
+    """
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+    backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "20"))
+    for attempt in range(retries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                return json.loads(out.stdout.strip().splitlines()[-1])
+            sys.stderr.write(
+                f"[bench] probe attempt {attempt + 1}/{retries} rc="
+                f"{out.returncode}: {out.stderr[-400:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"[bench] probe attempt {attempt + 1}/{retries} timed out "
+                f"after {timeout:.0f}s\n"
+            )
+        except Exception as exc:  # noqa: BLE001 — never die in the probe
+            sys.stderr.write(f"[bench] probe error: {exc!r}\n")
+        if attempt + 1 < retries:
+            time.sleep(backoff * (attempt + 1))
+    return None
+
+
+def run_bench(cpu_fallback: bool) -> dict:
     import jax
-    import jax.numpy as jnp
+
+    if cpu_fallback:
+        # the sitecustomize-installed tunnel plugin sets jax_platforms
+        # programmatically, trumping the JAX_PLATFORMS env var — the config
+        # update is the only override that sticks (see tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+
     import numpy as np
 
     from paddle_tpu.core import dtypes
     from paddle_tpu import models
-    from paddle_tpu.nn.graph import Network, reset_name_scope
+    from paddle_tpu.nn.graph import reset_name_scope
     from paddle_tpu.optim import SGD
     from paddle_tpu.parallel import DataParallel, make_mesh
     from paddle_tpu.trainer import SGDTrainer
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "256"))
-    image_size = int(os.environ.get("BENCH_IMAGE", "224"))
-    steps = max(1, int(os.environ.get("BENCH_STEPS", "20")))
-    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))
+    if cpu_fallback:
+        # deliberately separate env names: a TPU-sized BENCH_BATCH must not
+        # leak into the reduced-shape CPU fallback and wedge it
+        batch_size = int(os.environ.get("BENCH_CPU_BATCH", "16"))
+        image_size = int(os.environ.get("BENCH_CPU_IMAGE", "64"))
+        steps = max(1, int(os.environ.get("BENCH_CPU_STEPS", "4")))
+        warmup = max(1, int(os.environ.get("BENCH_CPU_WARMUP", "1")))
+        scan_k = max(1, int(os.environ.get("BENCH_CPU_SCAN", "2")))
+    else:
+        batch_size = int(os.environ.get("BENCH_BATCH", "256"))
+        image_size = int(os.environ.get("BENCH_IMAGE", "224"))
+        steps = max(1, int(os.environ.get("BENCH_STEPS", "32")))
+        warmup = max(1, int(os.environ.get("BENCH_WARMUP", "1")))
+        # steps per compiled dispatch: amortizes tunnel/host dispatch latency
+        scan_k = max(1, int(os.environ.get("BENCH_SCAN", "8")))
 
     devices = jax.devices()
     n_dev = len(devices)
@@ -50,15 +135,34 @@ def main() -> None:
         "image": rs.randn(batch_size, image_size, image_size, 3).astype(np.float32),
         "label": rs.randint(0, 1000, batch_size),
     }
-    batch = dp.shard_batch(batch)
-    trainer.init_state(batch)
-    step = trainer._make_step()
+    trainer.init_state(dp.shard_batch(batch))
 
-    from paddle_tpu.core.benchmark import time_train_steps
+    from paddle_tpu.core.benchmark import time_multi_steps, time_train_steps
 
-    sec_per_step, _ = time_train_steps(
-        step, trainer.state, batch, steps=steps, warmup=warmup
-    )
+    if scan_k > 1:
+        # K distinct stacked batches per dispatch, scanned inside one
+        # compiled program (SGDTrainer.make_multi_step)
+        batches = dp.shard_batches(
+            {
+                "image": rs.randn(
+                    scan_k, batch_size, image_size, image_size, 3
+                ).astype(np.float32),
+                "label": rs.randint(0, 1000, (scan_k, batch_size)),
+            }
+        )
+        multi = trainer.make_multi_step()
+        dispatches = max(1, steps // scan_k)
+        sec_per_step, _ = time_multi_steps(
+            multi, trainer.state, batches, scan_k,
+            dispatches=dispatches, warmup=warmup,
+        )
+        steps = dispatches * scan_k
+    else:
+        step = trainer._make_step()
+        batch = dp.shard_batch(batch)
+        sec_per_step, _ = time_train_steps(
+            step, trainer.state, batch, steps=steps, warmup=warmup
+        )
     dt = sec_per_step * steps
 
     images_per_sec = batch_size * steps / dt
@@ -85,8 +189,80 @@ def main() -> None:
         "batch_size": batch_size,
         "image_size": image_size,
         "ms_per_step": round(1000 * dt / steps, 2),
+        "scan_k": scan_k,
     }
-    print(json.dumps(out))
+    if cpu_fallback:
+        out["error"] = (
+            "tpu backend unavailable after probe retries; numbers are from the "
+            "CPU fallback at reduced shapes"
+        )
+    return out
+
+
+def main() -> None:
+    # last-resort watchdog: if the bench wedges after a successful probe
+    # (e.g. the tunnel dies mid-run while the GIL is released on an RPC
+    # wait), still emit the JSON error line instead of hanging the driver
+    total_timeout = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "2400"))
+    emit_lock = threading.Lock()
+    emitted = [False]
+
+    def _emit_once(obj: dict) -> None:
+        with emit_lock:
+            if not emitted[0]:
+                emitted[0] = True
+                _emit(obj)
+
+    def _watchdog() -> None:
+        _emit_once(_error_payload(f"bench watchdog fired after {total_timeout:.0f}s"))
+        os._exit(0)
+
+    timer = threading.Timer(total_timeout, _watchdog)
+    timer.daemon = True
+    timer.start()
+
+    cpu_fallback = os.environ.get("BENCH_FORCE_CPU") == "1"
+    if not cpu_fallback:
+        info = probe_backend()
+        if info is None or info.get("platform") == "cpu":
+            # None = tunnel down/hung; platform 'cpu' = JAX silently fell
+            # back inside the probe child — either way run reduced shapes
+            cpu_fallback = True
+        else:
+            sys.stderr.write(f"[bench] backend up: {info}\n")
+
+    try:
+        out = run_bench(cpu_fallback)
+    except Exception:
+        err = traceback.format_exc()
+        sys.stderr.write(err)
+        if not cpu_fallback:
+            # accelerator run died (OOM, compile error, tunnel drop). The
+            # axon backend is already initialized in this process, so the
+            # jax_platforms config can no longer be switched — rerun the CPU
+            # fallback in a fresh interpreter and relay its JSON line.
+            out = _error_payload(err)
+            try:
+                env = dict(os.environ, BENCH_FORCE_CPU="1")
+                sub = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    capture_output=True,
+                    text=True,
+                    timeout=900,
+                    env=env,
+                )
+                if sub.returncode == 0 and sub.stdout.strip():
+                    out = json.loads(sub.stdout.strip().splitlines()[-1])
+                    out["error"] = (
+                        "accelerator run failed: "
+                        + err.strip().splitlines()[-1]
+                    )
+            except Exception:
+                pass
+        else:
+            out = _error_payload(err)
+    timer.cancel()
+    _emit_once(out)
 
 
 if __name__ == "__main__":

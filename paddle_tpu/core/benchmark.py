@@ -35,3 +35,29 @@ def time_train_steps(
     dt = time.perf_counter() - t0
     assert np.isfinite(final), f"non-finite cost during timing: {final}"
     return dt / steps, state
+
+
+def time_multi_steps(
+    multi: Callable,
+    state: Any,
+    batches: Dict[str, Any],
+    k: int,
+    dispatches: int = 4,
+    warmup: int = 1,
+) -> Tuple[float, Any]:
+    """Times the K-step scan driver (SGDTrainer.make_multi_step): each
+    dispatch runs `k` train steps in one compiled program. Returns
+    (seconds_per_step, final_state); the barrier is a value fetch of the
+    last scanned cost (see module docstring for why not block_until_ready)."""
+    for _ in range(max(warmup, 1)):
+        state, costs = multi(state, batches)
+    warm = float(costs[-1])
+    assert np.isfinite(warm), f"non-finite cost during warmup: {warm}"
+
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        state, costs = multi(state, batches)
+    final = float(costs[-1])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final), f"non-finite cost during timing: {final}"
+    return dt / (dispatches * k), state

@@ -56,12 +56,23 @@ class DataParallel:
                 return False
         return True
 
-    def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+    def _put(self, batch: Dict[str, Any], sharding: NamedSharding) -> Dict[str, Any]:
         out = {}
         for k, v in batch.items():
             v = np.asarray(v) if not isinstance(v, jax.Array) else v
-            out[k] = jax.device_put(v, self._batch_sharding)
+            out[k] = jax.device_put(v, sharding)
         return out
+
+    def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        return self._put(batch, self._batch_sharding)
+
+    def shard_batches(self, batches: Dict[str, Any]) -> Dict[str, Any]:
+        """Shard a K-stacked batch dict ([K, B, ...] per slot) for the
+        multi-step scan driver: the scan axis stays unsharded, batch axis 1
+        shards over the mesh data axis."""
+        return self._put(
+            batches, NamedSharding(self.mesh, P(None, self.batch_axis))
+        )
 
     def shard_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
         params = {

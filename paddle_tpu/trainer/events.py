@@ -1,4 +1,12 @@
-"""Training events, parity with python/paddle/v2/event.py:45-88."""
+"""Training events, parity with python/paddle/v2/event.py:45-88.
+
+EndIteration carries its cost/metrics LAZILY: the trainer hands it the raw
+device values, and conversion to Python floats happens only when a handler
+actually reads `.cost` / `.metrics`. Handlers that merely count batches (or
+read the cost every N batches) therefore no longer force a device sync per
+batch — the async dispatch pipeline keeps running (the reference's hot loop
+never blocks on the cost either; TrainerInternal.cpp only sums it for the
+log-period line)."""
 
 from __future__ import annotations
 
@@ -23,9 +31,44 @@ class BeginIteration:
     batch_id: int
 
 
-@dataclasses.dataclass
 class EndIteration:
-    pass_id: int
-    batch_id: int
-    cost: float
-    metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    """End-of-batch event. `cost` and `metrics` are fetched from the device
+    on first access (and cached), so installing a handler is free unless the
+    handler reads the values."""
+
+    __slots__ = ("pass_id", "batch_id", "_cost", "_metrics", "_metrics_np")
+
+    def __init__(
+        self,
+        pass_id: int,
+        batch_id: int,
+        cost: Any,
+        metrics: Optional[Dict[str, Any]] = None,
+    ):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self._cost = cost
+        self._metrics = metrics or {}
+        self._metrics_np: Optional[Dict[str, Any]] = None
+
+    @property
+    def cost(self) -> float:
+        if not isinstance(self._cost, float):
+            self._cost = float(self._cost)
+        return self._cost
+
+    @property
+    def metrics(self) -> Dict[str, Any]:
+        if self._metrics_np is None:
+            import numpy as np
+
+            self._metrics_np = {
+                k: np.asarray(v) for k, v in self._metrics.items()
+            }
+        return self._metrics_np
+
+    def __repr__(self) -> str:  # avoid syncing in repr-driven debugging
+        return (
+            f"EndIteration(pass_id={self.pass_id}, batch_id={self.batch_id}, "
+            f"cost=<lazy>)"
+        )

@@ -87,7 +87,9 @@ class SGDTrainer:
         return state
 
     # -- compiled step -------------------------------------------------------
-    def _make_step(self):
+    def _build_step(self):
+        """The raw (untraced) train-step function; _make_step jits it and
+        make_multi_step scans it."""
         net = self.network
         cost_names = self.cost_names
         extra_names = self.extra_names
@@ -127,9 +129,35 @@ class SGDTrainer:
             extras = {n: outs[n].value for n in extra_names}
             return new_state, cost, extras
 
+        return step
+
+    def _make_step(self):
+        step = self._build_step()
         if self.parallel is not None:
             return self.parallel.compile_step(step)
         return jax.jit(step, donate_argnums=0)
+
+    def make_multi_step(self):
+        """K train steps per device dispatch: `multi(state, batches)` where
+        every batch slot is stacked on a leading K axis, scanned with
+        lax.scan inside ONE compiled program. Returns (new_state, costs[K]).
+
+        This amortizes per-dispatch host latency (dominant on remote-tunnel
+        or small-step workloads) and lets XLA overlap the tail of step i with
+        the head of step i+1 — the TPU-native analog of the reference's
+        compute/comm overlap in ConcurrentRemoteParameterUpdater
+        (RemoteParameterUpdater.h:180)."""
+        step = self._build_step()
+
+        def multi(state: TrainState, batches: Dict[str, Any]):
+            def body(s, b):
+                s2, cost, _ = step(s, b)
+                return s2, cost
+
+            state, costs = jax.lax.scan(body, state, batches)
+            return state, costs
+
+        return jax.jit(multi, donate_argnums=0)
 
     def _make_eval(self):
         net = self.network
@@ -161,12 +189,11 @@ class SGDTrainer:
     ) -> TrainState:
         """reader yields batches (lists of samples if feeder given, else dicts
         of arrays). One call = `num_passes` passes (v1 --num_passes)."""
-        user_handler = event_handler
         event_handler = event_handler or (lambda e: None)
         for pass_id in range(num_passes):
             event_handler(BeginPass(pass_id))
             t0 = time.time()
-            costs, costs_n, n_batches = 0.0, 0, 0
+            cost_sum_dev, n_batches = None, 0
             for batch_id, raw in enumerate(reader()):
                 # dict batches are already feed-ready (e.g. from a DoubleBuffer
                 # that ran the feeder on its prefetch thread)
@@ -200,21 +227,20 @@ class SGDTrainer:
                     if stats.GLOBAL_STATS.enabled:
                         jax.block_until_ready(cost)
                 n_batches += 1
-                # only sync the device when someone will look at the value —
-                # otherwise keep the async dispatch pipeline running
-                if user_handler is not None or batch_id % log_period == 0:
-                    c = float(cost)
-                    costs += c
-                    costs_n += 1
-                    event_handler(
-                        EndIteration(
-                            pass_id, batch_id, c, {k: np.asarray(v) for k, v in extras.items()}
-                        )
+                # accumulate the pass cost ON DEVICE (async scalar add) and
+                # hand handlers a lazy event — the device is synced only when
+                # a handler reads event.cost or at log_period, so the async
+                # dispatch pipeline keeps running between log lines
+                cost_sum_dev = cost if cost_sum_dev is None else cost_sum_dev + cost
+                event_handler(EndIteration(pass_id, batch_id, cost, extras))
+                if batch_id % log_period == 0:
+                    log.info(
+                        "pass %d batch %d cost=%.6f", pass_id, batch_id, float(cost)
                     )
-                    if batch_id % log_period == 0:
-                        log.info("pass %d batch %d cost=%.6f", pass_id, batch_id, c)
             metrics: Dict[str, Any] = {
-                "avg_cost": costs / max(costs_n, 1),
+                "avg_cost": (
+                    float(cost_sum_dev) / n_batches if n_batches else 0.0
+                ),
                 "batches": n_batches,
                 "pass_seconds": time.time() - t0,
             }

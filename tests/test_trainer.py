@@ -185,3 +185,61 @@ def test_feeder_truncates_over_bucket():
     batch = feeder([{"ids": list(range(9))}])
     assert batch["ids"].shape == (1, 4)
     np.testing.assert_array_equal(batch["ids.lengths"], [4])
+
+
+def test_multi_step_scan_matches_sequential():
+    """make_multi_step: K scanned steps in one compiled program must produce
+    the same state as K sequential compiled steps."""
+    import jax
+
+    data = {
+        "x": np.random.RandomState(0).randn(16, 8).astype(np.float32),
+        "label": np.random.RandomState(1).randint(0, 4, 16),
+    }
+
+    def build():
+        reset_name_scope()
+        _, _, _, cost = _build()
+        return SGDTrainer(cost, SGD(learning_rate=0.5))
+
+    K = 3
+    t_seq = build()
+    t_seq.init_state(data)
+    step = t_seq._make_step()
+    s = t_seq.state
+    for _ in range(K):
+        s, cost_seq, _ = step(s, data)
+
+    t_scan = build()
+    t_scan.init_state(data)
+    multi = t_scan.make_multi_step()
+    batches = {k: np.stack([v] * K) for k, v in data.items()}
+    s2, costs = multi(t_scan.state, batches)
+    assert costs.shape == (K,)
+    np.testing.assert_allclose(float(costs[-1]), float(cost_seq), rtol=1e-5)
+    for k in s["params"]:
+        np.testing.assert_allclose(
+            np.asarray(s["params"][k]), np.asarray(s2["params"][k]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_end_iteration_event_is_lazy():
+    """Handlers that don't read .cost must not force a device sync; reading
+    .cost/.metrics fetches and caches."""
+    _, _, _, cost = _build()
+    tr = SGDTrainer(cost, SGD(learning_rate=0.1))
+    reader = rd.batch(_toy_classification_reader(n=32), 16)
+    feeder = DataFeeder({"x": dense_vector(8), "label": integer_value(4)})
+
+    events = []
+    tr.train(reader, num_passes=1, event_handler=events.append, feeder=feeder)
+    iters = [e for e in events if isinstance(e, EndIteration)]
+    assert iters, "no EndIteration events delivered"
+    ev = iters[-1]
+    assert "lazy" in repr(ev)          # repr must not sync
+    c1 = ev.cost                        # first access fetches
+    assert isinstance(c1, float) and np.isfinite(c1)
+    assert ev.cost == c1                # cached
+    passes = [e for e in events if isinstance(e, EndPass)]
+    assert np.isfinite(passes[-1].metrics["avg_cost"])
