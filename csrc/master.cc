@@ -215,9 +215,13 @@ PT_EXPORT int pt_master_restore(void* mp, const char* path) {
   if (!f) return -1;
   auto r32 = [&](uint32_t* v) { return fread(v, 4, 1, f) == 1; };
   auto r64 = [&](int64_t* v) { return fread(v, 8, 1, f) == 1; };
+  // corrupt length fields must not drive multi-GiB allocations: bad_alloc
+  // would escape the extern-C ABI and abort (same class recordio.cc caps)
+  constexpr uint32_t kMaxBlob = 64u << 20;  // 64 MiB per payload/path
   auto rtask = [&](Task* t) {
     uint32_t len, fails;
     if (!r64(&t->id) || !r32(&len) || !r32(&fails)) return false;
+    if (len > kMaxBlob) return false;
     t->failures = static_cast<int>(fails);
     t->payload.resize(len);
     return len == 0 || fread(&t->payload[0], len, 1, f) == 1;
@@ -227,34 +231,42 @@ PT_EXPORT int pt_master_restore(void* mp, const char* path) {
   bool ok = r32(&magic) && magic == 0x50544D53u && r32(&version) &&
             r32(&pass) && r64(&next_id) && r32(&n_todo) && r32(&n_done) &&
             r32(&n_data);
+  // parse into locals and commit only after the whole file read cleanly —
+  // a truncated/corrupt snapshot must leave the in-memory queues untouched
+  // (same commit-after-parse shape as pt_opt_deserialize)
+  std::deque<Task> todo;
+  std::vector<Task> done;
+  std::vector<std::string> dataset;
   if (ok) {
-    m->todo.clear();
-    m->pending.clear();
-    m->done.clear();
-    m->discarded.clear();
-    m->dataset.clear();
-    m->pass = static_cast<int>(pass);
-    m->next_id = next_id;
     for (uint32_t i = 0; ok && i < n_todo; ++i) {
       Task t;
       ok = rtask(&t);
-      if (ok) m->todo.push_back(std::move(t));
+      if (ok) todo.push_back(std::move(t));
     }
     for (uint32_t i = 0; ok && i < n_done; ++i) {
       Task t;
       ok = rtask(&t);
-      if (ok) m->done.push_back(std::move(t));
+      if (ok) done.push_back(std::move(t));
     }
     for (uint32_t i = 0; ok && i < n_data; ++i) {
       uint32_t len;
-      ok = r32(&len);
+      ok = r32(&len) && len <= kMaxBlob;
+      if (!ok) break;
       std::string p(len, '\0');
-      if (ok && len) ok = fread(&p[0], len, 1, f) == 1;
-      if (ok) m->dataset.push_back(std::move(p));
+      if (len) ok = fread(&p[0], len, 1, f) == 1;
+      if (ok) dataset.push_back(std::move(p));
     }
   }
   fclose(f);
-  return ok ? 0 : -1;
+  if (!ok) return -1;
+  m->todo = std::move(todo);
+  m->pending.clear();
+  m->done = std::move(done);
+  m->discarded.clear();
+  m->dataset = std::move(dataset);
+  m->pass = static_cast<int>(pass);
+  m->next_id = next_id;
+  return 0;
 }
 
 PT_EXPORT void pt_master_destroy(void* mp) { delete static_cast<Master*>(mp); }

@@ -22,6 +22,11 @@ namespace pt {
 namespace {
 
 constexpr uint32_t kMagic = 0x50545243u;  // "PTRC"
+// Shared format limit (writers enforce, readers treat violations as
+// corruption; mirrored by _PyWriter/_py_read in runtime/recordio.py). Keeps a
+// corrupted data_len from driving a multi-GiB allocation whose bad_alloc
+// would escape the extern-C ABI.
+constexpr uint32_t kMaxChunkBytes = 1u << 30;  // 1 GiB
 
 struct Writer {
   FILE* f = nullptr;
@@ -65,6 +70,12 @@ struct Reader {
         // stream corrupt beyond chunk framing: stop rather than scan
         ++bad_chunks;
         return false;
+      }
+      // A data_len beyond the format limit (which writers enforce) is
+      // corruption — never a legitimate chunk.
+      if (head[2] > kMaxChunkBytes) {
+        ++bad_chunks;
+        return false;  // framing untrustworthy: stop rather than scan
       }
       std::string data(head[2], '\0');
       if (head[2] && fread(&data[0], head[2], 1, f) != 1) {
@@ -119,6 +130,8 @@ PT_EXPORT void* pt_recordio_writer_open(const char* path, int chunk_records,
 
 PT_EXPORT int pt_recordio_write(void* wp, const void* buf, uint64_t len) {
   auto* w = static_cast<Writer*>(wp);
+  // reject records the format cannot represent in a readable chunk
+  if (len + 4 > pt::kMaxChunkBytes) return -2;
   w->pending.emplace_back(static_cast<const char*>(buf), len);
   w->pending_bytes += len;
   if (w->pending.size() >= static_cast<size_t>(w->chunk_records) ||

@@ -22,7 +22,11 @@ def append_backward(
     program: Program = loss.block.program
     block = program.global_block()
     params = list(parameter_list) if parameter_list else [
-        v for v in block.vars.values() if v.persistable and not _is_slot(v.name)
+        v
+        for v in block.vars.values()
+        if v.persistable
+        and v.desc.trainable  # explicit registry, not name-substring matching
+        and not v.name.endswith("@GRAD")
     ]
     n_fwd = len(block.desc.ops)
     grad_vars = []
@@ -42,10 +46,3 @@ def append_backward(
     return grad_vars
 
 
-def _is_slot(name: str) -> bool:
-    """Optimizer slot vars (moments, velocities, lr) are persistable but not
-    trainable parameters."""
-    return any(
-        tag in name
-        for tag in ("_moment", "_velocity", "_beta", "_lr", "_mean_square", "@GRAD")
-    ) or name.endswith(("_mean", "_variance"))

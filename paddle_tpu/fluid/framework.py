@@ -23,6 +23,10 @@ class VarDesc:
     shape: Optional[Sequence[int]] = None  # None → inferred at first write
     dtype: Any = np.float32
     persistable: bool = False  # parameters & optimizer slots
+    # False for optimizer slots (moments/lr) and BN moving stats: persistable
+    # state that must not receive gradients. An explicit registry — gradient
+    # filtering must never rely on name-substring heuristics.
+    trainable: bool = True
     is_data: bool = False
     lod_level: int = 0  # kept for LoDTensor parity (ragged inputs)
     initializer: Optional[Any] = None  # ("uniform", lo, hi) | ("constant", v) | ndarray

@@ -157,8 +157,12 @@ def batch_norm(input: Variable, act: Optional[str] = None, name: Optional[str] =
     c = input.desc.shape[-3] if len(input.desc.shape) >= 3 else input.desc.shape[-1]
     scale = block.create_parameter(f"{name}.scale", shape=[c], initializer=("constant", 1.0))
     bias = block.create_parameter(f"{name}.bias", shape=[c], initializer=("constant", 0.0))
-    mean = block.create_parameter(f"{name}_mean", shape=[c], initializer=("constant", 0.0))
-    var = block.create_parameter(f"{name}_variance", shape=[c], initializer=("constant", 1.0))
+    mean = block.create_parameter(
+        f"{name}_mean", shape=[c], initializer=("constant", 0.0), trainable=False
+    )
+    var = block.create_parameter(
+        f"{name}_variance", shape=[c], initializer=("constant", 1.0), trainable=False
+    )
     out = block.create_var(f"{name}.out", shape=input.desc.shape)
     block.append_op(
         "batch_norm",

@@ -23,7 +23,8 @@ class Optimizer:
         if name in block.vars:
             return block.vars[name]
         return block.create_parameter(
-            name, shape=[], initializer=("constant", self.learning_rate)
+            name, shape=[], initializer=("constant", self.learning_rate),
+            trainable=False,
         )
 
     def _slots(self, block, param: Variable) -> dict:
@@ -66,7 +67,7 @@ class MomentumOptimizer(Optimizer):
     def _slots(self, block, param):
         v = block.create_parameter(
             f"{param.name}_velocity", shape=param.desc.shape,
-            initializer=("constant", 0.0),
+            initializer=("constant", 0.0), trainable=False,
         )
         return {"Velocity": v}
 
@@ -91,7 +92,7 @@ class AdagradOptimizer(Optimizer):
     def _slots(self, block, param):
         m = block.create_parameter(
             f"{param.name}_moment", shape=param.desc.shape,
-            initializer=("constant", 0.0),
+            initializer=("constant", 0.0), trainable=False,
         )
         return {"Moment": m}
 
@@ -117,7 +118,7 @@ class AdamOptimizer(Optimizer):
         mk = lambda tag, val=0.0, shape=None: block.create_parameter(
             f"{param.name}_{tag}",
             shape=param.desc.shape if shape is None else shape,
-            initializer=("constant", val),
+            initializer=("constant", val), trainable=False,
         )
         return {
             "Moment1": mk("moment1"),

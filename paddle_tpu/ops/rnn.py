@@ -9,9 +9,15 @@ kernel, and the big input projections are hoisted OUT of the scan as one large
 [B*T, 4H] matmul on the MXU (the reference does the same hoist: the layer
 projects via Mixed/fc before LstmLayer).
 
-Gate conventions match the reference (LstmCompute.cu / GruCompute.cu):
-LSTM gates in order [input, forget, cell(candidate), output] with optional
-peephole ("check") weights; GRU gates [update(z), reset(r), candidate(c)]."""
+Gate conventions — NOTE the LSTM block order intentionally differs from the
+reference: here the 4H weight/bias blocks are [input, forget, cell(candidate),
+output], while the reference packs [candidate(In), input(Ig), forget(Fg),
+output(Og)] (hl_cpu_lstm.cuh:42-45, hl_gpu_lstm.cuh). The math is identical;
+only the block layout differs — any loader interchanging LSTM weights with
+reference-trained models MUST permute the 4H blocks accordingly (no such
+loader exists yet; reference-format weights cannot currently be loaded into
+LSTM layers unpermuted). GRU gates [update(z), reset(r), candidate(c)] match
+GruCompute.cu. Optional peephole ("check") weights as in the reference."""
 
 from __future__ import annotations
 

@@ -3,12 +3,19 @@ memory.cc:61 GetGPUBuddyAllocator / detail/buddy_allocator.h:33).
 
 Serves numpy staging buffers for the feed path: `pool.ndarray(shape, dtype)`
 returns an array backed by pool memory so repeated batch assembly reuses the
-same arena instead of churning the Python heap."""
+same arena instead of churning the Python heap.
+
+Safety: `release(arr)` only MARKS the block releasable — the underlying
+pt_pool_free happens when the last numpy view over the block is garbage
+collected (weakref finalizer on the base array), so a released-but-still-
+referenced buffer can never be handed out again while readable (no
+use-after-free). `close()` refuses while any view is alive."""
 
 from __future__ import annotations
 
 import ctypes as C
-from typing import Dict, Optional, Sequence, Tuple
+import weakref
+from typing import Dict, Sequence
 
 import numpy as np
 
@@ -25,6 +32,9 @@ class HostPool:
         if not self._pool:
             raise MemoryError(f"cannot create {total_bytes}-byte host pool")
         self._live: Dict[int, int] = {}  # addr -> nbytes
+        # addr -> finalizer on the base view; present only for ndarray() blocks
+        self._viewed: Dict[int, weakref.finalize] = {}
+        self._releasable: set = set()  # release()d, awaiting view death
 
     def alloc(self, nbytes: int) -> int:
         addr = self._lib.pt_pool_alloc(self._pool, nbytes)
@@ -34,27 +44,57 @@ class HostPool:
         return addr
 
     def free(self, addr: int) -> None:
+        if addr in self._viewed:
+            raise ValueError(
+                f"block {addr:#x} is backing a numpy view; use release(arr)"
+            )
         if self._lib.pt_pool_free(self._pool, addr) != 0:
             raise ValueError(f"invalid free of {addr:#x}")
         self._live.pop(addr, None)
+        # never let a stale releasable flag survive address reuse
+        self._releasable.discard(addr)
 
     def ndarray(self, shape: Sequence[int], dtype=np.float32) -> np.ndarray:
-        """A numpy array over pool memory. Call release(arr) when done."""
+        """A numpy array over pool memory. Call release(arr) when done; the
+        block returns to the pool once every view of it has been collected."""
         dt = np.dtype(dtype)
         nbytes = int(np.prod(shape)) * dt.itemsize
         addr = self.alloc(max(nbytes, 1))
         buf = (C.c_char * nbytes).from_address(addr)
-        arr = np.frombuffer(buf, dtype=dt).reshape(shape)
-        arr.flags.writeable = True
-        self._live[addr] = nbytes
-        return arr
+        base = np.frombuffer(buf, dtype=dt)
+        base.flags.writeable = True
+        # every derived view (reshape below, user slices) keeps `base` alive
+        # through its .base chain, so this fires only when no view remains
+        self._viewed[addr] = weakref.finalize(base, self._on_views_dead, addr)
+        return base.reshape(shape)
+
+    def _on_views_dead(self, addr: int) -> None:
+        try:
+            self._viewed.pop(addr, None)
+            if addr in self._releasable:
+                self._releasable.discard(addr)
+                if self._pool:
+                    self._lib.pt_pool_free(self._pool, addr)
+                self._live.pop(addr, None)
+        except Exception:
+            pass  # interpreter shutdown
 
     def release(self, arr: np.ndarray) -> None:
-        # the view's data pointer is the pool block's base address
+        """Mark the block backing `arr` for return to the pool. The actual
+        free is deferred until all views die (CPython refcounting makes that
+        immediate once the caller drops its reference)."""
         addr = arr.__array_interface__["data"][0]
         if addr not in self._live:
             raise ValueError("array was not allocated from this pool")
-        self.free(addr)
+        if addr not in self._viewed:
+            # raw alloc() block (no tracked view -> nothing would ever fire
+            # the deferred free): the caller owns its lifetime via free()
+            raise ValueError(
+                f"block {addr:#x} was not created by ndarray(); use free(addr)"
+            )
+        if addr in self._releasable:
+            raise ValueError(f"double release of block {addr:#x}")
+        self._releasable.add(addr)
 
     def stats(self) -> Dict[str, int]:
         out = (C.c_uint64 * 5)()
@@ -69,11 +109,20 @@ class HostPool:
 
     def close(self) -> None:
         if self._pool:
+            if self._viewed:
+                raise RuntimeError(
+                    f"cannot close host pool: {len(self._viewed)} numpy "
+                    f"view(s) still alive over pool memory"
+                )
             self._lib.pt_pool_destroy(self._pool)
             self._pool = None
 
     def __del__(self):
         try:
+            # never munmap under live views even during teardown — leaking at
+            # process end beats a segfault
+            if getattr(self, "_viewed", None):
+                return
             self.close()
         except Exception:
             pass

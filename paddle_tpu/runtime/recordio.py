@@ -42,7 +42,13 @@ class Writer:
     def write(self, record: bytes) -> None:
         if self._native is not None:
             L, h = self._native
-            if L.pt_recordio_write(h, record, len(record)) != 0:
+            rc = L.pt_recordio_write(h, record, len(record))
+            if rc == -2:
+                raise ValueError(
+                    f"record of {len(record)} bytes exceeds the recordio "
+                    f"format limit ({MAX_CHUNK_BYTES} bytes per chunk)"
+                )
+            if rc != 0:
                 raise OSError("recordio write failed")
         else:
             self._py.write(record)
@@ -110,6 +116,12 @@ class Reader:
 # -- pure-Python same-format implementation ---------------------------------
 
 
+# shared format limit — keep in sync with kMaxChunkBytes in csrc/recordio.cc:
+# writers reject records the format cannot represent; readers treat a larger
+# data_len as corruption
+MAX_CHUNK_BYTES = 1 << 30
+
+
 class _PyWriter:
     def __init__(self, path: str, chunk_records: int, chunk_bytes: int):
         self.f = open(path, "wb")
@@ -119,6 +131,11 @@ class _PyWriter:
         self.pending_bytes = 0
 
     def write(self, record: bytes) -> None:
+        if len(record) + _LEN.size > MAX_CHUNK_BYTES:
+            raise ValueError(
+                f"record of {len(record)} bytes exceeds the recordio format "
+                f"limit ({MAX_CHUNK_BYTES} bytes per chunk)"
+            )
         self.pending.append(record)
         self.pending_bytes += len(record)
         if (
@@ -158,6 +175,9 @@ def _py_read(path: str, error_box: Optional[List[int]] = None) -> Iterator[bytes
             magic, n_rec, data_len, crc = _HEAD.unpack(head)
             if magic != _MAGIC:
                 bad()  # framing lost: stop rather than scan (native parity)
+                return
+            if data_len > MAX_CHUNK_BYTES:
+                bad()  # over format limit: corruption (native parity)
                 return
             data = f.read(data_len)
             if len(data) < data_len:

@@ -171,3 +171,31 @@ def test_elementwise_axis_broadcast():
     out = fn(OpContext(), {"X": [x], "Y": [y]}, {"axis": 1})["Out"]
     assert out.shape == (2, 3, 4)
     np.testing.assert_allclose(np.asarray(out[0, :, 0]), [1.0, 2.0, 3.0])
+
+
+def test_slot_like_param_names_still_train():
+    """Gradient filtering uses the explicit trainable registry, not name
+    substrings: a user parameter named like an optimizer slot (e.g. 'x_beta',
+    'emb_lr') must still receive gradients and train, while real slots and BN
+    moving stats stay excluded."""
+    feat, lbl = _toy_classification()
+    x = L.data("x", shape=[16])
+    y = L.data("y", shape=[1], dtype=np.int32)
+    # fc layers whose parameter names contain classic slot substrings
+    h = L.fc(x, 32, act="tanh", name="word_lr_emb")
+    out = L.fc(h, 4, act="softmax", name="x_beta")
+    loss = L.mean(L.cross_entropy(out, y))
+
+    prog = fluid.default_main_program()
+    pg = fluid.optimizer.MomentumOptimizer(learning_rate=0.5).minimize(loss)
+    trained = {p.name for p, _ in pg}
+    assert "word_lr_emb.w" in trained and "x_beta.w" in trained
+    # slots created by the optimizer must NOT be in the gradient list
+    assert not any(n.endswith("_velocity") or n == "momentum_lr" for n in trained)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    (l0,) = exe.run(prog, feed={"x": feat, "y": lbl}, fetch_list=[loss], scope=scope)
+    for _ in range(20):
+        (l1,) = exe.run(prog, feed={"x": feat, "y": lbl}, fetch_list=[loss], scope=scope)
+    assert float(l1) < float(l0) / 2, (float(l0), float(l1))

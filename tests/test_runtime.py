@@ -60,7 +60,15 @@ def test_pool_ndarray_roundtrip():
     arr[:] = np.arange(512, dtype=np.float32).reshape(16, 32)
     assert float(arr.sum()) == float(np.arange(512).sum())
     pool.release(arr)
-    assert pool.stats()["in_use"] == 0
+    # the block is NOT reusable while the view is alive (no use-after-free):
+    assert pool.stats()["in_use"] > 0
+    with pytest.raises(RuntimeError, match="view"):
+        pool.close()
+    view = arr[2:4]  # derived views extend the block's lifetime
+    del arr
+    assert pool.stats()["in_use"] > 0
+    del view
+    assert pool.stats()["in_use"] == 0  # freed once the last view died
     pool.close()
 
 
@@ -297,3 +305,44 @@ def test_native_optimizer_linear_lr_policy():
     for _ in range(10):
         o.update(p, g)
     assert abs(o.current_lr - 0.1) < 1e-9  # floored
+
+
+def test_master_restore_truncated_snapshot_preserves_state(tmp_path):
+    """A corrupt/truncated snapshot must fail WITHOUT destroying the live
+    queues (commit-after-parse in pt_master_restore)."""
+    snap = str(tmp_path / "good.snap")
+    m = TaskMaster(timeout_s=60, failure_max=3)
+    m.set_dataset([f"s{i}" for i in range(6)], chunks_per_task=2)
+    m.snapshot(snap)
+    blob = open(snap, "rb").read()
+    bad = str(tmp_path / "bad.snap")
+    open(bad, "wb").write(blob[: len(blob) // 2])  # truncate mid-task
+
+    before = m.stats()
+    assert before["todo"] == 3
+    with pytest.raises(OSError):
+        m.restore(bad)
+    after = m.stats()
+    assert after == before, "failed restore must not clobber live state"
+    # and the master still dispatches normally
+    assert m.get_task() is not None
+    m.close()
+
+
+def test_recordio_oversized_chunk_header_is_corruption(tmp_path):
+    """A corrupted data_len with intact magic must be treated as corruption,
+    not drive a multi-GiB allocation."""
+    import struct
+
+    path = str(tmp_path / "x.recordio")
+    with recordio.Writer(path) as w:
+        for i in range(5):
+            w.write(f"rec{i}".encode())
+
+    blob = bytearray(open(path, "rb").read())
+    # chunk header: magic, n_records, data_len, crc — patch data_len huge
+    struct.pack_into("<I", blob, 8, 0xF0000000)
+    open(path, "wb").write(bytes(blob))
+
+    r = recordio.Reader(path)
+    assert list(r) == []  # framing untrustworthy -> no records, no abort
