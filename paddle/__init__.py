@@ -1,0 +1,23 @@
+"""`paddle` compatibility namespace.
+
+The v1 stack's import surface (SURVEY §2.4): config scripts do
+`from paddle.trainer_config_helpers import *`, data providers do
+`from paddle.trainer.PyDataProvider2 import *`, and v2 user scripts do
+`import paddle.v2 as paddle`. Each submodule here is a thin re-export of the
+corresponding paddle_tpu implementation — the real code lives in
+paddle_tpu/, this package only provides the historical import paths so
+unmodified reference scripts run.
+"""
+
+__version__ = "0.11.0-tpu"
+
+
+def init(**kwargs):
+    """paddle.init(use_gpu=..., trainer_count=...) — v2 entry point."""
+    from paddle_tpu.core import init_ctx
+
+    use_gpu = kwargs.pop("use_gpu", None)
+    if use_gpu is not None:
+        kwargs.setdefault("use_tpu", use_gpu)
+    allowed = {"use_tpu", "trainer_count", "log_period", "seed", "dtype_policy"}
+    init_ctx.init(**{k: v for k, v in kwargs.items() if k in allowed})

@@ -1,0 +1,43 @@
+"""paddle.trainer.PyDataProvider2 — the @provider data-provider surface.
+
+Re-exports the paddle_tpu implementation of the reference module
+(python/paddle/trainer/PyDataProvider2.py:365 @provider + input types
+:63-236): `@provider`, input-type constructors, CacheType.
+"""
+
+from paddle_tpu.data.provider import (  # noqa: F401
+    CacheType,
+    DataProviderWrapper,
+    Settings,
+    provider,
+)
+from paddle_tpu.data.feeder import (  # noqa: F401
+    dense_array,
+    dense_vector,
+    dense_vector_sequence,
+    integer_value,
+    integer_value_sequence,
+    sparse_binary_vector,
+    sparse_value_slot,
+)
+
+# sequence variants the reference exposes under several historical names
+sparse_binary_vector_sequence = sparse_binary_vector
+integer_sequence = integer_value_sequence
+
+
+__all__ = [
+    "provider",
+    "CacheType",
+    "DataProviderWrapper",
+    "Settings",
+    "dense_vector",
+    "dense_array",
+    "dense_vector_sequence",
+    "integer_value",
+    "integer_value_sequence",
+    "integer_sequence",
+    "sparse_binary_vector",
+    "sparse_binary_vector_sequence",
+    "sparse_value_slot",
+]

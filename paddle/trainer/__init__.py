@@ -1,0 +1,1 @@
+"""paddle.trainer — config_parser + PyDataProvider2 import paths."""

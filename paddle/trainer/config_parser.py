@@ -1,0 +1,17 @@
+"""paddle.trainer.config_parser — parse_config entry points.
+
+The reference's C++ trainer calls parse_config_and_serialize through embedded
+Python (TrainerConfigHelper.cpp:34-56); here the same names resolve to the
+paddle_tpu config pipeline.
+"""
+
+from paddle_tpu.config.config_parser import (  # noqa: F401
+    ParsedConfig,
+    define_py_data_sources2,
+    get_config_arg,
+    inputs,
+    outputs,
+    parse_config,
+    parse_config_and_serialize,
+)
+from paddle_tpu.config.optimizers import settings  # noqa: F401

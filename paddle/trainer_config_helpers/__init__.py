@@ -1,0 +1,29 @@
+"""paddle.trainer_config_helpers — the v1 config-script DSL.
+
+Star-import surface of the reference package (layers.py + activations.py +
+poolings.py + attrs.py + optimizers.py + evaluators.py + data_sources.py,
+plus the config_parser built-ins the reference re-exports: settings,
+get_config_arg, define_py_data_sources2, outputs). Implementations live in
+paddle_tpu.config; signatures match the reference (see
+paddle_tpu/config/v1_layers.py).
+"""
+
+from paddle_tpu.config.helpers import *  # noqa: F401,F403
+from paddle_tpu.config.helpers import __all__ as _helpers_all
+from paddle_tpu.config.config_parser import (  # noqa: F401
+    define_py_data_sources2,
+    get_config_arg,
+    inputs,
+    outputs,
+)
+
+# define_py_data_sources (the older single-module variant) aliases the v2 one
+define_py_data_sources = define_py_data_sources2
+
+__all__ = list(_helpers_all) + [
+    "outputs",
+    "inputs",
+    "get_config_arg",
+    "define_py_data_sources2",
+    "define_py_data_sources",
+]

@@ -1,0 +1,48 @@
+"""paddle.v2 — the v2 user API import path (`import paddle.v2 as paddle`).
+
+Aliases the paddle_tpu.v2 package and its submodules under the historical
+names so reference v2 scripts (layer/trainer/dataset/reader/event usage per
+python/paddle/v2) import unchanged.
+"""
+
+import sys as _sys
+
+import paddle_tpu.v2 as _v2
+from paddle_tpu.v2 import *  # noqa: F401,F403
+
+# submodule aliases: make `import paddle.v2.layer`, `paddle.v2.dataset.mnist`
+# etc. resolve to the paddle_tpu implementations (same module objects, so
+# state like dataset caches is shared no matter which path imported them)
+_SUBMODULES = [
+    "activation", "attr", "data_type", "event", "inference", "layer",
+    "minibatch", "networks", "optimizer", "parameters", "plot", "pooling",
+    "topology", "trainer",
+]
+for _name in _SUBMODULES:
+    _mod = getattr(
+        __import__(f"paddle_tpu.v2.{_name}", fromlist=[_name]), "__dict__", None
+    )
+    _sys.modules[f"{__name__}.{_name}"] = _sys.modules[f"paddle_tpu.v2.{_name}"]
+    globals()[_name] = _sys.modules[f"paddle_tpu.v2.{_name}"]
+
+# data/reader/dataset live under paddle_tpu.data but are paddle.v2.* names
+import paddle_tpu.data.reader as _reader  # noqa: E402
+
+_sys.modules[f"{__name__}.reader"] = _reader
+reader = _reader
+
+try:
+    import paddle_tpu.data.datasets as _datasets  # noqa: E402
+
+    _sys.modules[f"{__name__}.dataset"] = _datasets
+    dataset = _datasets
+    for _dn in getattr(_datasets, "__all__", []):
+        try:
+            _dm = __import__(f"paddle_tpu.data.datasets.{_dn}", fromlist=[_dn])
+            _sys.modules[f"{__name__}.dataset.{_dn}"] = _dm
+        except Exception:
+            pass
+except ImportError:
+    pass
+
+init = __import__("paddle").init

@@ -50,19 +50,123 @@ def _train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--num_batches", type=int, default=20, help="--job=time batches")
 
 
+# Names injected into legacy provider modules: the reference embedded
+# Python 2, so providers in the wild use py2 builtins. A compat shim at module
+# load is what lets those files run unmodified under py3.
+_PY2_SHIMS = {"xrange": range, "unicode": str, "long": int, "basestring": str}
+
+
+def _load_provider_module(name: str, config_dir: str = ""):
+    """Import a provider module, preferring the config script's directory
+    (PyDataProvider2.cpp loads module.obj next to the config), with py2
+    builtin shims injected for legacy providers."""
+    path = os.path.join(config_dir or ".", name + ".py") if name else None
+    if path and os.path.exists(path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        mod.__dict__.update(_PY2_SHIMS)
+        sys.modules.setdefault(name, mod)
+        spec.loader.exec_module(mod)
+        return mod
+    if config_dir and config_dir not in sys.path:
+        sys.path.insert(0, config_dir)
+    mod = importlib.import_module(name)
+    for k, v in _PY2_SHIMS.items():
+        mod.__dict__.setdefault(k, v)
+    return mod
+
+
 def _load_provider(dc: proto.DataConfig):
     """DataConfig → (provider, file_list, args) — the PyDataProvider2 load
     path (gserver/dataproviders/PyDataProvider2.cpp:195 loads module.obj)."""
-    mod = importlib.import_module(dc.load_data_module)
+    mod = _load_provider_module(dc.load_data_module, dc.config_dir)
     provider = getattr(mod, dc.load_data_object)
     files: List[str] = []
-    if dc.files and os.path.exists(dc.files):
-        with open(dc.files) as f:
+    flist = dc.files
+    if flist and not os.path.exists(flist) and dc.config_dir:
+        cand = os.path.join(dc.config_dir, flist)
+        if os.path.exists(cand):
+            flist = cand
+    if flist and os.path.exists(flist):
+        with open(flist) as f:
             files = [ln.strip() for ln in f if ln.strip()]
-    elif dc.files:
-        files = [dc.files]
+    elif flist:
+        files = [flist]
     args = json.loads(dc.load_data_args) if dc.load_data_args else None
     return provider, files, args
+
+
+def bind_provider_types(topology, dc: proto.DataConfig):
+    """Bind the provider's input_types to the topology's data layers — the
+    runtime slot binding PyDataProvider2.cpp does. Returns a feeding map
+    {layer_name: slot_index} (sample tuples arrive in slot order).
+
+    Dict input_types bind by name. List input_types bind positionally over
+    the data layers in declaration order, except when the declared sizes are
+    incompatible (e.g. GoogleNet declares the label layer first while the
+    provider yields (image, label)) — then slots match by kind and size the
+    way DataProviderConverter reconciles Arguments."""
+    provider, files, args = _load_provider(dc)
+    kwargs = dict(args) if isinstance(args, dict) else {}
+    settings = provider.make_settings(obj=None, file_list=files, **kwargs)
+    types = settings.input_types
+    if types is None:
+        return None
+    layers = list(topology.data_layers().values())
+
+    def apply_spec(layer, spec):
+        from paddle_tpu.v2.layer import data as _v2_data
+
+        tmpl = _v2_data(layer.name + ".__tmpl__", spec)
+        layer.data_type = spec
+        layer.shape = tmpl.shape
+        layer.is_seq = tmpl.is_seq
+
+    if isinstance(types, dict):
+        feeding = {}
+        for i, (lname, spec) in enumerate(types.items()):
+            layer = topology.data_layers().get(lname)
+            if layer is None:
+                raise ValueError(f"provider input_types names unknown layer {lname!r}")
+            apply_spec(layer, spec)
+            feeding[lname] = i
+        return feeding
+
+    types = list(types)
+    if len(types) != len(layers):
+        raise ValueError(
+            f"provider declares {len(types)} slots but the config has "
+            f"{len(layers)} data layers"
+        )
+
+    def declared_size(layer):
+        size = getattr(layer, "_v1_size", None)
+        if size is None and getattr(layer, "shape", None):
+            size = 1
+            for d in layer.shape:
+                size *= int(d)
+        return size
+
+    def compatible(layer, spec) -> bool:
+        if spec.kind.startswith("dense") and not isinstance(spec.dim, tuple):
+            return declared_size(layer) in (None, int(spec.dim))
+        return True
+
+    order = list(layers)
+    if not all(compatible(l, s) for l, s in zip(order, types)):
+        # declaration order mismatches the slot order — rebind dense slots
+        # to the layers whose declared size matches, then fill the rest
+        remaining = list(layers)
+        order = []
+        for spec in types:
+            pick = next((l for l in remaining if compatible(l, spec)), remaining[0])
+            remaining.remove(pick)
+            order.append(pick)
+    for layer, spec in zip(order, types):
+        apply_spec(layer, spec)
+    return {layer.name: i for i, layer in enumerate(order)}
 
 
 def _make_reader(dc: proto.DataConfig, batch_size: int, is_train: bool = True) -> Callable:
@@ -150,12 +254,22 @@ def cmd_train(args: argparse.Namespace) -> int:
         parallel=parallel,
         seed=args.seed,
     )
-    feeder = pc.topology.make_feeder()
     batch_size = oc.batch_size or 32
 
     if pc.trainer_config.data_config is None and args.job != "test":
         print("config declares no data sources (define_py_data_sources2)", file=sys.stderr)
         return 2
+
+    # bind the provider's input_types to the data layers (the runtime slot
+    # binding PyDataProvider2.cpp performs) before building the feeder
+    feeding = None
+    bind_dc = pc.trainer_config.data_config or pc.trainer_config.test_data_config
+    if bind_dc is not None:
+        try:
+            feeding = bind_provider_types(pc.topology, bind_dc)
+        except Exception as e:
+            print(f"warning: provider type binding failed: {e}", file=sys.stderr)
+    feeder = pc.topology.make_feeder(feeding)
     reader = (
         _make_reader(pc.trainer_config.data_config, batch_size)
         if pc.trainer_config.data_config

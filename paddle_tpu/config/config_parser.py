@@ -180,6 +180,17 @@ def parse_config(
             if ret is not None and not ctx.outputs:
                 outputs(ret)
         else:
+            # config scripts import `paddle.trainer_config_helpers` — make
+            # sure the compat namespace resolves regardless of the caller's
+            # cwd (scripts are usually parsed from their own data directory)
+            import os
+            import sys
+
+            repo_root = os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            )
+            if repo_root not in sys.path:
+                sys.path.insert(0, repo_root)
             ns = _dsl_namespace()
             ns["__file__"] = config
             with open(config) as f:
@@ -189,6 +200,13 @@ def parse_config(
             raise ValueError(
                 f"config {config!r} declared no outputs(); call outputs(cost)"
             )
+        if not callable(config):
+            import os
+
+            cfg_dir = os.path.dirname(os.path.abspath(config))
+            for dc in (ctx.data_config, ctx.test_data_config):
+                if dc is not None and not dc.config_dir:
+                    dc.config_dir = cfg_dir
         topology = Topology(ctx.outputs)
         tc = proto.TrainerConfig(
             opt_config=ctx.opt_config or proto.OptimizationConfig(),

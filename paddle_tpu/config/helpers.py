@@ -53,6 +53,7 @@ from paddle_tpu.config.optimizers import (
 )
 
 ParameterAttribute = ParamAttr
+ExtraAttr = ExtraLayerAttribute
 
 # -- input types (PyDataProvider2.py:63-236) --------------------------------
 dense_vector = _feeder.dense_vector
@@ -64,21 +65,12 @@ sparse_binary_vector = _feeder.sparse_binary_vector
 sparse_value_slot = _feeder.sparse_value_slot
 
 # -- layers (trainer_config_helpers/layers.py ~100 wrappers) ----------------
-data_layer = _v2.data
-fc_layer = _v2.fc
-embedding_layer = _v2.embedding
-img_conv_layer = _v2.img_conv
-img_pool_layer = _v2.img_pool
-batch_norm_layer = _v2.batch_norm
-dropout_layer = _v2.dropout
 addto_layer = _v2.addto
-concat_layer = _v2.concat
 seq_concat_layer = _v2.seq_concat
 lstmemory = _v2.lstmemory
 grumemory = _v2.grumemory
 recurrent_layer = _v2.recurrent
 gated_unit_layer = _v2.gated_unit
-pooling_layer = _v2.pool
 last_seq = _v2.last_seq
 first_seq = _v2.first_seq
 expand_layer = _v2.expand
@@ -99,7 +91,6 @@ out_prod_layer = _v2.out_prod
 conv_shift_layer = _v2.conv_shift
 tensor_layer = _v2.tensor
 multiplex_layer = _v2.multiplex
-maxid_layer = _v2.max_id
 sampling_id_layer = _v2.sampling_id
 eos_layer = _v2.eos
 print_layer = _v2.print_layer
@@ -108,7 +99,6 @@ scale_shift_layer = _v2.scale_shift
 prelu_layer = _v2.prelu
 maxout_layer = _v2.maxout
 spp_layer = _v2.spp
-img_cmrnorm_layer = _v2.img_cmrnorm
 sum_to_one_norm_layer = _v2.sum_to_one_norm
 row_l2_norm_layer = _v2.row_l2_norm
 cross_channel_norm_layer = _v2.cross_channel_norm
@@ -121,10 +111,6 @@ switch_order_layer = _v2.switch_order
 block_expand_layer = _v2.block_expand
 row_conv_layer = _v2.row_conv
 selective_fc_layer = _v2.selective_fc
-bidirectional_lstm = _v2.bidirectional_lstm
-bidirectional_gru = _v2.bidirectional_gru
-simple_lstm = _v2.simple_lstm
-simple_gru = _v2.simple_gru
 
 # mixed layer + projections/operators
 mixed_layer = _v2.mixed
@@ -138,8 +124,6 @@ scaling_projection = _v2.scaling_projection
 dotmul_operator = _v2.dotmul_operator
 
 # costs
-classification_cost = _v2.classification_cost
-cross_entropy = _v2.cross_entropy_cost
 cross_entropy_with_selfnorm = _v2.cross_entropy_with_selfnorm_cost
 multi_binary_label_cross_entropy = _v2.multi_binary_label_cross_entropy_cost
 soft_binary_class_cross_entropy = _v2.soft_binary_class_cross_entropy
@@ -174,11 +158,36 @@ from paddle_tpu.v2.layer import (  # noqa: E402
 )
 
 # prebuilt networks (trainer_config_helpers/networks.py)
-simple_img_conv_pool = _nets.simple_img_conv_pool
-img_conv_group = _nets.img_conv_group
 vgg_16_network = _nets.vgg_16_network
-text_conv_pool = _nets.text_conv_pool
 simple_attention = _nets.simple_attention
+
+# -- reference-faithful v1 signatures override the bare v2 aliases ----------
+# (paddle_tpu.config.v1_layers matches layers.py/networks.py signatures so
+# unmodified reference config scripts run; see that module's docstring)
+from paddle_tpu.config.v1_layers import (  # noqa: E402
+    batch_norm_layer,
+    bidirectional_gru,
+    bidirectional_lstm,
+    classification_cost,
+    concat_layer,
+    conv_projection,
+    cross_entropy,
+    data_layer,
+    dropout_layer,
+    embedding_layer,
+    fc_layer,
+    img_cmrnorm_layer,
+    img_conv_group,
+    img_conv_layer,
+    img_pool_layer,
+    maxid_layer,
+    pooling_layer,
+    sequence_conv_pool,
+    simple_gru,
+    simple_img_conv_pool,
+    simple_lstm,
+    text_conv_pool,
+)
 
 
 # -- evaluator declarations (trainer_config_helpers/evaluators.py) ----------
@@ -235,7 +244,7 @@ def detection_map_evaluator(input=None, label=None, name=None, **kw):
 
 __all__ = [
     # attrs / activations / poolings
-    "ParamAttr", "ParameterAttribute", "ExtraLayerAttribute",
+    "ParamAttr", "ParameterAttribute", "ExtraLayerAttribute", "ExtraAttr",
     "LinearActivation", "SigmoidActivation", "SoftmaxActivation",
     "SequenceSoftmaxActivation", "ReluActivation", "BReluActivation",
     "TanhActivation", "STanhActivation", "SoftReluActivation", "AbsActivation",
@@ -285,7 +294,8 @@ __all__ = [
     "get_output_layer",
     # networks
     "simple_img_conv_pool", "img_conv_group", "vgg_16_network",
-    "text_conv_pool", "simple_attention",
+    "text_conv_pool", "simple_attention", "sequence_conv_pool",
+    "conv_projection",
     # evaluators
     "classification_error_evaluator", "auc_evaluator",
     "precision_recall_evaluator", "pnpair_evaluator", "sum_evaluator",

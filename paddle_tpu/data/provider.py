@@ -42,6 +42,16 @@ class Settings:
         for k, v in kwargs.items():
             setattr(self, k, v)
 
+    # the reference exposes the same field under both names; init_hooks in the
+    # wild assign either `settings.slots = [...]` or `settings.input_types`
+    @property
+    def slots(self):
+        return self.input_types
+
+    @slots.setter
+    def slots(self, value):
+        self.input_types = value
+
 
 class DataProviderWrapper:
     """Result of @provider: callable over file list(s), exposing the reader

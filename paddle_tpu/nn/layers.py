@@ -74,6 +74,10 @@ class Fc(Layer):
         total = None
         for i, arg in enumerate(ins):
             x = arg.value
+            if not arg.is_seq and x.ndim > 2:
+                # image/feature-map input: v1 fc operates on the flattened
+                # vector (FullyConnectedLayer consumes the flat Argument)
+                x = x.reshape(x.shape[0], -1)
             d = x.shape[-1]
             suffix = "" if len(ins) == 1 else f".{i}"
             w = ctx.param(
@@ -243,6 +247,7 @@ class Pool2D(Layer):
         pool_type: str = "max",
         stride: Optional[Union[int, Tuple[int, int]]] = None,
         padding: Union[int, Tuple[int, int]] = 0,
+        ceil_mode: bool = False,
         name: Optional[str] = None,
     ):
         super().__init__(input, name=name)
@@ -251,13 +256,33 @@ class Pool2D(Layer):
         self.pool_type = pool_type
         self.stride = stride
         self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def _pads(self, x) -> Any:
+        """ceil_mode=True (the v1 default, MathUtils outputSize with
+        caffeMode=false): out = ceil((I + 2p - f) / s) + 1. Emulated with
+        extra bottom/right padding so partial windows at the edge survive."""
+        if not self.ceil_mode:
+            return self.padding
+        fh, fw = conv_ops._pair(self.pool_size)
+        sh, sw = conv_ops._pair(
+            self.stride if self.stride is not None else self.pool_size
+        )
+        ph, pw = conv_ops._pair(self.padding)
+        out = []
+        for size, f, s, p in ((x.shape[1], fh, sh, ph), (x.shape[2], fw, sw, pw)):
+            n_out = -(-(size + 2 * p - f) // s) + 1  # ceil-div
+            extra = max(0, (n_out - 1) * s + f - size - 2 * p)
+            out.append((p, p + extra))
+        return tuple(out)
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         x = ins[0].value
+        pads = self._pads(x)
         if self.pool_type == "max":
-            out = conv_ops.max_pool2d(x, self.pool_size, self.stride, self.padding)
+            out = conv_ops.max_pool2d(x, self.pool_size, self.stride, pads)
         else:
-            out = conv_ops.avg_pool2d(x, self.pool_size, self.stride, self.padding)
+            out = conv_ops.avg_pool2d(x, self.pool_size, self.stride, pads)
         return ins[0].with_value(out)
 
 

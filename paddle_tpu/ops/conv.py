@@ -93,13 +93,26 @@ def conv2d_transpose(
     return out
 
 
+def _pool_pads(padding) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """padding: int | (ph, pw) | ((top, bottom), (left, right))."""
+    if isinstance(padding, (tuple, list)) and padding and isinstance(
+        padding[0], (tuple, list)
+    ):
+        (pt, pb), (pl, pr) = padding
+        return (int(pt), int(pb)), (int(pl), int(pr))
+    ph, pw = _pair(padding)
+    return (ph, ph), (pw, pw)
+
+
 def max_pool2d(
     x: Array, window: IntOr2, stride: Optional[IntOr2] = None, padding: IntOr2 = 0
 ) -> Array:
-    """[B, H, W, C] max pooling (hl_maxpool_forward, hl_cuda_cnn.cu)."""
+    """[B, H, W, C] max pooling (hl_maxpool_forward, hl_cuda_cnn.cu).
+    `padding` may be asymmetric ((top, bottom), (left, right)) — used by the
+    v1 DSL's ceil_mode output-size emulation."""
     wh, ww = _pair(window)
     sh, sw = _pair(stride if stride is not None else window)
-    ph, pw = _pair(padding)
+    hpad, wpad = _pool_pads(padding)
     neg = (
         -jnp.inf
         if jnp.issubdtype(x.dtype, jnp.floating)
@@ -111,7 +124,7 @@ def max_pool2d(
         lax.max,
         window_dimensions=(1, wh, ww, 1),
         window_strides=(1, sh, sw, 1),
-        padding=((0, 0), (ph, ph), (pw, pw), (0, 0)),
+        padding=((0, 0), hpad, wpad, (0, 0)),
     )
 
 
@@ -123,16 +136,17 @@ def avg_pool2d(
     exclusive: bool = True,
 ) -> Array:
     """[B, H, W, C] average pooling (hl_avgpool_forward). `exclusive` divides by
-    the count of valid (non-pad) elements, matching cuDNN's EXCLUDE_PADDING mode
-    used by the reference."""
+    the count of valid (non-pad) elements, matching the reference kernel which
+    clips each window to the image region before dividing. `padding` may be
+    asymmetric ((top, bottom), (left, right))."""
     wh, ww = _pair(window)
     sh, sw = _pair(stride if stride is not None else window)
-    ph, pw = _pair(padding)
+    hpad, wpad = _pool_pads(padding)
     dims = (1, wh, ww, 1)
     strides = (1, sh, sw, 1)
-    pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    pads = ((0, 0), hpad, wpad, (0, 0))
     summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
-    if exclusive and (ph or pw):
+    if exclusive and (sum(hpad) or sum(wpad)):
         ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
         counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
         return summed / counts

@@ -214,6 +214,10 @@ class DataConfig:
     load_data_object: Optional[str] = None
     load_data_args: str = ""
     async_load_data: bool = False
+    # directory of the config script that declared this source: provider
+    # modules and file lists resolve relative to it (PyDataProvider2.cpp
+    # loads the module from the config's directory)
+    config_dir: str = ""
 
 
 @dataclass

@@ -40,8 +40,8 @@ CONF_SRC = textwrap.dedent(
              learning_method=MomentumOptimizer(0.9))
     define_py_data_sources2(train_list='dummy', test_list='dummy',
                             module='conf_provider', obj='process')
-    img = data_layer(name='pixel', type=dense_vector(64))
-    lbl = data_layer(name='label', type=integer_value(10))
+    img = data_layer(name='pixel', size=64)
+    lbl = data_layer(name='label', size=10)
     h = fc_layer(input=img, size=hid, act=TanhActivation())
     out = fc_layer(input=h, size=10, act=SoftmaxActivation(), name='output')
     cost = classification_cost(input=out, label=lbl)

@@ -34,8 +34,8 @@ def test_v1_dsl_equals_v2_api():
         from paddle_tpu.config import helpers as H
         from paddle_tpu.config.config_parser import outputs
 
-        img = H.data_layer(name="pixel", type=H.dense_vector(16))
-        lbl = H.data_layer(name="label", type=H.integer_value(4))
+        img = H.data_layer(name="pixel", size=16)
+        lbl = H.data_layer(name="label", size=4)
         h = H.fc_layer(input=img, size=8, act=H.TanhActivation(), name="h")
         out = H.fc_layer(input=h, size=4, act=H.SoftmaxActivation(), name="out")
         outputs(H.classification_cost(input=out, label=lbl, name="cost"))

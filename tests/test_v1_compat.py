@@ -1,0 +1,141 @@
+"""North-star compatibility: UNMODIFIED reference config scripts parse and
+train against the `paddle` compat namespace (VERDICT round-1 item #2).
+
+Configs under test are the reference's own files (read-only mount):
+- benchmark/paddle/image/{smallnet_mnist_cifar,alexnet,vgg,googlenet}.py —
+  parse AND train (their provider.py generates synthetic data; smallnet runs
+  a full pass, the ImageNet-sized ones a few batches on CPU).
+- v1_api_demo/quick_start/trainer_config.{lr,cnn,lstm}.py — parse, with the
+  dictionary stubbed (their providers need downloaded data).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+REF = "/root/reference"
+IMG = f"{REF}/benchmark/paddle/image"
+QS = f"{REF}/v1_api_demo/quick_start"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference tree not mounted"
+)
+
+
+def _parse(path, args=""):
+    from paddle_tpu.config import parse_config
+
+    return parse_config(path, args)
+
+
+def _train_batches(pc, n_batches, batch_size):
+    """Build the real provider-fed pipeline the CLI uses and run n batches."""
+    from paddle_tpu.cli import _make_reader, bind_provider_types
+    from paddle_tpu.config import build_optimizer
+    from paddle_tpu.trainer import SGDTrainer
+
+    dc = pc.trainer_config.data_config
+    feeding = bind_provider_types(pc.topology, dc)
+    feeder = pc.topology.make_feeder(feeding)
+    reader = _make_reader(dc, batch_size)
+    bundle = build_optimizer(pc.trainer_config.opt_config)
+    trainer = SGDTrainer(pc.outputs, bundle.optimizer, schedule=bundle.schedule)
+
+    costs = []
+    it = iter(reader())
+    for _ in range(n_batches):
+        batch = feeder(next(it))
+        if trainer.state is None:
+            trainer.init_state(batch)
+            step = trainer._make_step()
+        trainer.state, cost, _ = step(trainer.state, batch)
+        costs.append(float(cost))
+    return costs
+
+
+@pytest.fixture()
+def bench_cwd(tmp_path, monkeypatch):
+    # the benchmark providers iterate files named in train.list
+    (tmp_path / "train.list").write_text("dummy\n")
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def test_smallnet_parses_and_trains_full_pass(bench_cwd):
+    pc = _parse(f"{IMG}/smallnet_mnist_cifar.py", "batch_size=64")
+    oc = pc.trainer_config.opt_config
+    assert oc.batch_size == 64
+    assert oc.learning_method == "momentum" and oc.momentum == 0.9
+    assert oc.l2_weight_decay == pytest.approx(0.0005 * 64)
+    # full pass: the provider yields 1024 synthetic samples
+    costs = _train_batches(pc, 1024 // 64, 64)
+    assert all(np.isfinite(c) for c in costs)
+    assert costs[-1] < costs[0] + 0.5  # random data: just require stability
+
+
+def test_alexnet_parses_and_trains(bench_cwd):
+    pc = _parse(f"{IMG}/alexnet.py", "batch_size=4")
+    costs = _train_batches(pc, 2, 4)
+    assert all(np.isfinite(c) for c in costs)
+
+
+def test_vgg16_parses_and_trains(bench_cwd):
+    pc = _parse(f"{IMG}/vgg.py", "batch_size=2,layer_num=16")
+    costs = _train_batches(pc, 2, 2)
+    assert all(np.isfinite(c) for c in costs)
+
+
+def test_googlenet_parses_and_trains(bench_cwd):
+    pc = _parse(f"{IMG}/googlenet.py", "batch_size=2")
+    # declaration order is (label, input) while the provider yields
+    # (image, label) — binding must reconcile by declared size
+    costs = _train_batches(pc, 2, 2)
+    assert all(np.isfinite(c) for c in costs)
+
+
+@pytest.fixture()
+def qs_cwd(tmp_path, monkeypatch):
+    (tmp_path / "data").mkdir()
+    (tmp_path / "data" / "dict.txt").write_text(
+        "".join(f"word{i}\t{i}\n" for i in range(30))
+    )
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+@pytest.mark.parametrize("cfg", ["lr", "cnn", "lstm"])
+def test_quick_start_configs_parse(qs_cwd, cfg):
+    pc = _parse(f"{QS}/trainer_config.{cfg}.py")
+    assert pc.outputs, "no outputs declared"
+    oc = pc.trainer_config.opt_config
+    assert oc.batch_size == 128
+    assert oc.learning_method == "adam"
+    assert oc.gradient_clipping_threshold == 25
+    assert oc.l2_weight_decay == pytest.approx(8e-4)
+    # model config emitted (the serialized contract)
+    assert pc.trainer_config.model_config.layers
+
+
+def test_quick_start_lr_trains_with_synthetic_provider(qs_cwd, tmp_path):
+    """The lr config trains once its provider is stubbed: feed ids + labels
+    through the bound feeder directly."""
+    pc = _parse(f"{QS}/trainer_config.lr.py")
+    from paddle_tpu.config import build_optimizer
+    from paddle_tpu.trainer import SGDTrainer
+
+    feeder = pc.topology.make_feeder()
+    rs = np.random.RandomState(0)
+    samples = [
+        {"word": rs.rand(30).astype(np.float32), "label": int(rs.randint(2))}
+        for _ in range(64)
+    ]
+    bundle = build_optimizer(pc.trainer_config.opt_config)
+    trainer = SGDTrainer(pc.outputs, bundle.optimizer, schedule=bundle.schedule)
+    batch = feeder(samples[:32])
+    trainer.init_state(batch)
+    step = trainer._make_step()
+    state = trainer.state
+    for _ in range(5):
+        state, cost, _ = step(state, batch)
+    assert np.isfinite(float(cost))
