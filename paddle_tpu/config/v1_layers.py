@@ -100,15 +100,32 @@ def _infer_geom(input: Layer, num_channels: Optional[int]):
     return (num_channels, side, side)
 
 
+def _is_flat(node: Layer) -> bool:
+    """True when the node's values are flat [B, c*h*w] even though image
+    geometry may be declared: data layers always feed flat values (the
+    provider's dense slot), and elementwise wrappers over them stay flat."""
+    return (
+        getattr(node, "type_name", None) == "data"
+        or getattr(node, "_v1_flat", False)
+    )
+
+
 def _ensure_nhwc(input: Layer, num_channels: Optional[int]):
     """Returns (nhwc_node, (c, h, w)). Inserts the flat-CHW -> NHWC adapter
-    when the input is not already an image node."""
+    when the input is not already an image-layout node. The adapter is cached
+    on the input so a data layer feeding several image branches (inception
+    towers) reuses one node instead of colliding on names."""
     geom = getattr(input, "_v1_geom", None)
-    if geom is not None:
+    if geom is not None and not _is_flat(input):
         return input, geom
-    c, h, w = _infer_geom(input, num_channels)
+    cached = getattr(input, "_v1_nhwc_node", None)
+    if cached is not None:
+        return cached, cached._v1_geom
+    c, h, w = geom if geom is not None else _infer_geom(input, num_channels)
     node = L.Reshape(input, (c, h, w), name=f"{input.name}.as_image")
     node = L.SwitchOrder(node, to="NHWC", name=f"{input.name}.to_nhwc")
+    _annotate(node, geom=(c, h, w))
+    input._v1_nhwc_node = node
     return node, (c, h, w)
 
 
@@ -187,6 +204,8 @@ def dropout_layer(input, dropout_rate, name=None):
     node = L.Dropout(input, dropout_rate, name=name)
     if hasattr(input, "_v1_geom"):
         _annotate(node, geom=input._v1_geom)
+        if _is_flat(input):  # elementwise: stays flat if the input was flat
+            node._v1_flat = True
     elif _size_of(input) is not None:
         _annotate(node, size=_size_of(input))
     return node
@@ -262,10 +281,12 @@ def batch_norm_layer(input, act=None, name=None, img3D=False,
                      layer_attr=None, batch_norm_type=None,
                      epsilon=1e-5, moving_average_fraction=0.9,
                      use_global_stats=None, mean_var_names=None):
-    """layers.py batch_norm_layer — on image input keeps geometry."""
+    """layers.py batch_norm_layer — on image input keeps geometry (and must
+    normalize per channel, so flat image data goes through the NHWC adapter
+    first, matching CudnnBatchNorm's per-channel statistics)."""
     geom = getattr(input, "_v1_geom", None)
     node_in = input
-    if geom is None and num_channels is not None:
+    if geom is not None or num_channels is not None:
         node_in, geom = _ensure_nhwc(input, num_channels)
     node = L.BatchNorm(
         node_in, act=_act(act), epsilon=epsilon,
@@ -321,6 +342,8 @@ def concat_layer(input, act=None, name=None, layer_attr=None, bias_attr=None):
     for i, item in enumerate(ins):
         if isinstance(item, _ConvProjSpec):
             built.append(item.build(f"{name}.proj{i}" if name else None))
+        elif _is_flat(item) and getattr(item, "_v1_geom", None) is not None:
+            built.append(_ensure_nhwc(item, None)[0])  # channel concat needs NHWC
         else:
             built.append(item)
     geoms = [getattr(b, "_v1_geom", None) for b in built]
@@ -420,7 +443,7 @@ def classification_cost(input, label, weight=None, name=None,
     from paddle_tpu.config import helpers as _h
 
     _mark_label_as_ids(label)
-    from_logits = _act(getattr(input, "act", None)) != "softmax"
+    from_logits = _act(_v2.effective_act(input)) != "softmax"
     node = C.ClassificationCost(
         input, label, weight=weight, name=name, coeff=coeff,
         from_logits=from_logits,
@@ -439,7 +462,7 @@ def cross_entropy(input, label, name=None, coeff=1.0, weight=None,
                   layer_attr=None):
     """layers.py:5738 — input already carries its output activation."""
     _mark_label_as_ids(label)
-    from_logits = _act(getattr(input, "act", None)) != "softmax"
+    from_logits = _act(_v2.effective_act(input)) != "softmax"
     node = C.ClassificationCost(
         input, label, weight=weight, name=name, coeff=coeff,
         from_logits=from_logits,

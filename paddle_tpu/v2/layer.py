@@ -475,8 +475,28 @@ def dotmul_operator(a, b, scale=1.0):
 # -- costs -----------------------------------------------------------------
 
 
+def effective_act(node):
+    """The activation the cost layer actually sees, looking through
+    activation-less passthrough wrappers (dropout) — a drop_rate layer_attr
+    must not hide a softmax-activated layer from the cost."""
+    while node is not None:
+        a = getattr(node, "act", None)
+        if a is not None:
+            return a
+        if getattr(node, "type_name", None) == "dropout":
+            node = node.inputs[0]
+            continue
+        return None
+    return None
+
+
 def classification_cost(input, label, weight=None, name=None, coeff=1.0, **_compat):
-    return C.ClassificationCost(input, label, weight=weight, name=name, coeff=coeff)
+    # The standard idiom feeds a softmax-activated layer; the cost must then
+    # consume probabilities, not re-softmax (layers.py:4347 applies softmax as
+    # the *input layer's* activation, so the cost itself is plain CE).
+    from_logits = effective_act(input) != "softmax"
+    return C.ClassificationCost(input, label, weight=weight, name=name,
+                                coeff=coeff, from_logits=from_logits)
 
 
 cross_entropy_cost = classification_cost

@@ -26,7 +26,6 @@ def _run(net, batch, params=None, states=None):
 
 def test_v1_dsl_equals_v2_api():
     """The same MLP via config-script DSL and via the v2 layer API."""
-    from paddle_tpu.config import parse_config
     from paddle_tpu.v2 import layer as vl
     from paddle_tpu.data.feeder import dense_vector, integer_value
 
@@ -40,32 +39,19 @@ def test_v1_dsl_equals_v2_api():
         out = H.fc_layer(input=h, size=4, act=H.SoftmaxActivation(), name="out")
         outputs(H.classification_cost(input=out, label=lbl, name="cost"))
 
-    pc = parse_config(dsl_config, emit_proto=False)
-    net_dsl = pc.topology.network
-
-    reset_name_scope()
-    img = vl.data(name="pixel", type=dense_vector(16))
-    lbl = vl.data(name="label", type=integer_value(4))
-    h = vl.fc(input=img, size=8, act="tanh", name="h")
-    out = vl.fc(input=h, size=4, act="softmax", name="out")
-    cost = vl.classification_cost(input=out, label=lbl, name="cost")
-    net_v2 = Network([cost])
+    def v2():
+        img = vl.data(name="pixel", type=dense_vector(16))
+        lbl = vl.data(name="label", type=integer_value(4))
+        h = vl.fc(input=img, size=8, act="tanh", name="h")
+        out = vl.fc(input=h, size=4, act="softmax", name="out")
+        return vl.classification_cost(input=out, label=lbl, name="cost")
 
     rs = np.random.RandomState(0)
     batch = {
         "pixel": rs.randn(6, 16).astype(np.float32),
         "label": rs.randint(0, 4, 6),
     }
-    p1, s1, o1 = _run(net_dsl, batch)
-    # same param names and shapes
-    p2, s2 = net_v2.init(jax.random.PRNGKey(0), batch)
-    assert set(p1) == set(p2)
-    assert {k: v.shape for k, v in p1.items()} == {k: v.shape for k, v in p2.items()}
-    # identical outputs under identical weights
-    _, _, o2 = _run(net_v2, batch, p1, s1)
-    np.testing.assert_allclose(
-        np.asarray(o1["cost"].value), np.asarray(o2["cost"].value), rtol=1e-6
-    )
+    _compare_dsl_v2(dsl_config, v2, batch)
 
 
 def test_mixed_projection_equals_primitive_fc():
@@ -92,6 +78,153 @@ def test_mixed_projection_equals_primitive_fc():
         np.asarray(outs["mixed_out"].value),
         np.asarray(outs["fc_out"].value),
         rtol=1e-5, atol=1e-6,
+    )
+
+
+def _compare_dsl_v2(dsl_config, v2_build, batch_dsl, batch_v2=None, cost="cost"):
+    """Parse a v1 config script and build the same net via the v2 API; assert
+    identical parameter names/shapes and identical cost under shared weights
+    (the test_NetworkCompare.cpp:222 contract)."""
+    from paddle_tpu.config import parse_config
+
+    pc = parse_config(dsl_config, emit_proto=False)
+    net_dsl = pc.topology.network
+    reset_name_scope()
+    net_v2 = Network([v2_build()])
+
+    p1, s1, o1 = _run(net_dsl, batch_dsl)
+    p2, s2 = net_v2.init(jax.random.PRNGKey(0), batch_v2 or batch_dsl)
+    assert set(p1) == set(p2)
+    assert {k: v.shape for k, v in p1.items()} == {k: v.shape for k, v in p2.items()}
+    _, _, o2 = _run(net_v2, batch_v2 or batch_dsl, p1, s1)
+    np.testing.assert_allclose(
+        np.asarray(o1[cost].value), np.asarray(o2[cost].value),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_conv_net_pair():
+    """Conv/pool/fc image net: v1 DSL (flat data + geometry annotations) vs
+    v2 API (NHWC data) — same params, same cost."""
+    from paddle_tpu.v2 import layer as vl
+    from paddle_tpu.data.feeder import dense_vector, integer_value
+
+    def dsl():
+        from paddle_tpu.config import helpers as H
+        from paddle_tpu.config.config_parser import outputs
+
+        img = H.data_layer(name="pixel", size=64, height=8, width=8)
+        lbl = H.data_layer(name="label", size=5)
+        c = H.img_conv_layer(input=img, filter_size=3, num_filters=4,
+                             padding=1, act=H.ReluActivation(), name="conv1")
+        p = H.img_pool_layer(input=c, pool_size=2, stride=2,
+                             ceil_mode=False, name="pool1")
+        out = H.fc_layer(input=p, size=5, act=H.SoftmaxActivation(), name="out")
+        outputs(H.classification_cost(input=out, label=lbl, name="cost"))
+
+    def v2():
+        img = vl.data(name="pixel", type=dense_vector(64), height=8, width=8)
+        lbl = vl.data(name="label", type=integer_value(5))
+        c = vl.img_conv(input=img, filter_size=3, num_filters=4, padding=1,
+                        act="relu", name="conv1")
+        p = vl.img_pool(input=c, pool_size=2, stride=2, name="pool1")
+        out = vl.fc(input=p, size=5, act="softmax", name="out")
+        return vl.classification_cost(input=out, label=lbl, name="cost")
+
+    rs = np.random.RandomState(3)
+    flat = rs.randn(4, 64).astype(np.float32)
+    lbl = rs.randint(0, 5, 4)
+    _compare_dsl_v2(
+        dsl, v2,
+        batch_dsl={"pixel": flat, "label": lbl},
+        batch_v2={"pixel": flat.reshape(4, 8, 8, 1), "label": lbl},
+    )
+
+
+def test_regression_cost_pair():
+    """Linear fc + square_error: DSL regression_cost vs v2 square_error_cost."""
+    from paddle_tpu.v2 import layer as vl
+    from paddle_tpu.data.feeder import dense_vector
+
+    def dsl():
+        from paddle_tpu.config import helpers as H
+        from paddle_tpu.config.config_parser import outputs
+
+        x = H.data_layer(name="x", size=12)
+        y = H.data_layer(name="y", size=3)
+        out = H.fc_layer(input=x, size=3, act=H.LinearActivation(), name="out")
+        outputs(H.regression_cost(input=out, label=y, name="cost"))
+
+    def v2():
+        x = vl.data(name="x", type=dense_vector(12))
+        y = vl.data(name="y", type=dense_vector(3))
+        out = vl.fc(input=x, size=3, act="linear", name="out")
+        return vl.square_error_cost(input=out, label=y, name="cost")
+
+    rs = np.random.RandomState(4)
+    batch = {"x": rs.randn(6, 12).astype(np.float32),
+             "y": rs.randn(6, 3).astype(np.float32)}
+    _compare_dsl_v2(dsl, v2, batch)
+
+
+def test_embedding_seqpool_pair():
+    """Text classifier: DSL (seq-ness inferred via _mark_seq_root) vs v2
+    (explicit integer_value_sequence)."""
+    from paddle_tpu.v2 import layer as vl
+    from paddle_tpu.data.feeder import integer_value, integer_value_sequence
+
+    def dsl():
+        from paddle_tpu.config import helpers as H
+        from paddle_tpu.config.config_parser import outputs
+
+        w = H.data_layer(name="word", size=10)
+        lbl = H.data_layer(name="label", size=3)
+        emb = H.embedding_layer(input=w, size=6, name="emb")
+        pooled = H.pooling_layer(input=emb, pooling_type=H.MaxPooling(),
+                                 name="pooled")
+        out = H.fc_layer(input=pooled, size=3, act=H.SoftmaxActivation(),
+                         name="out")
+        outputs(H.classification_cost(input=out, label=lbl, name="cost"))
+
+    def v2():
+        w = vl.data(name="word", type=integer_value_sequence(10))
+        lbl = vl.data(name="label", type=integer_value(3))
+        emb = vl.embedding(input=w, size=6, name="emb")
+        pooled = vl.pool(input=emb, pooling_type="max", name="pooled")
+        out = vl.fc(input=pooled, size=3, act="softmax", name="out")
+        return vl.classification_cost(input=out, label=lbl, name="cost")
+
+    rs = np.random.RandomState(5)
+    batch = {
+        "word": rs.randint(0, 10, (4, 7)),
+        "word.lengths": np.asarray([7, 5, 3, 6], np.int32),
+        "label": rs.randint(0, 3, 4),
+    }
+    _compare_dsl_v2(dsl, v2, batch)
+
+
+def test_addto_equals_mixed_identity():
+    """Parameterless equivalence: addto([x, y], act=tanh) == mixed layer over
+    two identity projections with tanh — the util_layers equivalence class."""
+    from paddle_tpu.v2 import layer as vl
+    from paddle_tpu.data.feeder import dense_vector
+
+    x = vl.data(name="x", type=dense_vector(9))
+    y = vl.data(name="y", type=dense_vector(9))
+    a = vl.addto([x, y], act="tanh", name="a")
+    m = vl.mixed(
+        input=[vl.identity_projection(x), vl.identity_projection(y)],
+        size=9, act="tanh", name="m",
+    )
+    net = Network([a, m])
+    rs = np.random.RandomState(6)
+    batch = {"x": rs.randn(5, 9).astype(np.float32),
+             "y": rs.randn(5, 9).astype(np.float32)}
+    params, states = net.init(jax.random.PRNGKey(0), batch)
+    outs, _ = net.apply(params, states, batch)
+    np.testing.assert_allclose(
+        np.asarray(outs["a"].value), np.asarray(outs["m"].value),
+        rtol=1e-6, atol=1e-7,
     )
 
 
