@@ -1,0 +1,171 @@
+"""Capture a jax.profiler trace + compiled cost analysis of the ResNet-50
+bench step on the real chip, and emit a top-op time table (PROFILE_r03.md).
+
+Usage:  python benchmarks/profile_resnet.py [--batch 256] [--image 224]
+Outputs: profiles/rN/ (xplane trace) + markdown table on stdout.
+
+The op table is parsed from the xplane.pb protobuf with tensorflow's profiler
+protos (tensorflow is present in the image for exactly this kind of tooling).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_step(batch_size: int, image_size: int):
+    import jax
+
+    from paddle_tpu.core import dtypes
+    from paddle_tpu import models
+    from paddle_tpu.nn.graph import reset_name_scope
+    from paddle_tpu.optim import SGD
+    from paddle_tpu.trainer import SGDTrainer
+
+    dtypes.set_policy(dtypes.bf16_policy())
+    reset_name_scope()
+    img, label, logits, cost = models.resnet50(image_size=image_size)
+    trainer = SGDTrainer(cost, SGD(learning_rate=0.1, momentum=0.9))
+    rs = np.random.RandomState(0)
+    batch = {
+        "image": rs.randn(batch_size, image_size, image_size, 3).astype(np.float32),
+        "label": rs.randint(0, 1000, batch_size),
+    }
+    trainer.init_state(batch)
+    step = jax.jit(trainer._build_step(), donate_argnums=0)
+    batch = jax.device_put(batch)
+    return trainer, step, batch
+
+
+def parse_xplane(trace_dir: str, n_steps: int = 3):
+    """Aggregate device time by HLO category and by source line from the
+    xplane dump (proto mirror compiled from benchmarks/xplane.proto — the
+    image has no tensorboard profiler plugin)."""
+    import xplane_pb2  # generated next to this file
+
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        return None, "no xplane.pb found under " + trace_dir
+    xs = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+
+    planes = [p for p in xs.planes if p.name.startswith("/device:TPU")]
+    if not planes:
+        return None, "no TPU plane in trace"
+    plane = planes[0]
+    md = plane.event_metadata
+    sm = {k: v.name for k, v in plane.stat_metadata.items()}
+
+    def meta_stats(mid):
+        m = md.get(mid)
+        out = {}
+        if m is None:
+            return out
+        for s in m.stats:
+            out[sm.get(s.metadata_id)] = (
+                s.uint64_value or s.int64_value or s.double_value or s.str_value
+            )
+        return out
+
+    by_cat = defaultdict(lambda: [0.0, 0.0, 0.0])  # ps, flops, bytes
+    by_src = defaultdict(lambda: [0.0, 0.0, 0.0])
+    for line in plane.lines:
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            ms = meta_stats(ev.metadata_id)
+            cat = str(ms.get("hlo_category", "?"))
+            fl = float(ms.get("flops") or 0)
+            by = float(ms.get("bytes_accessed") or 0)
+            src = str(ms.get("source", "-"))
+            for table, key in ((by_cat, cat), (by_src, src)):
+                table[key][0] += ev.duration_ps
+                table[key][1] += fl
+                table[key][2] += by
+    return (by_cat, by_src, n_steps), None
+
+
+def fmt_tables(by_cat, by_src, n_steps: int, top: int = 15) -> str:
+    lines = ["| HLO category | ms/step | TFLOP/s | GB/s | % time |", "|---|---|---|---|---|"]
+    total = sum(v[0] for v in by_cat.values())
+    for cat, (ps, fl, by) in sorted(by_cat.items(), key=lambda kv: -kv[1][0])[:top]:
+        sec = ps / 1e12
+        if sec <= 0:
+            continue
+        lines.append(
+            f"| {cat} | {ps / 1e9 / n_steps:.2f} | {fl / sec / 1e12:.1f} "
+            f"| {by / sec / 1e9:.0f} | {100 * ps / total:.1f} |"
+        )
+    lines.append("")
+    lines.append("| source line | ms/step | TFLOP/s | GB/s |")
+    lines.append("|---|---|---|---|")
+    for src, (ps, fl, by) in sorted(by_src.items(), key=lambda kv: -kv[1][0])[:top]:
+        sec = ps / 1e12
+        if sec <= 0:
+            continue
+        lines.append(
+            f"| {src} | {ps / 1e9 / n_steps:.2f} | {fl / sec / 1e12:.1f} "
+            f"| {by / sec / 1e9:.0f} |"
+        )
+    lines.append("")
+    lines.append(f"device busy: {total / 1e9 / n_steps:.2f} ms/step")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--out", default="profiles/r03")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} platform={dev.platform}", flush=True)
+
+    trainer, step, batch = build_step(args.batch, args.image)
+    state = trainer.state
+
+    t0 = time.perf_counter()
+    state, cost, _ = step(state, batch)
+    cost_v = float(cost)
+    print(f"compile+first step: {time.perf_counter() - t0:.1f}s cost={cost_v:.3f}", flush=True)
+
+    # steady-state timing
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, cost, _ = step(state, batch)
+    final = float(cost)
+    dt = (time.perf_counter() - t0) / args.steps
+    print(f"steady: {dt * 1000:.1f} ms/step  {args.batch / dt:.0f} img/s  cost={final:.3f}", flush=True)
+
+    os.makedirs(args.out, exist_ok=True)
+    with jax.profiler.trace(args.out):
+        for _ in range(3):
+            state, cost, _ = step(state, batch)
+        jax.block_until_ready(cost)
+        float(cost)
+
+    (res, err) = parse_xplane(args.out)
+    if res is None:
+        print("xplane parse failed:", err)
+        return
+    by_cat, by_src, n_steps = res
+    print()
+    print(fmt_tables(by_cat, by_src, n_steps))
+
+
+if __name__ == "__main__":
+    main()

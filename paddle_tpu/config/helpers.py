@@ -111,6 +111,13 @@ switch_order_layer = _v2.switch_order
 block_expand_layer = _v2.block_expand
 row_conv_layer = _v2.row_conv
 selective_fc_layer = _v2.selective_fc
+img_conv3d_layer = _v2.img_conv3d
+img_pool3d_layer = _v2.img_pool3d
+linear_comb_layer = _v2.linear_comb
+convex_comb_layer = _v2.convex_comb
+sub_nested_seq_layer = _v2.sub_nested_seq
+cross_entropy_over_beam = _v2.cross_entropy_over_beam
+BeamInput = _v2.BeamInput
 
 # mixed layer + projections/operators
 mixed_layer = _v2.mixed
@@ -275,7 +282,9 @@ __all__ = [
     "bilinear_interp_layer", "pad_layer", "crop_layer", "rotate_layer",
     "switch_order_layer", "block_expand_layer", "row_conv_layer",
     "selective_fc_layer", "bidirectional_lstm", "bidirectional_gru",
-    "simple_lstm", "simple_gru",
+    "simple_lstm", "simple_gru", "img_conv3d_layer", "img_pool3d_layer",
+    "linear_comb_layer", "convex_comb_layer", "sub_nested_seq_layer",
+    "cross_entropy_over_beam", "BeamInput",
     # mixed
     "mixed_layer", "full_matrix_projection", "trans_full_matrix_projection",
     "identity_projection", "dotmul_projection", "table_projection",

@@ -8,6 +8,7 @@ from paddle_tpu.nn.graph import (  # noqa: F401
 )
 from paddle_tpu.nn import activations as activations  # noqa: F401
 from paddle_tpu.nn import layers as layers  # noqa: F401
+from paddle_tpu.nn import layers3d as layers3d  # noqa: F401
 from paddle_tpu.nn import costs as costs  # noqa: F401
 from paddle_tpu.nn import struct_costs as struct_costs  # noqa: F401
 from paddle_tpu.nn import detection_layers as detection_layers  # noqa: F401

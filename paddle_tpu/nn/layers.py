@@ -19,6 +19,7 @@ from paddle_tpu.nn import init as init_mod
 from paddle_tpu.nn.graph import Argument, Context, Layer, ParamAttr
 from paddle_tpu.ops import conv as conv_ops
 from paddle_tpu.ops import linalg
+from paddle_tpu.ops import normalization as norm_ops
 
 Array = jax.Array
 
@@ -317,7 +318,6 @@ class BatchNorm(Layer):
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         x = ins[0].value
         c = x.shape[-1]
-        axes = tuple(range(x.ndim - 1))
         gamma = ctx.param(self, "scale", (c,), init_mod.ones, self.param_attr)
         beta = ctx.param(self, "bias", (c,), init_mod.zeros, self.bias_attr)
         moving_mean = ctx.state(self, "moving_mean", (c,), 0.0)
@@ -328,19 +328,21 @@ class BatchNorm(Layer):
             else not ctx.train
         )
         if use_global:
-            mean, var = moving_mean, moving_var
+            out = norm_ops.batch_norm_inference(
+                x, gamma, beta, moving_mean, moving_var, self.epsilon
+            )
         else:
-            xf = x.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)
+            # fused one-pass stats + minimal-pass custom VJP — the profiled
+            # bandwidth hot spot of conv/BN models (ops/normalization.py)
+            out, mean, var = norm_ops.batch_norm_train(
+                x, gamma, beta, self.epsilon
+            )
             ctx.update_state(
                 self, "moving_mean", self.maf * moving_mean + (1 - self.maf) * mean
             )
             ctx.update_state(
                 self, "moving_var", self.maf * moving_var + (1 - self.maf) * var
             )
-        inv = jax.lax.rsqrt(var + self.epsilon) * gamma
-        out = ((x.astype(jnp.float32) - mean) * inv + beta).astype(x.dtype)
         out = act_mod.apply(self.act, out)
         return ins[0].with_value(out)
 
@@ -510,6 +512,61 @@ class CosSim(Layer):
             b, axis=-1, keepdims=True
         )
         return ins[0].with_value(self.scale * num / jnp.maximum(den, 1e-12))
+
+
+@LAYERS.register("convex_comb")
+class LinearComb(Layer):
+    """Per-sample weighted sum of vectors (ConvexCombinationLayer /
+    linear_comb_layer, layers.py:4984): weights [B, M], vectors [B, M*N] →
+    z[i] = Σ_j x[j]·y[i+N·j], i.e. z = xᵀ·Y with Y = vectors.reshape(M, N)."""
+
+    type_name = "convex_comb"
+
+    def __init__(self, weights: Layer, vectors: Layer, size: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__([weights, vectors], name=name)
+        self.size = size
+
+    def forward(self, ctx, ins):
+        x, y = ins[0].value, ins[1].value
+        b, m = x.shape
+        n = self.size or y.shape[-1] // m
+        assert m * n == y.shape[-1], (
+            f"convex_comb {self.name}: vectors dim {y.shape[-1]} != "
+            f"weights dim {m} × size {n}"
+        )
+        out = jnp.einsum("bm,bmn->bn", x, y.reshape(b, m, n))
+        return ins[1].with_value(out)
+
+
+@LAYERS.register("cos_vm")
+class CosSimVecMat(Layer):
+    """Cosine similarity of one vector against each row of a per-sample
+    matrix (CosSimVecMatLayer.cpp): vec [B, M], mat [B, M*N] → [B, N],
+    out[i] = scale · cos(vec, mat_row_i)."""
+
+    type_name = "cos_vm"
+
+    def __init__(self, vec: Layer, mat: Layer, size: Optional[int] = None,
+                 scale: float = 1.0, name: Optional[str] = None):
+        super().__init__([vec, mat], name=name)
+        self.size = size
+        self.scale = scale
+
+    def forward(self, ctx, ins):
+        v, m_flat = ins[0].value, ins[1].value
+        b, dim = v.shape
+        n = self.size or m_flat.shape[-1] // dim
+        assert dim * n == m_flat.shape[-1], (
+            f"cos_vm {self.name}: mat dim {m_flat.shape[-1]} != "
+            f"vec dim {dim} × keys {n}"
+        )
+        mat = m_flat.reshape(b, n, dim)
+        num = jnp.einsum("bd,bnd->bn", v, mat)
+        den = jnp.linalg.norm(v, axis=-1, keepdims=True) * jnp.linalg.norm(
+            mat, axis=-1
+        )
+        return ins[1].with_value(self.scale * num / jnp.maximum(den, 1e-12))
 
 
 @LAYERS.register("mixed")

@@ -1,0 +1,153 @@
+"""3-D image layers — Conv3DLayer.cpp:21 / DeConv3DLayer.cpp / Pool3DLayer.cpp
+parity, NDHWC layout (TPU-native: rank-5 XLA conv HLO on the MXU; the
+reference lowers these through col2Vol/vol2Col GEMMs)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.nn import activations as act_mod
+from paddle_tpu.nn import init as init_mod
+from paddle_tpu.nn.graph import Argument, Context, Layer
+from paddle_tpu.nn.layers import _attr
+from paddle_tpu.ops import conv as conv_ops
+
+Int3 = Union[int, Tuple[int, int, int]]
+
+
+@LAYERS.register("conv3d")
+class Conv3D(Layer):
+    """3-D convolution over [B, D, H, W, C] (Conv3DLayer.cpp:21)."""
+
+    type_name = "conv3d"
+
+    def __init__(
+        self,
+        input: Layer,
+        num_filters: int,
+        filter_size: Int3,
+        stride: Int3 = 1,
+        padding: Int3 = 0,
+        dilation: Int3 = 1,
+        groups: int = 1,
+        act: Any = None,
+        bias: bool = True,
+        param_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.num_filters = num_filters
+        self.filter_size = filter_size
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.act = act
+        self.bias = bias
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        assert x.ndim == 5, f"conv3d {self.name}: expect NDHWC input, got {x.shape}"
+        kd, kh, kw = conv_ops._triple(self.filter_size)
+        cin = x.shape[-1]
+        w = ctx.param(
+            self,
+            "w",
+            (kd, kh, kw, cin // self.groups, self.num_filters),
+            init_mod.he_normal,
+            self.param_attr,
+        )
+        out = conv_ops.conv3d(
+            x, w, self.stride, self.padding, self.dilation, self.groups, ctx.policy
+        )
+        if self.bias:
+            b = ctx.param(self, "b", (self.num_filters,), init_mod.zeros, self.bias_attr)
+            out = out + b.astype(out.dtype)
+        out = act_mod.apply(self.act, out)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("deconv3d")
+class Conv3DTranspose(Layer):
+    """Transposed 3-D conv (DeConv3DLayer.cpp)."""
+
+    type_name = "deconv3d"
+
+    def __init__(
+        self,
+        input: Layer,
+        num_filters: int,
+        filter_size: Int3,
+        stride: Int3 = 1,
+        padding: Int3 = 0,
+        act: Any = None,
+        bias: bool = True,
+        param_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.num_filters = num_filters
+        self.filter_size = filter_size
+        self.stride = stride
+        self.padding = padding
+        self.act = act
+        self.bias = bias
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        assert x.ndim == 5, f"deconv3d {self.name}: expect NDHWC input, got {x.shape}"
+        kd, kh, kw = conv_ops._triple(self.filter_size)
+        cin = x.shape[-1]
+        w = ctx.param(
+            self,
+            "w",
+            (kd, kh, kw, self.num_filters, cin),
+            init_mod.he_normal,
+            self.param_attr,
+        )
+        out = conv_ops.conv3d_transpose(x, w, self.stride, self.padding, ctx.policy)
+        if self.bias:
+            b = ctx.param(self, "b", (self.num_filters,), init_mod.zeros, self.bias_attr)
+            out = out + b.astype(out.dtype)
+        out = act_mod.apply(self.act, out)
+        return ins[0].with_value(out)
+
+
+@LAYERS.register("pool3d")
+class Pool3D(Layer):
+    """3-D max/avg pooling over [B, D, H, W, C] (Pool3DLayer.cpp)."""
+
+    type_name = "pool3d"
+
+    def __init__(
+        self,
+        input: Layer,
+        pool_size: Int3,
+        pool_type: str = "max",
+        stride: Optional[Int3] = None,
+        padding: Int3 = 0,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.pool_size = pool_size
+        self.pool_type = pool_type
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        x = ins[0].value
+        assert x.ndim == 5, f"pool3d {self.name}: expect NDHWC input, got {x.shape}"
+        if self.pool_type == "max":
+            out = conv_ops.max_pool3d(x, self.pool_size, self.stride, self.padding)
+        elif self.pool_type in ("avg", "average"):
+            out = conv_ops.avg_pool3d(x, self.pool_size, self.stride, self.padding)
+        else:
+            raise ValueError(f"pool3d: unknown pool_type {self.pool_type!r}")
+        return ins[0].with_value(out)

@@ -210,3 +210,51 @@ def bidirectional_gru(input: Layer, size: int, name: str = "bigru", **kw: Any) -
     fwd = simple_gru(input, size, reverse=False, name=f"{name}.fw", **kw)
     bwd = simple_gru(input, size, reverse=True, name=f"{name}.bw", **kw)
     return Concat([fwd, bwd], name=f"{name}.cat")
+
+
+@LAYERS.register("mdlstmemory")
+class MDLstm(Layer):
+    """2-D multi-dimensional LSTM (MDLstmLayer.cpp:180). Input is the
+    pre-projected grid [B, H, W, 5*size] (same convention as lstmemory's 4H:
+    a preceding fc/mixed supplies x·Wx); output [B, H, W, size]. The grid is
+    walked as a wavefront — see ops/mdlstm.py. directions[d]=False reverses
+    dimension d (the reference's per-dim direction flags)."""
+
+    type_name = "mdlstmemory"
+
+    def __init__(
+        self,
+        input: Layer,
+        size: Optional[int] = None,
+        directions=(True, True),
+        param_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(input, name=name)
+        self.size = size
+        self.directions = tuple(directions)
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        from paddle_tpu.ops import mdlstm as md_ops
+
+        proj = ins[0].value
+        assert proj.ndim == 4, (
+            f"{self.name}: mdlstmemory needs a [B, H, W, 5*size] grid input"
+        )
+        hid = self.size or proj.shape[-1] // 5
+        assert proj.shape[-1] == 5 * hid, (
+            f"{self.name}: input width {proj.shape[-1]} != 5*size ({5 * hid})"
+        )
+        p = md_ops.MDLstmParams(
+            w_h=ctx.param(self, "w_h", (hid, 5 * hid), init_mod.smart_normal,
+                          self.param_attr),
+            bias=ctx.param(self, "b", (5 * hid,), init_mod.zeros, self.bias_attr),
+            check_i=ctx.param(self, "check_i", (hid,), init_mod.zeros, None),
+            check_f=ctx.param(self, "check_f", (2, hid), init_mod.zeros, None),
+            check_o=ctx.param(self, "check_o", (hid,), init_mod.zeros, None),
+        )
+        out = md_ops.mdlstm_2d(proj, p, self.directions)
+        return ins[0].with_value(out)

@@ -203,3 +203,46 @@ class SubSeq(Layer):
         out = jnp.take_along_axis(x, idx.reshape(b, t, *([1] * (x.ndim - 2))), axis=1)
         return Argument(out, jnp.minimum(sizes, t))
 
+
+
+@LAYERS.register("sub_nested_seq")
+class SubNestedSeq(Layer):
+    """SubNestedSequenceLayer.cpp:86 — trim a nested sequence to a selected
+    set of subsequences (beam-training machinery, used with kmax_seq_score).
+
+    inputs: nested [B, S, T, ...] with sub_lengths [B, S]; selected_indices
+    [B, K] int32 subsequence ids (-1 = pad).
+    output: [B, K, T, ...] with lengths = count of valid selections and
+    sub_lengths gathered along the selection."""
+
+    type_name = "sub_nested_seq"
+
+    def __init__(self, input: Layer, selected_indices: Layer, name=None):
+        super().__init__([input, selected_indices], name=name)
+
+    def forward(self, ctx, ins):
+        nested, sel = ins
+        assert nested.sub_lengths is not None, (
+            f"{self.name}: sub_nested_seq needs a nested-sequence input "
+            f"(Argument.sub_lengths set)"
+        )
+        idx = sel.value.astype(jnp.int32)  # [B, K]
+        valid = idx >= 0
+        safe = jnp.maximum(idx, 0)
+        gather_idx = safe.reshape(
+            safe.shape + (1,) * (nested.value.ndim - 2)
+        )
+        out = jnp.take_along_axis(
+            nested.value,
+            jnp.broadcast_to(
+                gather_idx, safe.shape + nested.value.shape[2:]
+            ),
+            axis=1,
+        )
+        sub_l = jnp.take_along_axis(nested.sub_lengths, safe, axis=1)
+        sub_l = jnp.where(valid, sub_l, 0)
+        out = out * valid.reshape(
+            valid.shape + (1,) * (out.ndim - 2)
+        ).astype(out.dtype)
+        lengths = valid.sum(axis=1).astype(jnp.int32)
+        return Argument(out, lengths=lengths, sub_lengths=sub_l)

@@ -351,3 +351,99 @@ class LambdaCost(Layer):
         pmask = mask[:, :, None] * mask[:, None, :]
         loss = jnp.sum(dndcg * pair_loss * rel_gt * pmask, axis=(1, 2))
         return Argument(self.coeff * jnp.mean(loss))
+
+
+class BeamInput:
+    """One beam expansion for CrossEntropyOverBeam — mirrors the reference's
+    trainer_config_helpers BeamInput(candidate_scores, selected_candidates,
+    gold) triple (layers.py:6038)."""
+
+    def __init__(self, candidate_scores: Layer, selected_candidates: Layer,
+                 gold: Layer):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+@LAYERS.register("cross_entropy_over_beam")
+class CrossEntropyOverBeam(Layer):
+    """Globally normalized cross entropy over multi-step beam expansions
+    (CrossEntropyOverBeam.cpp:193, learning-to-search training).
+
+    Dense TPU encoding (the reference walks ragged nested sequences on the
+    host; here every expansion is a fixed-shape tensor):
+      expansion t: candidate_scores [B, N_t] (flattened over the expansion's
+      subsequences), selected_candidates [B, K_t] int32 flat indices into N_t
+      (-1 = pad), gold [B] int32 flat index into N_t.
+    Ancestry: subsequence s of expansion t+1 descends from selected candidate
+    s of expansion t (the kmax/sub_nested_seq pipeline guarantees this), so a
+    candidate's parent path id is `flat_index // (N_t // K_{t-1})`-free — we
+    carry path scores forward along the selection directly.
+
+    Per sample: path scores accumulate along selections; the softmax runs
+    over the beam at the expansion where gold falls off (or the last one),
+    with the gold path appended as an extra candidate when it fell off —
+    `-log softmax(paths)[gold]` exactly as CostForOneSequence::forward."""
+
+    type_name = "cross_entropy_over_beam"
+
+    def __init__(self, input: List[BeamInput], name=None):
+        self.beams = list(input)
+        srcs: List[Layer] = []
+        for b in self.beams:
+            srcs += [b.candidate_scores, b.selected_candidates, b.gold]
+        super().__init__(srcs, name=name)
+
+    def forward(self, ctx, ins):
+        n_beams = len(self.beams)
+        scores = [ins[3 * i].value for i in range(n_beams)]
+        selected = [ins[3 * i + 1].value.astype(jnp.int32) for i in range(n_beams)]
+        gold = [ins[3 * i + 2].value.astype(jnp.int32).reshape(-1) for i in range(n_beams)]
+        bsz = scores[0].shape[0]
+        barange = jnp.arange(bsz)
+
+        neg = jnp.asarray(-1e30, jnp.float32)
+        # prefix score of the path each subsequence of expansion t descends
+        # from: [B, K_{t-1}]; expansion 0 descends from the empty path.
+        costs = []          # CE if gold falls off at expansion t (or last)
+        gold_prefix = jnp.zeros((bsz,), jnp.float32)
+        sel_prefix = None   # [B, K_prev] accumulated scores of selected paths
+        gold_in = jnp.ones((bsz,), bool)  # gold survived beams 0..t-1
+        first_off = jnp.full((bsz,), n_beams - 1, jnp.int32)
+        for t in range(n_beams):
+            sc = scores[t].astype(jnp.float32)  # [B, N]
+            n = sc.shape[1]
+            k_prev = 1 if sel_prefix is None else sel_prefix.shape[1]
+            seg = n // k_prev  # candidates per parent subsequence
+            parent = jnp.arange(n) // seg  # ancestry by position
+            base = (
+                jnp.zeros((bsz, n), jnp.float32)
+                if sel_prefix is None
+                else sel_prefix[:, parent]
+            )
+            path_scores = base + sc  # [B, N] total score of every candidate
+            sel = selected[t]  # [B, K]
+            valid = sel >= 0
+            safe = jnp.maximum(sel, 0)
+            sel_scores = jnp.take_along_axis(path_scores, safe, axis=1)
+            sel_scores = jnp.where(valid, sel_scores, neg)
+            g = gold[t]
+            gold_score = gold_prefix + sc[barange, g]
+            hit = jnp.any(valid & (sel == g[:, None]), axis=1)
+            # beam logits at this expansion: selected paths, with the gold
+            # path as an extra slot when it is not among them
+            extra = jnp.where(hit, neg, gold_score)
+            logits = jnp.concatenate([sel_scores, extra[:, None]], axis=1)
+            lse = jax.nn.logsumexp(logits, axis=1)
+            costs.append(lse - gold_score)  # = -log softmax [gold path]
+            # bookkeeping for the next expansion
+            fell_now = gold_in & ~hit
+            first_off = jnp.where(fell_now, t, first_off)
+            gold_in = gold_in & hit
+            gold_prefix = gold_score
+            sel_prefix = sel_scores
+        cost_mat = jnp.stack(costs, axis=1)  # [B, n_beams]
+        per_sample = jnp.take_along_axis(
+            cost_mat, first_off[:, None], axis=1
+        )[:, 0]
+        return Argument(jnp.mean(per_sample))

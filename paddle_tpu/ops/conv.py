@@ -167,3 +167,119 @@ def bilinear_resize(x: Array, out_h: int, out_w: int) -> Array:
 def conv_out_size(in_size: int, k: int, stride: int, pad: int, dilation: int = 1) -> int:
     eff = (k - 1) * dilation + 1
     return (in_size + 2 * pad - eff) // stride + 1
+
+
+# ---------------------------------------------------------------------------
+# 3D convolution / pooling — Conv3DLayer.cpp / DeConv3DLayer.cpp /
+# Pool3DLayer.cpp. NDHWC layout; XLA's conv HLO is rank-agnostic so these
+# lower onto the MXU exactly like the 2D path.
+# ---------------------------------------------------------------------------
+
+IntOr3 = Union[int, Tuple[int, int, int]]
+DIMNUMS3D = ("NDHWC", "DHWIO", "NDHWC")
+
+
+def _triple(v: IntOr3) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        assert len(v) == 3
+        return (int(v[0]), int(v[1]), int(v[2]))
+    return (int(v), int(v), int(v))
+
+
+def conv3d(
+    x: Array,
+    w: Array,
+    stride: IntOr3 = 1,
+    padding: IntOr3 = 0,
+    dilation: IntOr3 = 1,
+    groups: int = 1,
+    policy: Optional[dtypes.Policy] = None,
+) -> Array:
+    """x: [B, D, H, W, Cin], w: [kd, kh, kw, Cin/groups, Cout]."""
+    p = policy or dtypes.current()
+    x = p.cast_compute(x)
+    w = p.cast_compute(w)
+    pd, ph, pw = _triple(padding)
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=_triple(stride),
+        padding=[(pd, pd), (ph, ph), (pw, pw)],
+        rhs_dilation=_triple(dilation),
+        dimension_numbers=DIMNUMS3D,
+        feature_group_count=groups,
+        preferred_element_type=p.accum_dtype,
+        precision=p.precision,
+    )
+
+
+def conv3d_transpose(
+    x: Array,
+    w: Array,
+    stride: IntOr3 = 1,
+    padding: IntOr3 = 0,
+    policy: Optional[dtypes.Policy] = None,
+) -> Array:
+    """Transposed 3D conv (DeConv3DLayer.cpp); w is DHWIO of the forward conv."""
+    p = policy or dtypes.current()
+    x = p.cast_compute(x)
+    w = p.cast_compute(w)
+    pd, ph, pw = _triple(padding)
+    sd, sh, sw = _triple(stride)
+    kd, kh, kw = w.shape[0], w.shape[1], w.shape[2]
+    return lax.conv_general_dilated(
+        x,
+        jnp.flip(w, (0, 1, 2)).swapaxes(3, 4),
+        window_strides=(1, 1, 1),
+        padding=[
+            (kd - 1 - pd, kd - 1 - pd),
+            (kh - 1 - ph, kh - 1 - ph),
+            (kw - 1 - pw, kw - 1 - pw),
+        ],
+        lhs_dilation=(sd, sh, sw),
+        dimension_numbers=DIMNUMS3D,
+        preferred_element_type=p.accum_dtype,
+        precision=p.precision,
+    )
+
+
+def max_pool3d(
+    x: Array, window: IntOr3, stride: Optional[IntOr3] = None, padding: IntOr3 = 0
+) -> Array:
+    wd, wh, ww = _triple(window)
+    sd, sh, sw = _triple(stride if stride is not None else window)
+    pd, ph, pw = _triple(padding)
+    neg = (
+        -jnp.inf
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min
+    )
+    return lax.reduce_window(
+        x,
+        neg,
+        lax.max,
+        window_dimensions=(1, wd, wh, ww, 1),
+        window_strides=(1, sd, sh, sw, 1),
+        padding=((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0)),
+    )
+
+
+def avg_pool3d(
+    x: Array,
+    window: IntOr3,
+    stride: Optional[IntOr3] = None,
+    padding: IntOr3 = 0,
+    exclusive: bool = True,
+) -> Array:
+    wd, wh, ww = _triple(window)
+    sd, sh, sw = _triple(stride if stride is not None else window)
+    pd, ph, pw = _triple(padding)
+    dims = (1, wd, wh, ww, 1)
+    strides = (1, sd, sh, sw, 1)
+    pads = ((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0))
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    if exclusive and (pd or ph or pw):
+        ones = jnp.ones(x.shape[:4] + (1,), x.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return summed / counts
+    return summed / float(wd * wh * ww)

@@ -1,0 +1,132 @@
+"""2-D multi-dimensional LSTM (MDLstmLayer.cpp:180, mdlstmemory).
+
+The reference walks grid cells one CoordIterator step at a time; that serial
+order is hostile to the MXU. TPU-native formulation: *skew* the [H, W] grid so
+anti-diagonals become columns (cell (i, j) → column i + j), then one
+`lax.scan` over the H+W-1 skewed columns updates every row in parallel — the
+classic wavefront schedule. Per Graves' MD-LSTM and the reference's gate
+layout: gates = x·Wx + (Σ_d h_neighbor_d)·Wh + b with blocks
+[inode, input_gate, forget_gate_per_dim×2, output_gate], per-dim forget gates
+on each neighbor state, and peephole weights checkIg/checkFg[2]/checkOg."""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops import linalg
+
+Array = jax.Array
+
+
+class MDLstmParams(NamedTuple):
+    w_h: Array  # [H, 5H] recurrent weight (shared over dims, ref layout)
+    bias: Array  # [5H] for [inode, ig, fg0, fg1, og]
+    check_i: Array  # [H] peephole on input gate
+    check_f: Array  # [2, H] peephole per dim on forget gates
+    check_o: Array  # [H] peephole on output gate
+
+
+def _skew(x: Array) -> Array:
+    """[B, H, W, C] → [B, H, H+W-1, C]: row i shifted right by i."""
+    b, h, w, c = x.shape
+    out = jnp.zeros((b, h, h + w - 1, c), x.dtype)
+    for i in range(h):  # static python loop: h is a compile-time constant
+        out = out.at[:, i, i : i + w].set(x[:, i])
+    return out
+
+
+def _unskew(x: Array, w: int) -> Array:
+    b, h, _, c = x.shape
+    return jnp.stack([x[:, i, i : i + w] for i in range(h)], axis=1)
+
+
+def mdlstm_2d(
+    proj: Array,  # [B, H, W, 5*hid] = x @ w_x (input projection, done outside)
+    p: MDLstmParams,
+    directions: Tuple[bool, bool] = (True, True),
+) -> Array:
+    """Returns h: [B, H, W, hid]. directions[d]=False walks dim d backwards."""
+    b, gh, gw, h5 = proj.shape
+    hid = h5 // 5
+    # walk direction: flip the grid, scan forward, flip back
+    flip_axes = [ax + 1 for ax, fwd in enumerate(directions) if not fwd]
+    if flip_axes:
+        proj = jnp.flip(proj, flip_axes)
+
+    sk = _skew(proj)  # [B, gh, T, 5*hid], T = gh + gw - 1
+    t_len = gh + gw - 1
+    valid = _skew(jnp.ones((1, gh, gw, 1), proj.dtype))  # [1, gh, T, 1]
+
+    dt = proj.dtype
+    w_h = p.w_h.astype(dt)
+    bias = p.bias.astype(dt)
+    ci = p.check_i.astype(dt)
+    cf0 = p.check_f[0].astype(dt)
+    cf1 = p.check_f[1].astype(dt)
+    co = p.check_o.astype(dt)
+
+    def shift_down(x):  # row i receives row i-1 (the up-neighbor)
+        return jnp.pad(x, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+
+    def step(carry, xs):
+        h_prev, c_prev = carry  # [B, gh, hid] — the previous skewed column
+        col, m = xs  # [B, gh, 5*hid], [1, gh, 1]
+        h_up, c_up = shift_down(h_prev), shift_down(c_prev)  # dim-0 neighbor
+        h_left, c_left = h_prev, c_prev  # dim-1 neighbor (same row, prev col)
+        gates = col + linalg.matmul(h_up + h_left, w_h) + bias
+        g, ig, f0, f1, og = jnp.split(gates, 5, axis=-1)
+        i_t = jax.nn.sigmoid(ig + ci * (c_up + c_left))
+        f0_t = jax.nn.sigmoid(f0 + cf0 * c_up)
+        f1_t = jax.nn.sigmoid(f1 + cf1 * c_left)
+        c_t = i_t * jnp.tanh(g) + f0_t * c_up + f1_t * c_left
+        o_t = jax.nn.sigmoid(og + co * c_t)
+        h_t = o_t * jnp.tanh(c_t)
+        # zero out the skew padding so neighbors outside the grid read 0
+        h_t = h_t * m
+        c_t = c_t * m
+        return (h_t, c_t), h_t
+
+    zeros = jnp.zeros((b, gh, hid), dt)
+    xs = (jnp.moveaxis(sk, 2, 0), jnp.moveaxis(valid, 2, 0))
+    _, hs = lax.scan(step, (zeros, zeros), xs)
+    h_grid = _unskew(jnp.moveaxis(hs, 0, 2), gw)  # [B, gh, gw, hid]
+    if flip_axes:
+        h_grid = jnp.flip(h_grid, flip_axes)
+    return h_grid
+
+
+def mdlstm_2d_reference(proj, p, directions=(True, True)):
+    """Slow per-cell oracle for tests (the reference's CoordIterator walk)."""
+    import numpy as np
+
+    proj = np.asarray(proj, np.float32)
+    b, gh, gw, h5 = proj.shape
+    hid = h5 // 5
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    h = np.zeros((b, gh, gw, hid), np.float32)
+    c = np.zeros((b, gh, gw, hid), np.float32)
+    zero = np.zeros((b, hid), np.float32)
+    ii = range(gh) if directions[0] else range(gh - 1, -1, -1)
+    for i in ii:
+        jj = range(gw) if directions[1] else range(gw - 1, -1, -1)
+        for j in jj:
+            pi = i - 1 if directions[0] else i + 1
+            pj = j - 1 if directions[1] else j + 1
+            h_up = h[:, pi, j] if 0 <= pi < gh else zero
+            c_up = c[:, pi, j] if 0 <= pi < gh else zero
+            h_left = h[:, i, pj] if 0 <= pj < gw else zero
+            c_left = c[:, i, pj] if 0 <= pj < gw else zero
+            gates = proj[:, i, j] + (h_up + h_left) @ np.asarray(p.w_h) + np.asarray(p.bias)
+            g, ig, f0, f1, og = np.split(gates, 5, axis=-1)
+            i_t = sig(ig + np.asarray(p.check_i) * (c_up + c_left))
+            f0_t = sig(f0 + np.asarray(p.check_f)[0] * c_up)
+            f1_t = sig(f1 + np.asarray(p.check_f)[1] * c_left)
+            c_t = i_t * np.tanh(g) + f0_t * c_up + f1_t * c_left
+            o_t = sig(og + np.asarray(p.check_o) * c_t)
+            h[:, i, j] = o_t * np.tanh(c_t)
+            c[:, i, j] = c_t
+    return h

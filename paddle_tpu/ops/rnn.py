@@ -77,18 +77,20 @@ def lstm_step(
 ) -> Tuple[Array, Array]:
     """One LSTM step (hl_lstm fused kernel semantics, incl. peepholes)."""
     hdim = h.shape[-1]
-    gates = proj_t + linalg.matmul(h, p.w_hh) + p.bias
+    # params are f32 masters; compute in the activations' dtype so bf16
+    # carries stay bf16 through lax.scan (carry dtypes must be invariant)
+    gates = proj_t + linalg.matmul(h, p.w_hh) + p.bias.astype(proj_t.dtype)
     gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
     ga = act_mod.get(gate_act)
     if p.check_i is not None:
-        gi = gi + c * p.check_i
-        gf = gf + c * p.check_f
+        gi = gi + c * p.check_i.astype(c.dtype)
+        gf = gf + c * p.check_f.astype(c.dtype)
     i = ga(gi)
     f = ga(gf)
     cand = act_mod.get(cell_act)(gc)
     c_new = f * c + i * cand
     if p.check_o is not None:
-        go = go + c_new * p.check_o
+        go = go + c_new * p.check_o.astype(c_new.dtype)
     o = ga(go)
     h_new = o * act_mod.get(state_act)(c_new)
     return h_new, c_new
@@ -153,7 +155,7 @@ def gru_step(
     """One GRU step (GruCompute / hl_gpu_gru.cuh semantics: reset gate applies
     to the *recurrent* candidate term)."""
     hdim = h.shape[-1]
-    pz, pr, pc = jnp.split(proj_t + p.bias, 3, axis=-1)
+    pz, pr, pc = jnp.split(proj_t + p.bias.astype(proj_t.dtype), 3, axis=-1)
     rz = linalg.matmul(h, p.w_hzr)
     ga = act_mod.get(gate_act)
     z = ga(pz + rz[:, :hdim])

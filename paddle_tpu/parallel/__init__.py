@@ -14,5 +14,4 @@ from paddle_tpu.parallel.updaters import (  # noqa: F401
     IciAllReduceUpdater,
     ParameterUpdater,
     SgdLocalUpdater,
-    SparseShardedUpdater,
 )

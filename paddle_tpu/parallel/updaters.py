@@ -76,16 +76,8 @@ class IciAllReduceUpdater(SgdLocalUpdater):
             distributed.barrier("finish_pass")
 
 
-class SparseShardedUpdater(ParameterUpdater):
-    """SparseRemoteParameterUpdater parity (RemoteParameterUpdater.h:265):
-    embedding tables live row-sharded on the mesh (parallel/embedding.py);
-    the 'prefetch' pass of the reference (pull the rows this batch touches)
-    is unnecessary — the sharded lookup's gather touches only owned rows, and
-    its transpose is the row-sparse scatter-add the pserver applied by hand."""
-
-    def __init__(self, optimizer: Optimizer, table_params: Optional[set] = None):
-        self.optimizer = optimizer
-        self.table_params = table_params or set()
-
-    def apply(self, grads, opt_state, params, lr):
-        return self.optimizer.update(grads, opt_state, params, lr)
+# SparseRemoteParameterUpdater (RemoteParameterUpdater.h:265) has no updater
+# class here on purpose: embedding tables live row-sharded on the mesh
+# (parallel/embedding.py), the sharded lookup's gather touches only owned
+# rows, and its transpose is the row-sparse scatter-add the pserver applied
+# by hand — so the "sparse updater" is the compiled step itself.

@@ -47,6 +47,7 @@ class SGDTrainer:
         schedule: Optional[Callable] = None,
         model_average: Optional[ModelAverage] = None,
         parallel: Optional[Any] = None,  # parallel.DataParallel or None
+        updater: Optional[Any] = None,  # parallel.ParameterUpdater
         seed: int = 0,
     ):
         costs = [cost] if isinstance(cost, Layer) else list(cost)
@@ -54,6 +55,21 @@ class SGDTrainer:
         self.extra_names = [e.name for e in extra_outputs]
         self.network = Network(costs + list(extra_outputs))
         self.optimizer = optimizer
+        # The ParameterUpdater protocol (ParameterUpdater.h:38) is the seam
+        # where parallelism plugs into the trainer: the optimizer application
+        # inside the compiled step goes through updater.apply, and host-side
+        # pass boundaries go through start_pass/finish_pass (barriers on
+        # multi-host). Default: local updater, or the ICI all-reduce updater
+        # when a DataParallel mesh is configured.
+        if updater is None:
+            from paddle_tpu.parallel import IciAllReduceUpdater, SgdLocalUpdater
+
+            updater = (
+                IciAllReduceUpdater(optimizer, parallel)
+                if parallel is not None
+                else SgdLocalUpdater(optimizer)
+            )
+        self.updater = updater
         self.schedule = schedule or schedules.build(optimizer.learning_rate)
         self.model_average = model_average or ModelAverage(0.0)
         self.parallel = parallel
@@ -93,7 +109,7 @@ class SGDTrainer:
         net = self.network
         cost_names = self.cost_names
         extra_names = self.extra_names
-        optimizer = self.optimizer
+        updater = self.updater
         schedule = self.schedule
         avg = self.model_average
 
@@ -114,7 +130,7 @@ class SGDTrainer:
             )(state["params"])
             if self.parallel is not None:
                 grads, cost = self.parallel.reduce_grads(grads, cost)
-            new_params, new_opt = optimizer.update(
+            new_params, new_opt = updater.apply(
                 grads, state["opt"], state["params"], lr
             )
             new_avg = avg.update(state["avg"], new_params)
@@ -192,6 +208,7 @@ class SGDTrainer:
         event_handler = event_handler or (lambda e: None)
         for pass_id in range(num_passes):
             event_handler(BeginPass(pass_id))
+            self.updater.start_pass()
             t0 = time.time()
             cost_sum_dev, n_batches = None, 0
             for batch_id, raw in enumerate(reader()):
@@ -244,6 +261,7 @@ class SGDTrainer:
                 "batches": n_batches,
                 "pass_seconds": time.time() - t0,
             }
+            self.updater.finish_pass()
             if test_reader is not None:
                 metrics["test_cost"] = self.test(test_reader, feeder)["cost"]
             if save_dir is not None:

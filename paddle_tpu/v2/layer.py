@@ -54,6 +54,8 @@ __all__ = [
     "priorbox", "multibox_loss", "detection_output", "bidirectional_lstm",
     "bidirectional_gru", "simple_lstm", "simple_gru", "repeat", "resize",
     "block_expand", "row_conv", "selective_fc", "gated_unit",
+    "img_conv3d", "img_pool3d", "linear_comb", "convex_comb", "mdlstm",
+    "sub_nested_seq", "cross_entropy_over_beam", "BeamInput",
 ]
 
 
@@ -263,7 +265,76 @@ def sub_seq(input, offsets, sizes, name=None):
 # -- elementwise / misc ----------------------------------------------------
 
 
-def cos_sim(a, b, scale=1.0, name=None):
+def img_conv3d(input, filter_size, num_filters, num_channels=None, stride=1,
+               padding=0, dilation=1, groups=1, act=None, bias_attr=None,
+               param_attr=None, name=None, trans=False, layer_attr=None,
+               **_compat):
+    """img_conv3d_layer (layers.py:6770) — NDHWC input."""
+    from paddle_tpu.nn import layers3d as L3
+
+    cls = L3.Conv3DTranspose if trans else L3.Conv3D
+    kwargs = dict(
+        num_filters=num_filters, filter_size=filter_size, stride=stride,
+        padding=padding, act=_act(act), bias=bias_attr is not False,
+        param_attr=param_attr, bias_attr=_or_none(bias_attr), name=name,
+    )
+    if not trans:
+        kwargs.update(dilation=dilation, groups=groups)
+    return _with_drop(cls(input, **kwargs), layer_attr)
+
+
+def img_pool3d(input, pool_size, pool_type=None, stride=None, padding=0,
+               name=None, layer_attr=None, **_compat):
+    """img_pool3d_layer (layers.py:2695)."""
+    from paddle_tpu.nn import layers3d as L3
+
+    return _with_drop(
+        L3.Pool3D(input, pool_size, _pool(pool_type), stride=stride,
+                  padding=padding, name=name),
+        layer_attr,
+    )
+
+
+def linear_comb(weights, vectors, size=None, name=None, **_compat):
+    """linear_comb_layer / convex_comb_layer (layers.py:4984)."""
+    return L.LinearComb(weights, vectors, size=size, name=name)
+
+
+convex_comb = linear_comb
+
+
+def mdlstm(input, size=None, directions=(True, True), param_attr=None,
+           bias_attr=None, name=None, **_compat):
+    """mdlstmemory (config_parser.py:3621) — 2-D multi-dimensional LSTM over
+    a pre-projected [B, H, W, 5*size] grid."""
+    return R.MDLstm(input, size=size, directions=directions,
+                    param_attr=param_attr, bias_attr=_or_none(bias_attr),
+                    name=name)
+
+
+def sub_nested_seq(input, selected_indices, name=None):
+    """sub_nested_seq_layer (layers.py:6582)."""
+    return S.SubNestedSeq(input, selected_indices, name=name)
+
+
+def cross_entropy_over_beam(input, name=None):
+    """cross_entropy_over_beam (layers.py:6038); input is a list of
+    BeamInput(candidate_scores, selected_candidates, gold)."""
+    return SC.CrossEntropyOverBeam(input, name=name)
+
+
+BeamInput = SC.BeamInput
+
+
+def cos_sim(a, b, scale=1.0, size=1, name=None):
+    """cos_sim_layer (layers.py:2228): size=1 is row-wise cosine; size=N>1 is
+    the vector-vs-matrix form (cos_vm, CosSimVecMatLayer.cpp)."""
+    if size and size > 1:
+        return L.CosSimVecMat(a, b, size=size, scale=scale, name=name)
+    return _cos_sim_rowwise(a, b, scale=scale, name=name)
+
+
+def _cos_sim_rowwise(a, b, scale=1.0, name=None):
     return L.CosSim(a, b, scale=scale, name=name)
 
 

@@ -1,0 +1,232 @@
+"""Round-3 layer-breadth additions: 3-D conv/pool (Conv3DLayer.cpp,
+Pool3DLayer.cpp), MDLSTM (MDLstmLayer.cpp), linear_comb/cos_vm, and the beam
+machinery (SubNestedSequenceLayer.cpp, CrossEntropyOverBeam.cpp) — each
+checked against an independent numpy/oracle formulation plus gradient
+finiteness."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn import layers3d as L3
+from paddle_tpu.nn import recurrent as R
+from paddle_tpu.nn import seq_layers as S
+from paddle_tpu.nn import struct_costs as SC
+from paddle_tpu.nn.graph import Argument, Network, reset_name_scope
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    reset_name_scope()
+
+
+def test_conv3d_matches_manual_window_sum():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 4, 5, 6, 3).astype(np.float32)
+    d = L.Data("x", shape=(4, 5, 6, 3))
+    conv = L3.Conv3D(d, num_filters=2, filter_size=2, stride=1, padding=0,
+                     act=None, bias=False, name="c3")
+    net = Network(conv)
+    params, states = net.init(jax.random.PRNGKey(0), {"x": x})
+    outs, _ = net.apply(params, states, {"x": x})
+    got = np.asarray(outs["c3"].value)
+    w = np.asarray(params["c3.w"])  # [2,2,2,3,2]
+    # manual direct convolution at a few positions
+    for (b, dd, hh, ww) in [(0, 0, 0, 0), (1, 2, 3, 4), (0, 1, 2, 2)]:
+        patch = x[b, dd:dd + 2, hh:hh + 2, ww:ww + 2, :]
+        want = np.tensordot(patch, w, axes=([0, 1, 2, 3], [0, 1, 2, 3]))
+        np.testing.assert_allclose(got[b, dd, hh, ww], want, rtol=1e-4, atol=1e-4)
+    assert got.shape == (2, 3, 4, 5, 2)
+
+
+def test_conv3d_transpose_is_adjoint_of_conv3d():
+    """<conv(x), y> == <x, conv_T(y)> — the defining adjoint property."""
+    from paddle_tpu.ops import conv as conv_ops
+
+    rs = np.random.RandomState(1)
+    x = rs.randn(1, 5, 5, 5, 2).astype(np.float32)
+    w = rs.randn(3, 3, 3, 2, 5).astype(np.float32) * 0.1
+    y = conv_ops.conv3d(x, w, stride=2, padding=1)  # [1, 3, 3, 3, 5]
+    u = rs.randn(*y.shape).astype(np.float32)
+    # transpose takes the fwd conv's weight as-is ([k,k,k, Cout_of_T, Cin_of_T])
+    xt = conv_ops.conv3d_transpose(u, w, stride=2, padding=1)
+    assert xt.shape == x.shape
+    lhs = float(jnp.sum(y * u))
+    rhs = float(jnp.sum(jnp.asarray(x) * xt))
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-3)
+
+
+def test_pool3d_max_and_avg():
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 4, 4, 4, 3).astype(np.float32)
+    d = L.Data("x", shape=(4, 4, 4, 3))
+    mp = L3.Pool3D(d, 2, "max", name="mp")
+    ap = L3.Pool3D(d, 2, "avg", name="ap")
+    net = Network([mp, ap])
+    params, states = net.init(jax.random.PRNGKey(0), {"x": x})
+    outs, _ = net.apply(params, states, {"x": x})
+    want_max = x.reshape(2, 2, 2, 2, 2, 2, 2, 3).max((2, 4, 6))
+    want_avg = x.reshape(2, 2, 2, 2, 2, 2, 2, 3).mean((2, 4, 6))
+    np.testing.assert_allclose(np.asarray(outs["mp"].value), want_max, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs["ap"].value), want_avg, rtol=1e-5)
+
+
+@pytest.mark.parametrize("directions", [(True, True), (False, True),
+                                        (True, False), (False, False)])
+def test_mdlstm_matches_percell_oracle(directions):
+    from paddle_tpu.ops import mdlstm as M
+
+    rs = np.random.RandomState(3)
+    hid = 4
+    proj = rs.randn(2, 3, 5, 5 * hid).astype(np.float32) * 0.5
+    p = M.MDLstmParams(
+        w_h=rs.randn(hid, 5 * hid).astype(np.float32) * 0.3,
+        bias=rs.randn(5 * hid).astype(np.float32) * 0.1,
+        check_i=rs.randn(hid).astype(np.float32) * 0.1,
+        check_f=rs.randn(2, hid).astype(np.float32) * 0.1,
+        check_o=rs.randn(hid).astype(np.float32) * 0.1,
+    )
+    got = np.asarray(M.mdlstm_2d(jnp.asarray(proj), p, directions))
+    want = M.mdlstm_2d_reference(proj, p, directions)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_mdlstm_layer_gradients_finite():
+    rs = np.random.RandomState(4)
+    hid = 3
+    x = rs.randn(2, 3, 4, 5 * hid).astype(np.float32) * 0.3
+    d = L.Data("x", shape=(3, 4, 5 * hid))
+    md = R.MDLstm(d, size=hid, name="md")
+    net = Network(md)
+    params, states = net.init(jax.random.PRNGKey(0), {"x": x})
+
+    def loss(p):
+        outs, _ = net.apply(p, states, {"x": x})
+        return jnp.sum(outs["md"].value ** 2)
+
+    g = jax.grad(loss)(params)
+    for k, v in g.items():
+        assert np.all(np.isfinite(np.asarray(v))), k
+    assert float(jnp.abs(g["md.w_h"]).sum()) > 0
+
+
+def test_linear_comb_and_cos_vm():
+    rs = np.random.RandomState(5)
+    m, n, b = 3, 4, 2
+    wts = rs.randn(b, m).astype(np.float32)
+    vecs = rs.randn(b, m * n).astype(np.float32)
+    dw = L.Data("w", shape=(m,))
+    dv = L.Data("v", shape=(m * n,))
+    lc = L.LinearComb(dw, dv, name="lc")
+    cv = L.CosSimVecMat(dw, dv, scale=2.0, name="cv")
+    net = Network([lc, cv])
+    params, states = net.init(jax.random.PRNGKey(0), {"w": wts, "v": vecs})
+    outs, _ = net.apply(params, states, {"w": wts, "v": vecs})
+    # linear_comb: z = x^T Y with Y = vectors.reshape(M, N) (layers.py:4984)
+    want_lc = np.einsum("bm,bmn->bn", wts, vecs.reshape(b, m, n))
+    np.testing.assert_allclose(np.asarray(outs["lc"].value), want_lc, rtol=1e-5)
+    # cos_vm: rows laid out by step M (CosSimVecMatLayer.cpp)
+    mat = vecs.reshape(b, n, m)
+    want_cv = 2.0 * np.einsum("bm,bnm->bn", wts, mat) / (
+        np.linalg.norm(wts, axis=1, keepdims=True) * np.linalg.norm(mat, axis=2)
+    )
+    np.testing.assert_allclose(np.asarray(outs["cv"].value), want_cv,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sub_nested_seq_selects_subsequences():
+    rs = np.random.RandomState(6)
+    val = rs.randn(2, 4, 3, 5).astype(np.float32)  # [B, S, T, D]
+    sub_l = np.array([[3, 2, 1, 3], [2, 2, 2, 0]], np.int32)
+    sel = np.array([[2, 0], [1, -1]], np.int32)
+    nested = Argument(jnp.asarray(val), lengths=jnp.asarray([4, 3]),
+                      sub_lengths=jnp.asarray(sub_l))
+    layer = S.SubNestedSeq.__new__(S.SubNestedSeq)
+    layer.name = "sns"
+    out = layer.forward(None, [nested, Argument(jnp.asarray(sel))])
+    got = np.asarray(out.value)
+    np.testing.assert_allclose(got[0, 0], val[0, 2])
+    np.testing.assert_allclose(got[0, 1], val[0, 0])
+    np.testing.assert_allclose(got[1, 0], val[1, 1])
+    np.testing.assert_allclose(got[1, 1], 0.0)  # -1 pad → zeroed
+    np.testing.assert_array_equal(np.asarray(out.sub_lengths),
+                                  [[1, 3], [2, 0]])
+    np.testing.assert_array_equal(np.asarray(out.lengths), [2, 1])
+
+
+def _beam_cost_oracle(scores, selected, gold):
+    """Slow per-sample reimplementation of CostForOneSequence::forward for
+    the dense encoding."""
+    bsz = scores[0].shape[0]
+    out = np.zeros(bsz)
+    for b in range(bsz):
+        prefix_sel = None
+        gold_prefix = 0.0
+        costs_t, hits_t = [], []
+        for sc, sel, g in zip(scores, selected, gold):
+            n = sc.shape[1]
+            k_prev = 1 if prefix_sel is None else len(prefix_sel)
+            seg = n // k_prev
+            base = np.zeros(n) if prefix_sel is None else np.repeat(prefix_sel, seg)
+            path = base + sc[b]
+            sel_b = sel[b]
+            valid = sel_b >= 0
+            sel_scores = np.where(valid, path[np.maximum(sel_b, 0)], -1e30)
+            gold_score = gold_prefix + sc[b, g[b]]
+            hit = bool(np.any(valid & (sel_b == g[b])))
+            logits = list(sel_scores) + ([] if hit else [gold_score])
+            mx = max(logits)
+            lse = mx + np.log(sum(np.exp(l - mx) for l in logits))
+            costs_t.append(lse - gold_score)
+            hits_t.append(hit)
+            gold_prefix = gold_score
+            prefix_sel = sel_scores
+        # cost at the first expansion where gold fell off, else the last
+        cut = next((t for t, h in enumerate(hits_t) if not h), len(costs_t) - 1)
+        out[b] = costs_t[cut]
+    return out
+
+
+def test_cross_entropy_over_beam_matches_oracle():
+    rs = np.random.RandomState(7)
+    bsz, k = 3, 2
+    scores = [rs.randn(bsz, 4).astype(np.float32),
+              rs.randn(bsz, 2 * 3).astype(np.float32)]
+    selected = [np.array([[1, 3], [0, 2], [2, -1]], np.int32),
+                np.array([[0, 4], [1, 5], [3, 2]], np.int32)]
+    # sample 0: gold in both beams; sample 1: falls off at t=1;
+    # sample 2: falls off at t=0
+    gold = [np.array([3, 0, 1], np.int32), np.array([4, 2, 0], np.int32)]
+
+    layer = SC.CrossEntropyOverBeam.__new__(SC.CrossEntropyOverBeam)
+    layer.name = "beam_ce"
+    layer.beams = [None, None]
+    ins = []
+    for t in range(2):
+        ins += [Argument(jnp.asarray(scores[t])),
+                Argument(jnp.asarray(selected[t])),
+                Argument(jnp.asarray(gold[t]))]
+    got = float(layer.forward(None, ins).value)
+    want = float(np.mean(_beam_cost_oracle(scores, selected, gold)))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_v1_and_v2_wrappers_resolve():
+    from paddle_tpu.config import helpers as H
+    from paddle_tpu.v2 import layer as vl
+
+    for name in ("img_conv3d_layer", "img_pool3d_layer", "linear_comb_layer",
+                 "convex_comb_layer", "sub_nested_seq_layer",
+                 "cross_entropy_over_beam", "BeamInput"):
+        assert hasattr(H, name), name
+    for name in ("img_conv3d", "img_pool3d", "linear_comb", "convex_comb",
+                 "mdlstm", "sub_nested_seq", "cross_entropy_over_beam"):
+        assert hasattr(vl, name), name
+    # registry parity for the new type names
+    from paddle_tpu.core.registry import LAYERS
+    for t in ("conv3d", "deconv3d", "pool3d", "mdlstmemory", "convex_comb",
+              "cos_vm", "sub_nested_seq", "cross_entropy_over_beam"):
+        assert LAYERS.get(t) is not None, t

@@ -106,3 +106,45 @@ def test_bilinear_resize():
     x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
     out = conv_ops.bilinear_resize(x, 8, 8)
     assert out.shape == (1, 8, 8, 1)
+
+
+def test_fused_batch_norm_matches_autodiff_oracle():
+    """ops/normalization.py custom VJP vs plain-jnp autodiff in f32."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import normalization as N
+
+    rs = np.random.RandomState(7)
+    x = rs.randn(8, 5, 5, 6).astype(np.float32) * 2 + 1.5
+    gamma = rs.randn(6).astype(np.float32) * 0.5 + 1.0
+    beta = rs.randn(6).astype(np.float32)
+    eps = 1e-5
+
+    def oracle(x, g, b):
+        axes = (0, 1, 2)
+        m = jnp.mean(x, axis=axes)
+        v = jnp.var(x, axis=axes)
+        y = (x - m) * jax.lax.rsqrt(v + eps) * g + b
+        return y
+
+    def loss_fused(args):
+        y, _, _ = N.batch_norm_train(*args, eps)
+        return jnp.sum(jnp.sin(y))
+
+    def loss_oracle(args):
+        return jnp.sum(jnp.sin(oracle(*args)))
+
+    y_f, m_f, v_f = N.batch_norm_train(x, gamma, beta, eps)
+    np.testing.assert_allclose(np.asarray(y_f), np.asarray(oracle(x, gamma, beta)),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(m_f), x.mean((0, 1, 2)), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v_f), x.var((0, 1, 2)), rtol=1e-3, atol=1e-4)
+
+    g1 = jax.grad(loss_fused)((x, gamma, beta))
+    g2 = jax.grad(loss_oracle)((x, gamma, beta))
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-3, atol=2e-3)
+
+    # inference path
+    y_i = N.batch_norm_inference(x, gamma, beta, m_f, v_f, eps)
+    np.testing.assert_allclose(np.asarray(y_i), np.asarray(y_f), rtol=2e-3, atol=2e-3)

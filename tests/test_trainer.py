@@ -243,3 +243,39 @@ def test_end_iteration_event_is_lazy():
     assert ev.cost == c1                # cached
     passes = [e for e in events if isinstance(e, EndPass)]
     assert np.isfinite(passes[-1].metrics["avg_cost"])
+
+
+def test_updater_protocol_is_wired():
+    """The ParameterUpdater seam (ParameterUpdater.h:38): a custom updater's
+    apply runs inside the compiled step and pass hooks fire on the host."""
+    from paddle_tpu.parallel import SgdLocalUpdater
+
+    calls = []
+
+    class CountingUpdater(SgdLocalUpdater):
+        def start_pass(self):
+            calls.append("start_pass")
+
+        def finish_pass(self):
+            calls.append("finish_pass")
+
+        def apply(self, grads, opt_state, params, lr):
+            # scale LR by 0 => params must not move; proves apply() is the
+            # one being traced into the step, not optimizer.update directly
+            return super().apply(grads, opt_state, params, lr * 0.0)
+
+    _, _, _, cost = _build()
+    opt = SGD(learning_rate=0.5)
+    tr = SGDTrainer(cost, opt, updater=CountingUpdater(opt))
+    reader = rd.batch(_toy_classification_reader(n=32), 16)
+    feeder = DataFeeder({"x": dense_vector(8), "label": integer_value(4)})
+    tr.train(reader, num_passes=2, feeder=feeder)
+    assert calls == ["start_pass", "finish_pass"] * 2
+    # zero-LR updater: parameters unchanged after training
+    p0, _ = tr.network.init(
+        __import__("jax").random.PRNGKey(tr.seed),
+        feeder(next(iter(rd.batch(_toy_classification_reader(n=16), 16)()))),
+        train=True,
+    )
+    for k, v in tr.state["params"].items():
+        np.testing.assert_allclose(np.asarray(v), np.asarray(p0[k]), atol=1e-6)
