@@ -130,7 +130,7 @@ def run_seq2seq(cpu_fallback: bool, peak: float, n_dev: int) -> dict:
     else:
         vocab = int(os.environ.get("BENCH_S2S_VOCAB", "30000"))
         dim = int(os.environ.get("BENCH_S2S_DIM", "512"))
-        bs = int(os.environ.get("BENCH_S2S_BATCH", "64"))
+        bs = int(os.environ.get("BENCH_S2S_BATCH", "128"))  # best measured (sweep r3)
         src_len = trg_len = int(os.environ.get("BENCH_S2S_LEN", "50"))
         steps = max(1, int(os.environ.get("BENCH_S2S_STEPS", "16")))
         warmup = 2

@@ -59,3 +59,35 @@ def merge_model(
         np.savez(f, **payload)
     os.replace(tmp, output_path)
     return output_path
+
+
+def merge_model_v1(
+    config_path: str,
+    model_dir: str,
+    output_path: str,
+    config_args: str = "",
+    pass_id: Optional[int] = None,
+) -> str:
+    """Reference-format merged model (MergeModel.cpp byte layout): int64
+    config length + serialized TrainerConfig + every parameter written with
+    its `Parameter::Header` in topological parameter order. The config is our
+    protobuf-text rendering (the reference writes binary proto; the framing
+    and parameter bytes are format-identical)."""
+    from paddle_tpu import proto
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import checkpoint as ckpt
+    from paddle_tpu.trainer import v1_format
+
+    pc = parse_config(config_path, config_args)
+    if any(d.startswith("pass-") for d in os.listdir(model_dir)):
+        params, _states, _opt, _m = ckpt.load_pass(model_dir, pass_id)
+    else:
+        parent, leaf = os.path.split(model_dir.rstrip("/"))
+        params, _states, _opt, _m = ckpt.load_pass(parent, int(leaf.split("-")[1]))
+
+    config_bytes = proto.to_text(pc.trainer_config).encode()
+    tmp = output_path + ".tmp"
+    with open(tmp, "wb") as f:
+        v1_format.write_merged(f, config_bytes, params, order=sorted(params))
+    os.replace(tmp, output_path)
+    return output_path

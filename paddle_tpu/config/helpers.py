@@ -200,7 +200,7 @@ from paddle_tpu.config.v1_layers import (  # noqa: E402
 # -- evaluator declarations (trainer_config_helpers/evaluators.py) ----------
 
 
-def _declare_evaluator(etype: str, *input_layers, name: Optional[str] = None, **_kw):
+def _declare_evaluator(etype: str, *input_layers, name: Optional[str] = None, **kw):
     from paddle_tpu.config import config_parser as cp
 
     cfg = proto.EvaluatorConfig(
@@ -208,6 +208,9 @@ def _declare_evaluator(etype: str, *input_layers, name: Optional[str] = None, **
         type=etype,
         input_layers=[l.name for l in input_layers if l is not None],
     )
+    for k, v in kw.items():  # EvaluatorConfig fields (chunk_scheme, top_k, ...)
+        if hasattr(cfg, k) and v is not None:
+            setattr(cfg, k, v)
     cp.g_context().evaluators.append(cfg)
     return cfg
 
@@ -236,9 +239,37 @@ def column_sum_evaluator(input=None, name=None, **kw):
     return _declare_evaluator("column_sum", input, name=name, **kw)
 
 
-def chunk_evaluator(input=None, label=None, chunk_scheme="IOB", num_chunk_types=0, name=None, **kw):
-    cfg = _declare_evaluator("chunk", input, label, name=name)
-    return cfg
+def chunk_evaluator(input=None, label=None, chunk_scheme="IOB",
+                    num_chunk_types=0, name=None, excluded_chunk_types=None,
+                    **kw):
+    return _declare_evaluator(
+        "chunk", input, label, name=name, chunk_scheme=chunk_scheme,
+        num_chunk_types=num_chunk_types or 1,
+        excluded_chunk_types=excluded_chunk_types or [],
+    )
+
+
+def value_printer_evaluator(input=None, name=None, **kw):
+    """utils evaluator (Evaluator.h ValuePrinter): print layer outputs."""
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    return _declare_evaluator("value_printer", *ins, name=name, **kw)
+
+
+def gradient_printer_evaluator(input=None, name=None, **kw):
+    """GradientPrinter: per-layer gradients are not materialized outside the
+    compiled step here, so this prints the layer's forward value with a note
+    (declared for config compatibility)."""
+    return _declare_evaluator("gradient_printer", input, name=name, **kw)
+
+
+def maxid_printer_evaluator(input=None, num_results=1, name=None, **kw):
+    return _declare_evaluator("max_id_printer", input, name=name,
+                              num_results=num_results, **kw)
+
+
+def classification_error_printer_evaluator(input=None, label=None, name=None, **kw):
+    return _declare_evaluator("classification_error_printer", input, label,
+                              name=name, **kw)
 
 
 def ctc_error_evaluator(input=None, label=None, name=None, **kw):

@@ -75,6 +75,15 @@ class Executor:
             if op.type == "backward":
                 self._run_backward(block, op, values, ctx)
                 continue
+            if op.type == "recurrent":
+                self._run_recurrent(block, op, values, ctx)
+                continue
+            if op.type == "while":
+                self._run_while(block, op, values, ctx)
+                continue
+            if op.type == "cond":
+                self._run_cond(block, op, values, ctx)
+                continue
             fn = ops_mod.OPS.get(op.type)
             ins = {
                 slot: [values[n] for n in names]
@@ -115,6 +124,115 @@ class Executor:
         grads = jax.grad(loss_fn)({p: values[p] for p in params})
         for p in params:
             values[p + "@GRAD"] = grads[p]
+
+    # -- control flow (cond_op.cc:231 / recurrent_op.cc:222 / while) ---------
+    # The reference interprets sub-scopes per step on the host; here each
+    # sub-block is traced once and driven by the matching lax primitive, so
+    # control flow compiles into the same XLA program as everything else.
+
+    def _sub_block(self, block: Block, idx: int) -> Block:
+        return block.program.blocks[idx]
+
+    def _run_recurrent(self, block, op, values, ctx) -> None:
+        """recurrent_op.cc:222 → one lax.scan. Attrs:
+        sub_block: int; seq_ins: {block_var: parent_seq_var} ([B, T, ...],
+        sliced per step as [B, ...]); states: {block_pre_state: (boot_var,
+        block_state)}; seq_outs: {parent_out: block_var} (stacked [B, T, ...]).
+        """
+        sub = self._sub_block(block, op.attrs["sub_block"])
+        seq_ins: Dict[str, str] = op.attrs.get("seq_ins", {})
+        states: Dict[str, Any] = op.attrs.get("states", {})
+        seq_outs: Dict[str, str] = op.attrs.get("seq_outs", {})
+        reverse = bool(op.attrs.get("reverse", False))
+
+        base = {
+            k: v for k, v in values.items()
+            if k not in seq_ins.values()
+        }
+        carry0 = {pre: values[boot] for pre, (boot, _st) in states.items()}
+        xs = {bv: jnp.swapaxes(values[pv], 0, 1) for bv, pv in seq_ins.items()}
+
+        def body(carry, x_t):
+            local = dict(base)
+            local.update(x_t)
+            local.update(carry)
+            local = self._run_ops(sub, local, ctx)
+            new_carry = {pre: local[st] for pre, (_b, st) in states.items()}
+            outs = {pv: local[bv] for pv, bv in seq_outs.items()}
+            return new_carry, outs
+
+        carry, stacked = jax.lax.scan(body, carry0, xs, reverse=reverse)
+        for pv, seq in stacked.items():
+            values[pv] = jnp.swapaxes(seq, 0, 1)
+        for pre, (_b, st) in states.items():
+            values[f"{op.attrs.get('name', 'recurrent')}.{st}@LAST"] = carry[pre]
+
+    def _run_while(self, block, op, values, ctx) -> None:
+        """while op → lax.while_loop. Attrs: sub_block, cond (scalar bool var
+        recomputed by the sub-block each iteration), carry (var names carried
+        across iterations; shapes must be loop-invariant)."""
+        sub = self._sub_block(block, op.attrs["sub_block"])
+        carry_names = list(op.attrs["carry"])
+        cond_name = op.attrs["cond"]
+        base = {k: v for k, v in values.items() if k not in carry_names}
+
+        def cond_fun(carry):
+            return jnp.asarray(carry[cond_name]).reshape(()).astype(bool)
+
+        def body_fun(carry):
+            local = dict(base)
+            local.update(carry)
+            local = self._run_ops(sub, local, ctx)
+            return {n: local[n] for n in {cond_name, *carry_names}}
+
+        carry0 = {n: values[n] for n in {cond_name, *carry_names}}
+        out = jax.lax.while_loop(cond_fun, body_fun, carry0)
+        values.update(out)
+
+    def _run_cond(self, block, op, values, ctx) -> None:
+        """cond_op.cc:231. Scalar condition → lax.cond over the two
+        sub-blocks; vector (per-sample) condition → both branches run on the
+        full batch and outputs are mask-selected (the TPU-native equivalent
+        of the reference's scope split/merge — identical results for pure
+        subnets, no dynamic shapes)."""
+        cond = values[op.attrs["cond"]]
+        true_b = self._sub_block(block, op.attrs["true_block"])
+        false_b = (
+            self._sub_block(block, op.attrs["false_block"])
+            if op.attrs.get("false_block") is not None
+            else None
+        )
+        out_names = list(op.attrs["outs"])
+        base = dict(values)
+
+        def run_block(sub):
+            local = self._run_ops(sub, dict(base), ctx)
+            return [local[n] for n in out_names]
+
+        cond_arr = jnp.asarray(cond)
+        if cond_arr.ndim == 0 or cond_arr.size == 1:
+            outs = jax.lax.cond(
+                cond_arr.reshape(()).astype(bool),
+                lambda: run_block(true_b),
+                lambda: (
+                    run_block(false_b)
+                    if false_b is not None
+                    else [values[n] for n in out_names]
+                ),
+            )
+        else:
+            t_outs = run_block(true_b)
+            f_outs = (
+                run_block(false_b)
+                if false_b is not None
+                else [values[n] for n in out_names]
+            )
+            mask = cond_arr.reshape(-1).astype(bool)
+            outs = [
+                jnp.where(mask.reshape((-1,) + (1,) * (t.ndim - 1)), t, f)
+                for t, f in zip(t_outs, f_outs)
+            ]
+        values.update(dict(zip(out_names, outs)))
 
     # -- public API ----------------------------------------------------------
     def run(

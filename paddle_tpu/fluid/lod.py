@@ -1,0 +1,105 @@
+"""LoDTensor and SelectedRows — the fluid ragged/sparse value types.
+
+Parity: paddle/framework/lod_tensor.h:80 (level-of-detail offsets over a
+packed value tensor) and framework/selected_rows.h (row-sparse gradients).
+
+TPU encoding: the packed data stays packed ([sum_len, D] with int32 offset
+vectors per level, exactly the reference's Vector<size_t> lod) and ops use
+segment ids derived from the offsets — static shapes, dynamic *values*, so
+everything stays jit-compatible. Conversion helpers to/from the padded
+[B, T, D]+lengths encoding used by paddle_tpu.nn round-trip losslessly."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class LoDTensor:
+    """data: [N, ...] packed values; lod: tuple of offset vectors, coarsest
+    level first (lod[-1] segments individual sequences of rows)."""
+
+    data: Array
+    lod: Tuple[Array, ...] = ()
+
+    def tree_flatten(self):
+        return (self.data, self.lod), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, lod = children
+        return cls(data, tuple(lod))
+
+    @property
+    def num_sequences(self) -> int:
+        return len(self.lod[-1]) - 1 if self.lod else self.data.shape[0]
+
+    def seq_lengths(self) -> Array:
+        off = jnp.asarray(self.lod[-1])
+        return off[1:] - off[:-1]
+
+    def segment_ids(self) -> Array:
+        """[N] int32: which (finest-level) sequence each row belongs to."""
+        off = jnp.asarray(self.lod[-1])
+        n = self.data.shape[0]
+        return jnp.searchsorted(off, jnp.arange(n), side="right") - 1
+
+
+def lod_from_lengths(lengths: Sequence[int]) -> Array:
+    return jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(jnp.asarray(lengths, jnp.int32))]
+    )
+
+
+def to_padded(t: LoDTensor, max_len: int) -> Tuple[Array, Array]:
+    """packed → ([B, T, ...] padded, [B] lengths); max_len static."""
+    off = jnp.asarray(t.lod[-1])
+    lengths = off[1:] - off[:-1]
+    b = len(off) - 1
+    idx = off[:-1, None] + jnp.arange(max_len)[None, :]
+    idx = jnp.minimum(idx, t.data.shape[0] - 1)
+    padded = t.data[idx.reshape(-1)].reshape((b, max_len) + t.data.shape[1:])
+    mask = jnp.arange(max_len)[None, :] < lengths[:, None]
+    padded = padded * mask.reshape(mask.shape + (1,) * (padded.ndim - 2)).astype(
+        padded.dtype
+    )
+    return padded, lengths.astype(jnp.int32)
+
+
+def from_padded(padded: np.ndarray, lengths: np.ndarray) -> LoDTensor:
+    """host-side: padded [B, T, ...] + lengths → packed LoDTensor."""
+    rows = [np.asarray(padded)[i, : int(l)] for i, l in enumerate(np.asarray(lengths))]
+    data = np.concatenate(rows, 0) if rows else np.zeros((0,) + padded.shape[2:])
+    return LoDTensor(jnp.asarray(data), (lod_from_lengths([len(r) for r in rows]),))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class SelectedRows:
+    """Row-sparse value (selected_rows.h): `value[i]` belongs to row
+    `rows[i]` of a dense [height, D] tensor. Duplicated rows allowed
+    (grad accumulation is a scatter-add)."""
+
+    rows: Array  # [K] int32
+    value: Array  # [K, D]
+    height: int
+
+    def tree_flatten(self):
+        return (self.rows, self.value), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, value = children
+        return cls(rows, value, height)
+
+    def to_dense(self) -> Array:
+        out = jnp.zeros((self.height,) + self.value.shape[1:], self.value.dtype)
+        return out.at[self.rows].add(self.value)

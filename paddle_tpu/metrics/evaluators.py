@@ -238,10 +238,12 @@ class ChunkEvaluator(Evaluator):
     """Chunk-level F1 for sequence labeling (ChunkEvaluator.cpp). Supports the
     same schemes: IOB/IOE/IOBES/plain with num_chunk_types."""
 
-    def __init__(self, scheme: str = "IOB", num_chunk_types: int = 1):
+    def __init__(self, scheme: str = "IOB", num_chunk_types: int = 1,
+                 excluded_chunk_types=()):
         assert scheme in ("IOB", "IOE", "IOBES", "plain")
         self.scheme = scheme
         self.num_chunk_types = num_chunk_types
+        self.excluded = set(excluded_chunk_types or ())
 
     def start(self):
         self.correct = 0
@@ -288,7 +290,8 @@ class ChunkEvaluator(Evaluator):
             if scheme == "IOE" and typ is not None and pos == 1:
                 chunks.append((start, i, cur_type))
                 start, cur_type = None, None
-        return set(chunks)
+        # chunk of these types are not counted (ModelConfig.proto:561)
+        return {c for c in chunks if c[2] not in self.excluded}
 
     def update(self, output=None, label=None, lengths=None, **kw):
         pred = np.asarray(output)
@@ -478,3 +481,80 @@ class DetectionMAPEvaluator(Evaluator):
                     prev_r = recall[k]
             aps.append(float(ap))
         return float(np.mean(aps)) if aps else 0.0
+
+
+@EVALUATORS.register("value_printer")
+class ValuePrinter(Evaluator):
+    """Utility evaluator (Evaluator.cpp ValuePrinter): logs layer outputs
+    each batch — the debugging role of the reference printer evaluators."""
+
+    def __init__(self, writer=None, **_kw):
+        import sys
+
+        self._write = writer or (lambda s: sys.stderr.write(s + "\n"))
+
+    def start(self):
+        self.batches = 0
+
+    def update(self, **kw):
+        self.batches += 1
+        for k, v in kw.items():
+            if v is None:
+                continue
+            arr = np.asarray(v)
+            with np.printoptions(threshold=64, precision=6):
+                self._write(f"[value_printer] {k}: shape={arr.shape} {arr}")
+
+    def finish(self):
+        return float(self.batches)
+
+
+@EVALUATORS.register("gradient_printer")
+class GradientPrinter(ValuePrinter):
+    """GradientPrinter declaration compatibility. Per-layer gradients never
+    leave the compiled step here (autodiff inside jit), so this prints the
+    forward value and says so — the config keeps parsing and running."""
+
+    def update(self, **kw):
+        self.batches += 1
+        for k, v in kw.items():
+            if v is None:
+                continue
+            arr = np.asarray(v)
+            with np.printoptions(threshold=64, precision=6):
+                self._write(
+                    f"[gradient_printer] {k} (forward value; grads stay "
+                    f"inside the compiled step): shape={arr.shape} {arr}"
+                )
+
+
+@EVALUATORS.register("max_id_printer")
+class MaxIdPrinter(ValuePrinter):
+    """utils max_id printer: top-k argmax ids of the output distribution."""
+
+    def __init__(self, num_results: int = 1, writer=None, **_kw):
+        super().__init__(writer)
+        self.k = max(1, int(num_results))
+
+    def update(self, output=None, **kw):
+        if output is None:
+            return
+        self.batches += 1
+        arr = np.asarray(output)
+        flat = arr.reshape(-1, arr.shape[-1])
+        top = np.argsort(-flat, axis=-1)[:, : self.k]
+        self._write(f"[max_id_printer] top{self.k} ids: {top[:8].tolist()}")
+
+
+@EVALUATORS.register("classification_error_printer")
+class ClassificationErrorPrinter(ValuePrinter):
+    """Prints the per-example 0/1 error vector (utils printer parity)."""
+
+    def update(self, output=None, label=None, **kw):
+        if output is None or label is None:
+            return
+        self.batches += 1
+        pred = np.asarray(output).reshape(-1, np.asarray(output).shape[-1]).argmax(-1)
+        lab = np.asarray(label).reshape(-1)
+        err = (pred != lab[: len(pred)]).astype(np.int32)
+        self._write(f"[classification_error_printer] err={err[:32].tolist()}")

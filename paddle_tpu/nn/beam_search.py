@@ -8,30 +8,16 @@ finished-beam masking — static shapes, one compiled program."""
 
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from paddle_tpu.nn.attention_layers import AttentionDecoder, DecoderParams
+from paddle_tpu.nn.beam_core import beam_search_scan
 from paddle_tpu.ops import linalg
 
 Array = jax.Array
-NEG_INF = -1e9
-
-
-class BeamState(NamedTuple):
-    tokens: Array  # [B, K] current tokens
-    scores: Array  # [B, K] cumulative log-probs
-    h: Array  # [B, K, H] decoder states
-    finished: Array  # [B, K] bool
-    history: Array  # [B, K, L] generated tokens
-
-
-def _gather_beams(x: Array, idx: Array) -> Array:
-    """x: [B, K, ...], idx: [B, K'] → [B, K', ...]."""
-    return jax.vmap(lambda xb, ib: xb[ib])(x, idx)
 
 
 def beam_search(
@@ -72,7 +58,6 @@ def beam_search(
     b, ts, de = enc_value.shape
     k = beam_size
     h0 = decoder.initial_state(dp, enc_value, enc_lengths)  # [B, H]
-    hdim = h0.shape[-1]
 
     # project once, then tile across beams → [B*K, ...] (projecting the tiled
     # array would redo the same matmul K times)
@@ -80,53 +65,24 @@ def beam_search(
     enc_t = jnp.repeat(enc_value, k, axis=0)
     enc_len_t = jnp.repeat(enc_lengths, k, axis=0)
     enc_proj_t = jnp.repeat(enc_proj, k, axis=0)
-
-    init = BeamState(
-        tokens=jnp.full((b, k), bos_id, jnp.int32),
-        # only beam 0 is live initially so the first expansion isn't k copies
-        scores=jnp.tile(
-            jnp.asarray([0.0] + [NEG_INF] * (k - 1), jnp.float32), (b, 1)
-        ),
-        h=jnp.repeat(h0[:, None, :], k, axis=1),
-        finished=jnp.zeros((b, k), bool),
-        history=jnp.zeros((b, k, max_len), jnp.int32),
-    )
-
     vocab = embed_table.shape[0]
 
-    def step(state: BeamState, t: Array):
-        emb_t = embed_table[state.tokens.reshape(-1)]  # [B*K, Demb]
-        h_flat = state.h.reshape(b * k, hdim)
+    def step_fn(tokens_flat: Array, h_flat: Array, t: Array):
+        emb_t = embed_table[tokens_flat]  # [B*K, Demb]
         h_new = decoder.step(dp, enc_t, enc_proj_t, enc_len_t, emb_t, h_flat)
         logits = linalg.matmul(h_new, w_out) + b_out
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        logp = logp.reshape(b, k, vocab)
-        # finished beams may only emit EOS with no score change
-        eos_only = jnp.full((vocab,), NEG_INF).at[eos_id].set(0.0)
-        logp = jnp.where(state.finished[:, :, None], eos_only[None, None, :], logp)
-        cand = state.scores[:, :, None] + logp  # [B, K, V]
-        flat = cand.reshape(b, k * vocab)
-        top_scores, top_idx = lax.top_k(flat, k)  # [B, K]
-        beam_idx = top_idx // vocab
-        tok_idx = (top_idx % vocab).astype(jnp.int32)
+        return logp, h_new
 
-        h_sel = _gather_beams(h_new.reshape(b, k, hdim), beam_idx)
-        fin_sel = _gather_beams(state.finished, beam_idx)
-        hist_sel = _gather_beams(state.history, beam_idx)
-        hist_new = lax.dynamic_update_index_in_dim(
-            hist_sel.swapaxes(0, 2), tok_idx.swapaxes(0, 1), t, 0
-        ).swapaxes(0, 2)
-        new_finished = fin_sel | (tok_idx == eos_id)
-        return (
-            BeamState(tok_idx, top_scores, h_sel, new_finished, hist_new),
-            None,
-        )
-
-    final, _ = lax.scan(step, init, jnp.arange(max_len))
-
-    scores = final.scores
-    if length_penalty > 0:
-        lengths = jnp.sum((final.history != eos_id).astype(jnp.float32), axis=-1) + 1.0
-        scores = scores / jnp.power(lengths, length_penalty)
-    order = jnp.argsort(-scores, axis=-1)
-    return _gather_beams(final.history, order), jnp.take_along_axis(scores, order, -1)
+    res = beam_search_scan(
+        step_fn,
+        jnp.repeat(h0, k, axis=0),
+        batch=b,
+        vocab=vocab,
+        bos_id=bos_id,
+        eos_id=eos_id,
+        beam_size=k,
+        max_len=max_len,
+        length_penalty=length_penalty,
+    )
+    return res.history, res.scores

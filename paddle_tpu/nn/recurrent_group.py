@@ -481,66 +481,37 @@ class BeamSearchLayer(Layer):
         vocab = core.generated.size
         prob_layer = core.out_layers[0].name
 
-        tokens0 = jnp.full((batch, k), self.bos_id, jnp.int32)
-        scores0 = jnp.tile(
-            jnp.asarray([0.0] + [NEG_INF] * (k - 1), jnp.float32), (batch, 1)
-        )
-        finished0 = jnp.zeros((batch, k), bool)
-        history0 = jnp.zeros((batch, k, L), jnp.int32)
-
-        def gather_beams(x: Array, idx: Array) -> Array:
-            xb = x.reshape((batch, k) + x.shape[1:])
-            sel = jax.vmap(lambda xx, ii: xx[ii])(xb, idx)
-            return sel.reshape((batch * k,) + x.shape[1:])
-
-        def body(state, t):
-            tokens, scores, finished, history, carry = state
+        def step_fn(tokens_flat, carry, t):
             seeded = dict(static_tiled)
             seeded[core.gen_placeholder.name] = Argument(
-                self._embed(ctx, tokens.reshape(-1))
+                self._embed(ctx, tokens_flat)
             )
             for m in core.memories:
                 seeded[m.name] = Argument(carry[m.name])
             values = _eval_subnet(core.order, ctx, seeded)
-            probs = values[prob_layer].value.reshape(batch, k, vocab)
+            probs = values[prob_layer].value
             logp = jnp.log(jnp.maximum(probs.astype(jnp.float32), 1e-20))
-            eos_only = jnp.full((vocab,), NEG_INF).at[self.eos_id].set(0.0)
-            logp = jnp.where(finished[:, :, None], eos_only[None, None, :], logp)
-            cand = (scores[:, :, None] + logp).reshape(batch, k * vocab)
-            top_scores, top_idx = lax.top_k(cand, k)
-            beam_idx = top_idx // vocab
-            tok_idx = (top_idx % vocab).astype(jnp.int32)
+            new_carry = {
+                m.name: values[core.links[m.name].name].value
+                for m in core.memories
+            }
+            return logp, new_carry
 
-            new_carry = {}
-            for m in core.memories:
-                nxt = values[core.links[m.name].name].value
-                new_carry[m.name] = gather_beams(nxt, beam_idx)
-            fin_sel = jax.vmap(lambda f, i: f[i])(finished, beam_idx)
-            hist_sel = jax.vmap(lambda h, i: h[i])(history, beam_idx)
-            hist_new = lax.dynamic_update_index_in_dim(
-                hist_sel.swapaxes(0, 2), tok_idx.swapaxes(0, 1), t, 0
-            ).swapaxes(0, 2)
-            new_finished = fin_sel | (tok_idx == self.eos_id)
-            return (
-                (tok_idx, top_scores, new_finished, hist_new, new_carry),
-                None,
-            )
+        from paddle_tpu.nn.beam_core import beam_search_scan
 
         keys0 = set(ctx.state_updates)
-        (tokens, scores, finished, history, _), _ = lax.scan(
-            body, (tokens0, scores0, finished0, history0, carry_t), jnp.arange(L)
+        res = beam_search_scan(
+            step_fn, carry_t, batch=batch, vocab=vocab, bos_id=self.bos_id,
+            eos_id=self.eos_id, beam_size=k, max_len=L,
         )
         for kk in list(ctx.state_updates):
             if kk not in keys0:
                 del ctx.state_updates[kk]
 
-        best = jnp.argmax(scores, axis=-1)
-        ids = jax.vmap(lambda h, i: h[i])(history, best)  # [B, L]
-        is_eos = ids == self.eos_id
-        any_eos = jnp.any(is_eos, axis=-1)
-        first_eos = jnp.argmax(is_eos.astype(jnp.int32), axis=-1)
-        lengths = jnp.where(any_eos, first_eos + 1, L).astype(jnp.int32)
-        ctx.cache[(id(core), "beam_scores")] = scores
+        # beams arrive sorted best-first from the shared engine
+        ids = res.history[:, 0]
+        lengths = res.lengths[:, 0]
+        ctx.cache[(id(core), "beam_scores")] = res.scores
         return Argument(ids, lengths)
 
 

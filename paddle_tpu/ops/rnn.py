@@ -21,6 +21,7 @@ GruCompute.cu. Optional peephole ("check") weights as in the reference."""
 
 from __future__ import annotations
 
+import os
 from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -33,10 +34,18 @@ from paddle_tpu.ops import linalg
 Array = jax.Array
 
 
-def _use_fused(standard_config: bool) -> bool:
+def _use_fused(standard_config: bool, bh: int = 0) -> bool:
     """Route to the pallas whole-sequence kernel when on TPU (or forced) and
-    the layer uses the reference-default activations (no peepholes)."""
+    the layer uses the reference-default activations (no peepholes).
+
+    `bh` = batch*hidden of the carry: the kernel keeps per-step blocks
+    resident in VMEM, and past ~100k carry elements the *backward* kernel's
+    scoped-VMEM stack exceeds the 16 MB limit (measured: 256×512 GRU bwd
+    wants 16.21M) — fall back to the lax.scan path there."""
     if not standard_config:
+        return False
+    limit = int(os.environ.get("PADDLE_TPU_FUSED_RNN_MAX_BH", "100000"))
+    if bh > limit:
         return False
     from paddle_tpu.ops import pallas as pal
 
@@ -116,7 +125,8 @@ def lstm_scan(
 
     if _use_fused(
         gate_act == "sigmoid" and cell_act == "tanh" and state_act == "tanh"
-        and p.check_i is None and p.check_f is None and p.check_o is None
+        and p.check_i is None and p.check_f is None and p.check_o is None,
+        bh=b * hdim,
     ):
         from paddle_tpu.ops.pallas.rnn_kernels import lstm_seq_fused
 
@@ -178,7 +188,7 @@ def gru_scan(
     hdim = h3 // 3
     h0 = h0 if h0 is not None else jnp.zeros((b, hdim), proj.dtype)
 
-    if _use_fused(gate_act == "sigmoid" and cand_act == "tanh"):
+    if _use_fused(gate_act == "sigmoid" and cand_act == "tanh", bh=b * hdim):
         from paddle_tpu.ops.pallas.rnn_kernels import gru_seq_fused
 
         return _run_fused(

@@ -154,6 +154,24 @@ class EvaluatorConfig:
     name: str = ""
     type: str = ""
     input_layers: List[str] = field(default_factory=list)
+    # ChunkEvaluator (ModelConfig.proto:537-540, :561)
+    chunk_scheme: str = ""
+    num_chunk_types: int = 0
+    excluded_chunk_types: List[int] = field(default_factory=list)
+    # PrecisionRecall / ClassificationError (:543-546, :566)
+    classification_threshold: float = 0.5
+    positive_label: int = -1
+    top_k: int = 1
+    # printers (:548-557)
+    dict_file: str = ""
+    result_file: str = ""
+    num_results: int = 1
+    delimited: bool = True
+    # DetectionMAP (:568-574)
+    overlap_threshold: float = 0.5
+    background_id: int = 0
+    evaluate_difficult: bool = False
+    ap_type: str = "11point"
 
 
 @dataclass

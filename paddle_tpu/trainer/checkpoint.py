@@ -73,10 +73,19 @@ def save_pass(
     states: Optional[Dict[str, Any]] = None,
     opt_state: Optional[Any] = None,
     extra_meta: Optional[Dict[str, Any]] = None,
+    v1_binary: bool = False,
 ) -> str:
-    """Write save_dir/pass-%05d/{params,states,opt}.npz + manifest.json."""
+    """Write save_dir/pass-%05d/{params,states,opt}.npz + manifest.json.
+
+    v1_binary=True additionally writes each parameter as a reference-format
+    `Parameter::save` file in the pass dir (ParamUtil layout — SURVEY §7
+    step 8 model interchange; see trainer/v1_format.py)."""
     pdir = os.path.join(save_dir, f"pass-{pass_id:05d}")
     os.makedirs(pdir, exist_ok=True)
+    if v1_binary:
+        from paddle_tpu.trainer import v1_format
+
+        v1_format.save_model_dir(pdir, _to_numpy_tree(params))
     manifest: Dict[str, Any] = {"pass_id": pass_id, "files": {}, "version": 1}
     if extra_meta:
         manifest["extra"] = extra_meta

@@ -1,0 +1,75 @@
+"""Alternating multi-network training — the GAN demo class.
+
+Parity: paddle/gserver/gradientmachines/MultiNetwork.cpp +
+GradientMachineMode.h + the v1_api_demo/gan host loop (gan_trainer.py):
+two gradient machines built from configs that share parameter NAMES, where
+each phase marks the other side's parameters `is_static` (frozen), and the
+host copies shared parameters between machines every iteration
+(copy_shared_parameters).
+
+TPU-native shape: each phase is its own SGDTrainer (whole phase step = one
+compiled program; frozen params ride through untouched because the optimizer
+honors ParamAttr.is_static). Sharing is by parameter name, exactly the v1
+convention — after a phase step the updated values are copied into the other
+phases' states, device-to-device."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from paddle_tpu.trainer.trainer import SGDTrainer
+
+
+class MultiNetworkTrainer:
+    """Coordinate named SGDTrainers whose networks share parameters by name.
+
+    Usage (the gan_conf.py pattern):
+        mt = MultiNetworkTrainer({"dis": dis_trainer, "gen": gen_trainer})
+        mt.init_state({"dis": dis_batch, "gen": gen_batch})
+        cost = mt.step("dis", dis_batch)   # trains dis_*, syncs shared params
+        cost = mt.step("gen", gen_batch)   # trains gen_*, syncs shared params
+    """
+
+    def __init__(self, trainers: Dict[str, SGDTrainer]):
+        assert trainers, "need at least one named trainer"
+        self.trainers = dict(trainers)
+        self._steps: Dict[str, Any] = {}
+
+    def init_state(self, sample_batches: Dict[str, Any]) -> None:
+        for name, tr in self.trainers.items():
+            tr.init_state(sample_batches[name])
+        # start from ONE consistent copy of every shared parameter: first
+        # trainer that owns a name wins (the demo copies gen->dis at start)
+        seen: Dict[str, Any] = {}
+        for tr in self.trainers.values():
+            for k, v in tr.state["params"].items():
+                if k in seen:
+                    tr.state["params"][k] = seen[k]
+                else:
+                    seen[k] = v
+
+    def sync_shared(self, src: str) -> None:
+        """copy_shared_parameters: push src's current values into every other
+        trainer state holding a same-named parameter."""
+        src_params = self.trainers[src].state["params"]
+        for name, tr in self.trainers.items():
+            if name == src:
+                continue
+            tgt = tr.state["params"]
+            for k in tgt:
+                if k in src_params:
+                    tgt[k] = src_params[k]
+
+    def step(self, phase: str, batch: Any, sync: bool = True):
+        """One train step of `phase`'s network, then propagate its updated
+        shared parameters to the other phases. Returns the phase cost."""
+        tr = self.trainers[phase]
+        if phase not in self._steps:
+            self._steps[phase] = tr._make_step()
+        tr.state, cost, extras = self._steps[phase](tr.state, batch)
+        if sync:
+            self.sync_shared(phase)
+        return cost
+
+    def state_of(self, phase: str):
+        return self.trainers[phase].state

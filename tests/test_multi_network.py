@@ -1,0 +1,100 @@
+"""GAN-style alternating training (MultiNetwork.cpp / v1_api_demo/gan):
+two networks share parameters by name, each phase freezes the other side via
+ParamAttr(is_static=True), shared values sync between phase steps."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.graph import Network, ParamAttr, reset_name_scope
+from paddle_tpu.optim import Adam
+from paddle_tpu.trainer import SGDTrainer
+from paddle_tpu.trainer.multi_network import MultiNetworkTrainer
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    reset_name_scope()
+
+
+def _discriminator(sample, static: bool):
+    pa = lambda n: ParamAttr(name=n, is_static=static)
+    h = L.Fc(sample, 16, act="relu", param_attr=pa("dis_w1"),
+             bias_attr=pa("dis_b1"), name=f"dish_{static}")
+    return L.Fc(h, 2, act="softmax", param_attr=pa("dis_w2"),
+                bias_attr=pa("dis_b2"), name=f"diso_{static}")
+
+
+def _generator(noise, static: bool):
+    pa = lambda n: ParamAttr(name=n, is_static=static)
+    h = L.Fc(noise, 16, act="relu", param_attr=pa("gen_w1"),
+             bias_attr=pa("gen_b1"), name=f"genh_{static}")
+    return L.Fc(h, 2, act=None, param_attr=pa("gen_w2"),
+                bias_attr=pa("gen_b2"), name=f"geno_{static}")
+
+
+def test_gan_alternating_training_converges():
+    rs = np.random.RandomState(0)
+    data_mean = np.asarray([2.0, -1.0], np.float32)
+    bs = 64
+
+    # discriminator phase: real+fake samples fed as data, gen frozen N/A
+    d_sample = L.Data("sample", shape=(2,))
+    d_label = L.Data("label", shape=())
+    d_out = _discriminator(d_sample, static=False)
+    d_cost = C.ClassificationCost(d_out, d_label, name="d_cost")
+    dis_tr = SGDTrainer(d_cost, Adam(learning_rate=1e-2))
+
+    # generator phase: noise -> G (trainable) -> D (static) scored as "real"
+    g_noise = L.Data("noise", shape=(4,))
+    g_label = L.Data("label", shape=())
+    g_sample = _generator(g_noise, static=False)
+    g_out = _discriminator(g_sample, static=True)
+    g_cost = C.ClassificationCost(g_out, g_label, name="g_cost")
+    gen_tr = SGDTrainer(g_cost, Adam(learning_rate=1e-2))
+
+    gen_net = Network(g_sample)
+
+    def real_batch():
+        return data_mean + rs.randn(bs, 2).astype(np.float32) * 0.3
+
+    def noise_batch():
+        return rs.randn(bs, 4).astype(np.float32)
+
+    mt = MultiNetworkTrainer({"dis": dis_tr, "gen": gen_tr})
+    mt.init_state({
+        "dis": {"sample": real_batch(), "label": np.ones(bs, np.int64)},
+        "gen": {"noise": noise_batch(), "label": np.ones(bs, np.int64)},
+    })
+
+    def gen_samples(n=256):
+        params = mt.state_of("gen")["params"]
+        outs, _ = gen_net.apply(params, mt.state_of("gen")["states"],
+                                {"noise": rs.randn(n, 4).astype(np.float32)})
+        return np.asarray(outs[g_sample.name].value)
+
+    before = np.linalg.norm(gen_samples().mean(0) - data_mean)
+
+    for it in range(400):
+        fake = gen_samples(bs)
+        samples = np.concatenate([real_batch(), fake], 0)
+        labels = np.concatenate([np.ones(bs), np.zeros(bs)]).astype(np.int64)
+        mt.step("dis", {"sample": samples, "label": labels})
+        mt.step("gen", {"noise": noise_batch(),
+                        "label": np.ones(bs, np.int64)})
+
+    after = np.linalg.norm(gen_samples().mean(0) - data_mean)
+    assert after < before * 0.5, (before, after)
+
+    # frozen copies really stayed in sync: dis params identical across phases
+    for k in ("dis_w1", "dis_w2"):
+        np.testing.assert_array_equal(
+            np.asarray(mt.state_of("dis")["params"][k]),
+            np.asarray(mt.state_of("gen")["params"][k]),
+        )
+    # and the generator's params never moved inside the dis phase state
+    assert "gen_w1" not in mt.state_of("dis")["params"]

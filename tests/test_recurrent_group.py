@@ -169,3 +169,38 @@ def test_beam_search_generates_and_respects_eos():
     for i in range(2):
         if lens[i] < 7:  # ended on EOS
             assert ids[i, lens[i] - 1] == 1
+
+
+def test_beam_engine_hand_checkable():
+    """nn/beam_core.py beam_search_scan on a fixed-logits toy: beams and
+    scores must match hand-computed expansion (the single engine both
+    generation entry points wrap)."""
+    import jax.numpy as jnp
+    from paddle_tpu.nn.beam_core import beam_search_scan
+
+    # vocab 4, eos=3. Step logp depends only on the current token.
+    table = np.log(np.asarray([
+        [0.1, 0.6, 0.2, 0.1],   # after token 0
+        [0.05, 0.05, 0.5, 0.4], # after token 1
+        [0.3, 0.3, 0.1, 0.3],   # after token 2
+        [0.25, 0.25, 0.25, 0.25],
+    ], np.float32))
+
+    def step_fn(tokens, carry, t):
+        return jnp.asarray(table)[tokens], carry
+
+    res = beam_search_scan(
+        step_fn, carry0=jnp.zeros((2 * 2, 1)), batch=2, vocab=4, bos_id=0,
+        eos_id=3, beam_size=2, max_len=2,
+    )
+    # t=0 from bos(0): top2 = tok1 (0.6), tok2 (0.2)
+    # t=1: from tok1: tok2 (0.6*0.5=0.30), tok3 (0.6*0.4=0.24);
+    #      from tok2: tok0/1/3 (0.2*0.3=0.06) → top2 = [1,2](0.30), [1,3](0.24)
+    hist = np.asarray(res.history)
+    scores = np.exp(np.asarray(res.scores))
+    np.testing.assert_array_equal(hist[0, 0], [1, 2])
+    np.testing.assert_array_equal(hist[0, 1], [1, 3])
+    np.testing.assert_allclose(scores[0], [0.30, 0.24], rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(res.lengths)[0], [2, 2])
+    # batch row 1 identical (same dynamics)
+    np.testing.assert_array_equal(hist[1], hist[0])
