@@ -70,9 +70,12 @@ def merge_model_v1(
 ) -> str:
     """Reference-format merged model (MergeModel.cpp byte layout): int64
     config length + serialized TrainerConfig + every parameter written with
-    its `Parameter::Header` in topological parameter order. The config is our
-    protobuf-text rendering (the reference writes binary proto; the framing
-    and parameter bytes are format-identical)."""
+    its `Parameter::Header` in **config declaration order** — the stream has
+    no per-parameter names, so a reference consumer binds bytes to parameters
+    positionally (MergeModel.cpp iterates para_names() in config order).
+    Caveat: the config header here is our protobuf-*text* rendering, so the
+    reference binary cannot parse the header itself; the framing and the
+    parameter byte layout are format-identical."""
     from paddle_tpu import proto
     from paddle_tpu.config import parse_config
     from paddle_tpu.trainer import checkpoint as ckpt
@@ -86,8 +89,21 @@ def merge_model_v1(
         params, _states, _opt, _m = ckpt.load_pass(parent, int(leaf.split("-")[1]))
 
     config_bytes = proto.to_text(pc.trainer_config).encode()
+    # positional binding: emit in the config's parameter declaration order,
+    # then any params unknown to the config (sorted, for determinism). A
+    # declared parameter missing from the checkpoint would silently shift
+    # every later binding — fail at merge time instead.
+    declared = [p.name for p in pc.trainer_config.model_config.parameters]
+    missing = [n for n in declared if n not in params]
+    if missing:
+        raise ValueError(
+            f"merge_model_v1: config declares parameters {missing} that are "
+            "not in the checkpoint — positional binding would corrupt every "
+            "parameter after the first missing one"
+        )
+    order = declared + sorted(set(params) - set(declared))
     tmp = output_path + ".tmp"
     with open(tmp, "wb") as f:
-        v1_format.write_merged(f, config_bytes, params, order=sorted(params))
+        v1_format.write_merged(f, config_bytes, params, order=order)
     os.replace(tmp, output_path)
     return output_path

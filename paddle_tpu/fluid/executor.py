@@ -209,24 +209,37 @@ class Executor:
             local = self._run_ops(sub, dict(base), ctx)
             return [local[n] for n in out_names]
 
+        if false_b is None:
+            missing = [n for n in out_names if n not in base]
+            if missing:
+                raise KeyError(
+                    f"cond op: outputs {missing} are not defined outside the "
+                    "true block, so there is no passthrough value for the "
+                    "false branch — provide a false_block"
+                )
+
+        def run_false():
+            if false_b is not None:
+                return run_block(false_b)
+            # passthrough branch: both lax.cond branches must return
+            # identical avals, so align the outer values to the true
+            # branch's output shapes/dtypes
+            t_avals = jax.eval_shape(lambda: run_block(true_b))
+            return [
+                jnp.broadcast_to(jnp.asarray(base[n]), av.shape).astype(av.dtype)
+                for n, av in zip(out_names, t_avals)
+            ]
+
         cond_arr = jnp.asarray(cond)
         if cond_arr.ndim == 0 or cond_arr.size == 1:
             outs = jax.lax.cond(
                 cond_arr.reshape(()).astype(bool),
                 lambda: run_block(true_b),
-                lambda: (
-                    run_block(false_b)
-                    if false_b is not None
-                    else [values[n] for n in out_names]
-                ),
+                run_false,
             )
         else:
             t_outs = run_block(true_b)
-            f_outs = (
-                run_block(false_b)
-                if false_b is not None
-                else [values[n] for n in out_names]
-            )
+            f_outs = run_false()
             mask = cond_arr.reshape(-1).astype(bool)
             outs = [
                 jnp.where(mask.reshape((-1,) + (1,) * (t.ndim - 1)), t, f)

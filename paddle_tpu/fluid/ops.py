@@ -586,7 +586,6 @@ def _pool_with_index(ctx, ins, attrs):
     arg = flat.argmax(-1)
     out = jnp.take_along_axis(flat, arg[..., None], -1)[..., 0]
     ki, kj = arg // ks[1], arg % ks[1]
-    gi = ii[:, 0, :, 0][None, None][..., 0][..., None, None]  # broadcast helper
     rows = (jnp.arange(oh) * st[0])[None, None, :, None] + ki
     cols = (jnp.arange(ow) * st[1])[None, None, None, :] + kj
     return {"Out": out, "Mask": rows * w + cols}
@@ -728,10 +727,12 @@ def _lstm(ctx, ins, attrs):
         else jnp.ones(proj.shape[:2])
     ).astype(proj.dtype)
     p = rnn_ops.LstmParams(w_hh=w, bias=b if b is not None else jnp.zeros((4 * hdim,)))
-    hs, h_last, c_last = rnn_ops.lstm_scan(
-        proj, mask, p, reverse=attrs.get("is_reverse", False)
+    # the reference lstm_op emits the FULL cell-state sequence in 'Cell'
+    # (lstm_op.cc BatchCellPreAct/Cell outputs) — return_cell_seq collects it
+    hs, cs, h_last = rnn_ops.lstm_scan(
+        proj, mask, p, reverse=attrs.get("is_reverse", False), return_cell_seq=True
     )
-    return {"Hidden": hs, "Cell": c_last, "LastH": h_last}
+    return {"Hidden": hs, "Cell": cs, "LastH": h_last}
 
 
 @op("conv2d_transpose")
@@ -975,3 +976,142 @@ def _precision_recall(ctx, ins, attrs):
     macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
     return {"BatchMetrics": jnp.concatenate([macro, prec, rec, f1]),
             "AccumStatesInfo": jnp.stack([tp, fp, fn], 1)}
+
+
+# -- IO ops (feed_op.cc / fetch_op.cc / save_op / load_op) -------------------
+# The reference's executor prepends feed ops reading a FeedHolder vector and
+# appends fetch ops writing a FetchHolder; save/load stream a single variable
+# to/from disk on the host. Here feed/fetch move values between a python-list
+# holder and program vars (the jit path passes the holder contents as traced
+# args), and save/load do host IO — under tracing, `save` routes through
+# io_callback and `load` materializes the file at trace time (it becomes a
+# compile-time constant, the TPU-native reading of "load once at startup").
+
+
+@op("feed")
+def _feed(ctx, ins, attrs):
+    holder = _one(ins, "X")  # python list (FeedHolder role)
+    return {"Out": holder[attrs.get("col", 0)]}
+
+
+@op("fetch")
+def _fetch(ctx, ins, attrs):
+    x = _one(ins, "X")
+    holder = _one(ins, "Holder")
+    if isinstance(holder, list):  # FetchHolder role, eager path
+        col = attrs.get("col", 0)
+        while len(holder) <= col:
+            holder.append(None)
+        holder[col] = x
+    return {"Out": x}
+
+
+@op("save")
+def _save(ctx, ins, attrs):
+    import os
+
+    x = _one(ins, "X")
+    path = attrs["file_path"]
+    # np.save appends '.npy' when the path lacks it — guard the on-disk name
+    disk_path = path if path.endswith(".npy") else path + ".npy"
+    if not attrs.get("overwrite", True) and os.path.exists(disk_path):
+        raise RuntimeError(f"save op: {disk_path} exists and overwrite=False")
+
+    def host_save(arr):
+        # re-check at execution time: under the cached-jit path the trace-time
+        # check above runs once against pre-run state only
+        if not attrs.get("overwrite", True) and os.path.exists(disk_path):
+            raise RuntimeError(f"save op: {disk_path} exists and overwrite=False")
+        np.save(path, np.asarray(arr))
+        return np.zeros((), np.int32)
+
+    if isinstance(x, jax.core.Tracer):
+        from jax.experimental import io_callback
+
+        done = io_callback(host_save, jax.ShapeDtypeStruct((), jnp.int32), x)
+    else:
+        done = host_save(x)
+    return {"Out": done}
+
+
+@op("load")
+def _load(ctx, ins, attrs):
+    path = attrs["file_path"]
+    if not path.endswith(".npy"):
+        path = path + ".npy"
+    return {"Out": jnp.asarray(np.load(path))}
+
+
+# -- beam search ops (beam_search_op.cc / beam_search_decode_op.cc) ----------
+# Dense-tensor redesign of the reference's LoD-based beams: a fixed beam
+# width K per source sentence, so every step is a static [B, K*V] top-k on
+# device (beam_search_op.cc walks candidate lists on the host per step).
+
+
+@op("beam_search")
+def _beam_search(ctx, ins, attrs):
+    """One expansion step. ins: pre_ids [B*K,1], pre_scores [B*K,1],
+    scores [B*K,V] — accumulated log-probs when is_accumulated (the
+    reference's default, beam_search_op.cc), else per-step probabilities
+    that get log()ed and added to pre_scores here. outs:
+    selected_ids/selected_scores [B*K,1], parent_idx [B*K] (absolute row
+    into the pre-beam). Expansion + finished-EOS masking delegate to
+    nn/beam_core.expand_beams — the single beam engine."""
+    from paddle_tpu.nn.beam_core import expand_beams
+
+    k = attrs["beam_size"]
+    end_id = attrs.get("end_id", 1)
+    pre_ids = _one(ins, "pre_ids").reshape(-1)
+    pre_scores = _one(ins, "pre_scores").reshape(-1).astype(jnp.float32)
+    scores = _one(ins, "scores")
+    bk, v = scores.shape
+    b = bk // k
+    logp = (
+        scores.astype(jnp.float32)
+        if attrs.get("is_accumulated", True)
+        else jnp.log(jnp.maximum(scores.astype(jnp.float32), 1e-20))
+        + pre_scores[:, None]
+    )
+    top_scores, beam_idx, tok = expand_beams(
+        logp.reshape(b, k, v),
+        pre_scores.reshape(b, k),
+        (pre_ids == end_id).reshape(b, k),
+        end_id,
+        k,
+    )
+    parent = (beam_idx + jnp.arange(b)[:, None] * k).reshape(-1)
+    return {
+        "selected_ids": tok.reshape(-1, 1),
+        "selected_scores": top_scores.reshape(-1, 1),
+        "parent_idx": parent,
+    }
+
+
+@op("beam_search_decode")
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack per-step selections into whole sequences. ins: Ids [T, B*K]
+    (or [T, B*K, 1]), ParentIdx [T, B*K] absolute rows, Scores [B*K] final
+    accumulated scores. outs: SentenceIds [B, K, T] (end_id-padded),
+    SentenceScores [B, K]."""
+    k = attrs["beam_size"]
+    ids = _one(ins, "Ids")
+    parents = _one(ins, "ParentIdx")
+    scores = _one(ins, "Scores").reshape(-1)
+    ids = ids.reshape(ids.shape[0], -1)  # [T, B*K]
+    parents = parents.reshape(parents.shape[0], -1)
+    t, bk = ids.shape
+    b = bk // k
+
+    def back(ptr, step):
+        id_t, par_t = step
+        tok = id_t[ptr]
+        ptr_new = par_t[ptr]
+        return ptr_new, tok
+
+    ptr0 = jnp.arange(bk)
+    _, toks = jax.lax.scan(back, ptr0, (ids[::-1], parents[::-1]))
+    seq = toks[::-1].T  # [B*K, T]
+    return {
+        "SentenceIds": seq.reshape(b, k, t),
+        "SentenceScores": scores.reshape(b, k),
+    }

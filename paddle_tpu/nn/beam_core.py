@@ -25,6 +25,28 @@ class BeamResult(NamedTuple):
     lengths: Array  # [B, K] lengths up to and including EOS
 
 
+def expand_beams(
+    cand_logp: Array,  # [B, K, V] TOTAL candidate scores for live beams
+    pre_scores: Array,  # [B, K] accumulated scores of the incoming beams
+    finished: Array,  # [B, K] bool
+    eos_id: int,
+    k: int,
+) -> Tuple[Array, Array, Array]:
+    """One beam expansion — THE top-k + finished-EOS-masking step, shared by
+    the generation scan below and the fluid `beam_search` op (one masking
+    semantic, one NEG_INF convention). Finished beams propagate EOS at their
+    unchanged score. Returns (top_scores [B,k], beam_idx [B,k], tok [B,k])."""
+    b, _kk, v = cand_logp.shape
+    eos_only = jnp.full((v,), NEG_INF).at[eos_id].set(0.0)
+    cand = jnp.where(
+        finished[:, :, None],
+        pre_scores[:, :, None] + eos_only[None, None, :],
+        cand_logp,
+    )
+    top_scores, top_idx = lax.top_k(cand.reshape(b, -1), k)
+    return top_scores, top_idx // v, (top_idx % v).astype(jnp.int32)
+
+
 def _gather_beams(tree: Any, idx: Array, batch: int, k: int) -> Any:
     """Select beams: every leaf [B*K, ...] (or [B, K, ...]) reindexed by
     idx [B, K']."""
@@ -62,17 +84,14 @@ def beam_search_scan(
     )
     finished0 = jnp.zeros((batch, k), bool)
     history0 = jnp.zeros((batch, k, max_len), jnp.int32)
-    eos_only = jnp.full((vocab,), NEG_INF).at[eos_id].set(0.0)
 
     def body(state, t):
         tokens, scores, finished, history, carry = state
         logp, new_carry = step_fn(tokens.reshape(-1), carry, t)
         logp = logp.reshape(batch, k, vocab).astype(jnp.float32)
-        logp = jnp.where(finished[:, :, None], eos_only[None, None, :], logp)
-        cand = (scores[:, :, None] + logp).reshape(batch, k * vocab)
-        top_scores, top_idx = lax.top_k(cand, k)
-        beam_idx = top_idx // vocab
-        tok_idx = (top_idx % vocab).astype(jnp.int32)
+        top_scores, beam_idx, tok_idx = expand_beams(
+            scores[:, :, None] + logp, scores, finished, eos_id, k
+        )
 
         carry_sel = _gather_beams(new_carry, beam_idx, batch, k)
         fin_sel = jax.vmap(lambda f, i: f[i])(finished, beam_idx)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from paddle_tpu.core.registry import LAYERS
 from paddle_tpu.nn.graph import Argument, Context, Layer
 from paddle_tpu.ops import sequence as seq_ops
+from paddle_tpu.ops import xent as xent_ops
 
 Array = jax.Array
 
@@ -77,11 +78,13 @@ class ClassificationCost(CostLayer):
         self.from_logits = from_logits
 
     def per_example(self, ctx, pred, label):
-        if self.from_logits:
-            logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
-        else:
-            logp = jnp.log(jnp.maximum(pred.astype(jnp.float32), 1e-10))
         label = label.astype(jnp.int32).reshape(-1)
+        if self.from_logits:
+            # fused big-vocab path: all [N, V] tensors stay in pred's dtype,
+            # reductions in f32 (ops/xent.py — r3 profile showed the f32
+            # log_softmax dominating the NMT step's bandwidth)
+            return xent_ops.softmax_xent_with_logits(pred, label)
+        logp = jnp.log(jnp.maximum(pred.astype(jnp.float32), 1e-10))
         return -jnp.take_along_axis(logp, label[:, None], axis=-1)[:, 0]
 
 

@@ -49,12 +49,14 @@ def _bn_fwd_impl(x, gamma, beta, eps):
     mean = s1 / n
     var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
     inv = jax.lax.rsqrt(var + eps)
-    # scale/shift folded to per-channel a,b so the apply pass is one fma
-    a = (gamma.astype(jnp.float32) * inv).astype(x.dtype)
-    b = (beta.astype(jnp.float32) - gamma.astype(jnp.float32) * inv * mean).astype(
-        x.dtype
-    )
-    y = x * a + b
+    # scale/shift folded to per-channel a,b so the apply pass is one fma.
+    # a/b stay f32 (they are [C]-sized — free) and the normalize arithmetic
+    # runs f32 with ONE cast on the output: with bf16 activations and large
+    # beta/mean magnitudes, doing the fma in bf16 loses mantissa (ADVICE r3);
+    # XLA fuses the converts into the elementwise pass either way.
+    a = gamma.astype(jnp.float32) * inv
+    b = beta.astype(jnp.float32) - gamma.astype(jnp.float32) * inv * mean
+    y = (xf * a + b).astype(x.dtype)
     return y, mean, var
 
 
@@ -78,13 +80,14 @@ def _bn_bwd(eps, res, cts):
     dgx = jnp.sum(dyf * xf, axis=axes)
     # sum(dy * xhat) = inv * (sum(dy*x) - mean*sum(dy))
     dgamma = inv * (dgx - mean * dbeta)
-    # dx = gamma*inv/n * (n*dy - dbeta - xhat*dgamma)
+    # dx = gamma*inv/n * (n*dy - dbeta - xhat*dgamma). Per-channel constants
+    # stay f32 like the forward's a/b (same mantissa-loss argument): the fma
+    # runs f32 with one cast on the output, XLA fuses the converts.
     gi = gamma.astype(jnp.float32) * inv
-    c1 = (gi).astype(x.dtype)
-    c2 = (gi * (dbeta + mean * inv * -dgamma) / -n).astype(x.dtype)  # constant term
+    c2 = gi * (dbeta + mean * inv * -dgamma) / -n  # constant term
     # xhat*dgamma = (x-mean)*inv*dgamma -> express dx as a*dy + b*x + c per channel
-    bx = (gi * inv * dgamma / -n).astype(x.dtype)
-    dx = dy * c1 + x * bx + c2
+    bx = gi * inv * dgamma / -n
+    dx = (dyf * gi + xf * bx + c2).astype(x.dtype)
     return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
 
 

@@ -115,15 +115,21 @@ def lstm_scan(
     gate_act: str = "sigmoid",
     cell_act: str = "tanh",
     state_act: str = "tanh",
+    return_cell_seq: bool = False,
 ) -> Tuple[Array, Array, Array]:
     """Full-sequence LSTM → (h_seq [B,T,H], h_last, c_last). Masked steps
-    carry the previous state through (ragged batches stay correct)."""
+    carry the previous state through (ragged batches stay correct).
+
+    `return_cell_seq=True` returns (h_seq, c_seq [B,T,H], h_last) instead —
+    the fluid lstm_op contract (full cell sequence in its 'Cell' slot). The
+    fused pallas kernel only materializes final states, so that mode always
+    takes the scan path."""
     b, t, h4 = proj.shape
     hdim = h4 // 4
     h0 = h0 if h0 is not None else jnp.zeros((b, hdim), proj.dtype)
     c0 = c0 if c0 is not None else jnp.zeros((b, hdim), proj.dtype)
 
-    if _use_fused(
+    if not return_cell_seq and _use_fused(
         gate_act == "sigmoid" and cell_act == "tanh" and state_act == "tanh"
         and p.check_i is None and p.check_f is None and p.check_o is None,
         bh=b * hdim,
@@ -142,11 +148,14 @@ def lstm_scan(
         m = m_t[:, None].astype(h_new.dtype)
         h = m * h_new + (1 - m) * h
         c = m * c_new + (1 - m) * c
-        return (h, c), h
+        return (h, c), ((h, c) if return_cell_seq else h)
 
     xs = (jnp.swapaxes(proj, 0, 1), jnp.swapaxes(mask, 0, 1))
-    (h_last, c_last), hs = lax.scan(step, (h0, c0), xs, reverse=reverse)
-    return jnp.swapaxes(hs, 0, 1), h_last, c_last
+    (h_last, c_last), out = lax.scan(step, (h0, c0), xs, reverse=reverse)
+    if return_cell_seq:
+        hs, cs = out
+        return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1), h_last
+    return jnp.swapaxes(out, 0, 1), h_last, c_last
 
 
 class GruParams(NamedTuple):

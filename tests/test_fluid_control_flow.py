@@ -1,0 +1,546 @@
+"""Fluid control flow, LoD sequence ops, RNN ops, IO ops, beam ops
+(VERDICT r3 weak #1 / task #2: the round-3 fluid surface shipped untested).
+
+Oracles follow the repo's CPU-oracle idiom (SURVEY §4): numpy loops for the
+recurrences, the eager interpreter vs the jit path for executor parity —
+the reference's analogous corpus is framework/tests/test_recurrent_op.py,
+test_while_op / test_cond_op, and the operators' python unit tests."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers as L
+from paddle_tpu.fluid.ops import OPS, OpContext
+
+
+@pytest.fixture(autouse=True)
+def _fresh_program():
+    fluid.reset_default_program()
+    yield
+
+
+# ---------------------------------------------------------------------------
+# recurrent op (recurrent_op.cc → lax.scan)
+# ---------------------------------------------------------------------------
+
+
+def _build_rnn_program(b, t, d, h, seed=0):
+    """h_t = tanh(x_t @ W + h_{t-1} @ U): the test_recurrent_op.py cell."""
+    rs = np.random.RandomState(seed)
+    wv = (rs.randn(d, h) * 0.3).astype(np.float32)
+    uv = (rs.randn(h, h) * 0.3).astype(np.float32)
+
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var("x_seq", shape=[t, d], is_data=True)
+    block.create_var("h0", shape=[h], is_data=True)
+    w = block.create_parameter("W", shape=[d, h], initializer=wv)
+    u = block.create_parameter("U", shape=[h, h], initializer=uv)
+
+    sub = prog.create_block()
+    sub.append_op("mul", {"X": "x_t", "Y": w}, {"Out": "xw"}, {})
+    sub.append_op("mul", {"X": "h_pre", "Y": u}, {"Out": "hu"}, {})
+    sub.append_op("elementwise_add", {"X": "xw", "Y": "hu"}, {"Out": "s"}, {})
+    sub.append_op("tanh", {"X": "s"}, {"Y": "h_new"}, {})
+    prog.rollback()
+
+    block.desc.ops.append(
+        fluid.framework.OpDesc(
+            type="recurrent",
+            attrs={
+                "sub_block": sub.idx,
+                "seq_ins": {"x_t": "x_seq"},
+                "states": {"h_pre": ("h0", "h_new")},
+                "seq_outs": {"h_seq": "h_new"},
+            },
+        )
+    )
+    return prog, wv, uv
+
+
+def _np_rnn(x, h0, w, u):
+    hs = []
+    h = h0
+    for step in range(x.shape[1]):
+        h = np.tanh(x[:, step] @ w + h @ u)
+        hs.append(h)
+    return np.stack(hs, 1)
+
+
+def test_recurrent_op_matches_numpy_and_jit_matches_eager():
+    b, t, d, h = 4, 6, 5, 3
+    rs = np.random.RandomState(1)
+    xv = rs.randn(b, t, d).astype(np.float32)
+    h0 = rs.randn(b, h).astype(np.float32)
+    prog, wv, uv = _build_rnn_program(b, t, d, h)
+
+    exe = fluid.Executor()
+    (jit_out,) = exe.run(prog, feed={"x_seq": xv, "h0": h0}, fetch_list=["h_seq"])
+    (eager_out,) = exe.run(
+        prog, feed={"x_seq": xv, "h0": h0}, fetch_list=["h_seq"], use_jit=False
+    )
+    want = _np_rnn(xv, h0, wv, uv)
+    np.testing.assert_allclose(np.asarray(jit_out), want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(eager_out), want, rtol=1e-5, atol=1e-5)
+
+
+def test_recurrent_op_reverse():
+    b, t, d, h = 2, 5, 4, 3
+    rs = np.random.RandomState(2)
+    xv = rs.randn(b, t, d).astype(np.float32)
+    h0 = np.zeros((b, h), np.float32)
+    prog, wv, uv = _build_rnn_program(b, t, d, h, seed=3)
+    prog.global_block().desc.ops[-1].attrs["reverse"] = True
+
+    exe = fluid.Executor()
+    (out,) = exe.run(prog, feed={"x_seq": xv, "h0": h0}, fetch_list=["h_seq"])
+    want = _np_rnn(xv[:, ::-1], h0, wv, uv)[:, ::-1]
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# while op (→ lax.while_loop)
+# ---------------------------------------------------------------------------
+
+
+def test_while_op_jit_matches_eager_and_closed_form():
+    """v doubles until counter hits 7: v_final = v0 * 2^7."""
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var("v", shape=[4], is_data=True)
+    block.create_var("c", shape=[], is_data=True)
+    block.create_var("n", shape=[], is_data=True)
+    block.create_var("keep_going", shape=[])
+    # cond must hold before entry
+    block.append_op("less_than", {"X": "c", "Y": "n"}, {"Out": "keep_going"}, {})
+
+    sub = prog.create_block()
+    sub.append_op("scale", {"X": "v"}, {"Out": "v"}, {"scale": 2.0})
+    sub.append_op("increment", {"X": "c"}, {"Out": "c"}, {"step": 1.0})
+    sub.append_op("less_than", {"X": "c", "Y": "n"}, {"Out": "keep_going"}, {})
+    prog.rollback()
+
+    block.desc.ops.append(
+        fluid.framework.OpDesc(
+            type="while",
+            attrs={"sub_block": sub.idx, "cond": "keep_going", "carry": ["v", "c"]},
+        )
+    )
+    feed = {
+        "v": np.ones(4, np.float32),
+        "c": np.zeros((), np.float32),
+        "n": np.full((), 7.0, np.float32),
+    }
+    exe = fluid.Executor()
+    (v_jit,) = exe.run(prog, feed=dict(feed), fetch_list=["v"])
+    (v_eager,) = exe.run(prog, feed=dict(feed), fetch_list=["v"], use_jit=False)
+    np.testing.assert_allclose(np.asarray(v_jit), np.full(4, 128.0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v_jit), np.asarray(v_eager), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# cond op (→ lax.cond / masked select)
+# ---------------------------------------------------------------------------
+
+
+def _cond_prog(with_false_block: bool):
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var("flag", shape=[], is_data=True)
+    block.create_var("x", shape=[3], is_data=True)
+    block.create_var("out", shape=[3], is_data=True)  # passthrough default
+
+    true_b = prog.create_block()
+    true_b.append_op("scale", {"X": "x"}, {"Out": "out"}, {"scale": 10.0})
+    prog.rollback()
+    attrs = {"cond": "flag", "true_block": true_b.idx, "outs": ["out"]}
+    if with_false_block:
+        false_b = prog.create_block()
+        false_b.append_op("scale", {"X": "x"}, {"Out": "out"}, {"scale": -1.0})
+        prog.rollback()
+        attrs["false_block"] = false_b.idx
+    block.desc.ops.append(fluid.framework.OpDesc(type="cond", attrs=attrs))
+    return prog
+
+
+@pytest.mark.parametrize("use_jit", [True, False])
+def test_cond_scalar_both_branches(use_jit):
+    prog = _cond_prog(with_false_block=True)
+    exe = fluid.Executor()
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    base = {"x": xv, "out": np.zeros(3, np.float32)}
+    (t_out,) = exe.run(
+        prog, feed={**base, "flag": np.asarray(1.0)}, fetch_list=["out"], use_jit=use_jit
+    )
+    (f_out,) = exe.run(
+        prog, feed={**base, "flag": np.asarray(0.0)}, fetch_list=["out"], use_jit=use_jit
+    )
+    np.testing.assert_allclose(np.asarray(t_out), xv * 10.0)
+    np.testing.assert_allclose(np.asarray(f_out), -xv)
+
+
+def test_cond_passthrough_without_false_block():
+    prog = _cond_prog(with_false_block=False)
+    exe = fluid.Executor()
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    prior = np.array([7.0, 8.0, 9.0], np.float32)
+    (f_out,) = exe.run(
+        prog, feed={"x": xv, "out": prior, "flag": np.asarray(0.0)}, fetch_list=["out"]
+    )
+    np.testing.assert_allclose(np.asarray(f_out), prior)  # false → passthrough
+    (t_out,) = exe.run(
+        prog, feed={"x": xv, "out": prior, "flag": np.asarray(1.0)}, fetch_list=["out"]
+    )
+    np.testing.assert_allclose(np.asarray(t_out), xv * 10.0)
+
+
+def test_cond_vector_per_sample_select():
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var("flag", shape=[4], is_data=True)
+    block.create_var("x", shape=[4, 2], is_data=True)
+    true_b = prog.create_block()
+    true_b.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 2.0})
+    prog.rollback()
+    false_b = prog.create_block()
+    false_b.append_op("scale", {"X": "x"}, {"Out": "y"}, {"scale": 0.0})
+    prog.rollback()
+    block.desc.ops.append(
+        fluid.framework.OpDesc(
+            type="cond",
+            attrs={"cond": "flag", "true_block": true_b.idx,
+                   "false_block": false_b.idx, "outs": ["y"]},
+        )
+    )
+    xv = np.ones((4, 2), np.float32)
+    flag = np.array([1, 0, 1, 0], np.float32)
+    exe = fluid.Executor()
+    (y,) = exe.run(prog, feed={"x": xv, "flag": flag}, fetch_list=["y"])
+    np.testing.assert_allclose(np.asarray(y)[:, 0], [2.0, 0.0, 2.0, 0.0])
+
+
+def test_cond_missing_passthrough_raises():
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var("flag", shape=[], is_data=True)
+    block.create_var("x", shape=[3], is_data=True)
+    true_b = prog.create_block()
+    true_b.append_op("scale", {"X": "x"}, {"Out": "only_inside"}, {"scale": 2.0})
+    prog.rollback()
+    block.desc.ops.append(
+        fluid.framework.OpDesc(
+            type="cond",
+            attrs={"cond": "flag", "true_block": true_b.idx, "outs": ["only_inside"]},
+        )
+    )
+    exe = fluid.Executor()
+    with pytest.raises(KeyError, match="false_block"):
+        exe.run(
+            prog,
+            feed={"x": np.ones(3, np.float32), "flag": np.asarray(1.0)},
+            fetch_list=["only_inside"],
+            use_jit=False,
+        )
+
+
+# ---------------------------------------------------------------------------
+# LSTM / GRU ops vs numpy oracles
+# ---------------------------------------------------------------------------
+
+
+def _np_lstm(proj, w_hh, bias, mask):
+    """Gate order [i, f, c, o] (ops/rnn.py convention)."""
+    b, t, h4 = proj.shape
+    h = h4 // 4
+    hs, cs = [], []
+    hv = np.zeros((b, h), np.float32)
+    cv = np.zeros((b, h), np.float32)
+
+    def sig(x):
+        return 1.0 / (1.0 + np.exp(-x))
+
+    for step in range(t):
+        g = proj[:, step] + hv @ w_hh + bias
+        gi, gf, gc, go = np.split(g, 4, -1)
+        c_new = sig(gf) * cv + sig(gi) * np.tanh(gc)
+        h_new = sig(go) * np.tanh(c_new)
+        m = mask[:, step][:, None]
+        hv = m * h_new + (1 - m) * hv
+        cv = m * c_new + (1 - m) * cv
+        hs.append(hv)
+        cs.append(cv)
+    return np.stack(hs, 1), np.stack(cs, 1), hv
+
+
+def test_fluid_lstm_op_matches_numpy_full_cell_sequence():
+    rs = np.random.RandomState(0)
+    b, t, h = 3, 5, 4
+    proj = rs.randn(b, t, 4 * h).astype(np.float32) * 0.5
+    w = (rs.randn(h, 4 * h) * 0.3).astype(np.float32)
+    bias = (rs.randn(4 * h) * 0.1).astype(np.float32)
+    lengths = np.array([5, 3, 4], np.int32)
+    mask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float32)
+
+    out = OPS.get("lstm")(
+        OpContext(),
+        {"Input": [proj], "Weight": [w], "Bias": [bias], "SeqLengths": [lengths]},
+        {},
+    )
+    hs_w, cs_w, h_last_w = _np_lstm(proj, w, bias, mask)
+    np.testing.assert_allclose(np.asarray(out["Hidden"]), hs_w, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["Cell"]), cs_w, rtol=1e-5, atol=1e-5)
+    assert out["Cell"].shape == (b, t, h)  # FULL cell sequence (lstm_op.cc)
+    np.testing.assert_allclose(np.asarray(out["LastH"]), h_last_w, rtol=1e-5, atol=1e-5)
+
+
+def test_fluid_gru_unit_matches_numpy():
+    rs = np.random.RandomState(4)
+    b, h = 3, 4
+    x = rs.randn(b, 3 * h).astype(np.float32) * 0.5
+    hp = rs.randn(b, h).astype(np.float32) * 0.5
+    w = (rs.randn(h, 3 * h) * 0.3).astype(np.float32)
+
+    out = OPS.get("gru_unit")(
+        OpContext(), {"Input": [x], "HiddenPrev": [hp], "Weight": [w], "Bias": [None]}, {}
+    )
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    pz, pr, pc = np.split(x, 3, -1)
+    rz = hp @ w[:, : 2 * h]
+    z = sig(pz + rz[:, :h])
+    r = sig(pr + rz[:, h:])
+    c = np.tanh(pc + (r * hp) @ w[:, 2 * h:])
+    want = (1 - z) * hp + z * c
+    np.testing.assert_allclose(np.asarray(out["Hidden"]), want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# LoDTensor sequence ops
+# ---------------------------------------------------------------------------
+
+
+def _lod_fixture():
+    from paddle_tpu.fluid.lod import LoDTensor, lod_from_lengths
+
+    rs = np.random.RandomState(5)
+    lengths = [3, 1, 4]
+    data = rs.randn(sum(lengths), 2).astype(np.float32)
+    return LoDTensor(np.asarray(data), (lod_from_lengths(lengths),)), data, lengths
+
+
+@pytest.mark.parametrize(
+    "pooltype,reducer",
+    [
+        ("SUM", lambda seg: seg.sum(0)),
+        ("AVERAGE", lambda seg: seg.mean(0)),
+        ("MAX", lambda seg: seg.max(0)),
+        ("SQRT", lambda seg: seg.sum(0) / np.sqrt(len(seg))),
+        ("LAST", lambda seg: seg[-1]),
+        ("FIRST", lambda seg: seg[0]),
+    ],
+)
+def test_sequence_pool_vs_numpy(pooltype, reducer):
+    t, data, lengths = _lod_fixture()
+    out = OPS.get("sequence_pool")(OpContext(), {"X": [t]}, {"pooltype": pooltype})["Out"]
+    offs = np.concatenate([[0], np.cumsum(lengths)])
+    want = np.stack([reducer(data[offs[i]: offs[i + 1]]) for i in range(len(lengths))])
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+
+
+def test_sequence_softmax_vs_numpy():
+    t, data, lengths = _lod_fixture()
+    from paddle_tpu.fluid.lod import LoDTensor, lod_from_lengths
+
+    v = data[:, 0].copy()
+    t1 = LoDTensor(np.asarray(v), (lod_from_lengths(lengths),))
+    out = OPS.get("sequence_softmax")(OpContext(), {"X": [t1]}, {})["Out"]
+    offs = np.concatenate([[0], np.cumsum(lengths)])
+    want = np.zeros_like(v)
+    for i in range(len(lengths)):
+        seg = v[offs[i]: offs[i + 1]]
+        e = np.exp(seg - seg.max())
+        want[offs[i]: offs[i + 1]] = e / e.sum()
+    got = np.asarray(out.data if hasattr(out, "data") else out).reshape(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_lod_padded_round_trip():
+    from paddle_tpu.fluid import lod as lod_mod
+
+    t, data, lengths = _lod_fixture()
+    padded, lens = lod_mod.to_padded(t, max_len=4)
+    assert padded.shape == (3, 4, 2)
+    back = lod_mod.from_padded(np.asarray(padded), np.asarray(lens))
+    np.testing.assert_allclose(np.asarray(back.data), data, rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(back.lod[-1]), np.asarray(t.lod[-1])
+    )
+
+
+def test_selected_rows_to_dense_accumulates_duplicates():
+    from paddle_tpu.fluid.lod import SelectedRows
+
+    sr = SelectedRows(
+        rows=np.asarray([1, 3, 1], np.int32),
+        value=np.asarray([[1.0], [2.0], [10.0]], np.float32),
+        height=5,
+    )
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(dense[:, 0], [0.0, 11.0, 0.0, 2.0, 0.0])
+
+
+# ---------------------------------------------------------------------------
+# IO ops: feed / fetch / save / load
+# ---------------------------------------------------------------------------
+
+
+def test_feed_fetch_ops():
+    holder = [np.asarray([1.0, 2.0]), np.asarray([3.0])]
+    out = OPS.get("feed")(OpContext(), {"X": [holder]}, {"col": 1})["Out"]
+    np.testing.assert_allclose(out, [3.0])
+    fetch_holder = []
+    got = OPS.get("fetch")(
+        OpContext(), {"X": [np.asarray([9.0])], "Holder": [fetch_holder]}, {"col": 0}
+    )["Out"]
+    np.testing.assert_allclose(got, [9.0])
+    np.testing.assert_allclose(fetch_holder[0], [9.0])
+
+
+def test_save_load_round_trip(tmp_path):
+    import jax
+
+    path = str(tmp_path / "var.npy")
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    OPS.get("save")(OpContext(), {"X": [x]}, {"file_path": path})
+    out = OPS.get("load")(OpContext(), {}, {"file_path": path})["Out"]
+    np.testing.assert_allclose(np.asarray(out), x)
+
+    # traced save: io_callback path
+    path2 = str(tmp_path / "traced.npy")
+
+    @jax.jit
+    def f(v):
+        return OPS.get("save")(OpContext(), {"X": [v]}, {"file_path": path2})["Out"]
+
+    f(x).block_until_ready()
+    np.testing.assert_allclose(np.load(path2), x)
+
+
+# ---------------------------------------------------------------------------
+# beam_search / beam_search_decode ops
+# ---------------------------------------------------------------------------
+
+
+def test_beam_search_step_selects_topk_and_masks_finished():
+    k, v, end_id = 2, 5, 0
+    pre_ids = np.asarray([[3], [0]], np.int64)  # beam 1 already finished (EOS)
+    pre_scores = np.asarray([[-1.0], [-0.5]], np.float32)
+    probs = np.full((2, v), 1e-9, np.float32)
+    probs[0, 2] = 0.6
+    probs[0, 4] = 0.3
+    probs[1, 3] = 0.9  # ignored: beam is finished
+    out = OPS.get("beam_search")(
+        OpContext(),
+        {"pre_ids": [pre_ids], "pre_scores": [pre_scores], "scores": [probs]},
+        # probabilities in: is_accumulated=False (the default, matching the
+        # reference, is accumulated log-probs)
+        {"beam_size": k, "end_id": end_id, "is_accumulated": False},
+    )
+    ids = np.asarray(out["selected_ids"]).reshape(-1)
+    parents = np.asarray(out["parent_idx"]).reshape(-1)
+    scores = np.asarray(out["selected_scores"]).reshape(-1)
+    # best candidate: finished beam propagating EOS at score -0.5
+    assert ids[0] == end_id and parents[0] == 1
+    np.testing.assert_allclose(scores[0], -0.5, rtol=1e-5)
+    # second: token 2 from live beam 0 at -1 + log(0.6)
+    assert ids[1] == 2 and parents[1] == 0
+    np.testing.assert_allclose(scores[1], -1.0 + np.log(0.6), rtol=1e-5)
+
+
+def test_beam_search_decode_backtracks():
+    # B=1, K=2, T=3; hand-built parent chain.
+    ids = np.asarray([[5, 7], [2, 4], [9, 1]], np.int64)  # [T, K]
+    parents = np.asarray([[0, 0], [1, 0], [0, 1]], np.int64)
+    scores = np.asarray([-0.1, -0.2], np.float32)
+    out = OPS.get("beam_search_decode")(
+        OpContext(),
+        {"Ids": [ids], "ParentIdx": [parents], "Scores": [scores]},
+        {"beam_size": 2},
+    )
+    seqs = np.asarray(out["SentenceIds"])[0]  # [K, T]
+    # beam 0 at t=2: token 9, parent 0 → t=1 token 2, parent 1 → t=0 token 7
+    np.testing.assert_array_equal(seqs[0], [7, 2, 9])
+    # beam 1 at t=2: token 1, parent 1 → t=1 token 4, parent 0 → t=0 token 5
+    np.testing.assert_array_equal(seqs[1], [5, 4, 1])
+    np.testing.assert_allclose(np.asarray(out["SentenceScores"])[0], scores)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: text-classification LSTM trained through the fluid API
+# (r2 task #5's done-bar; reference idiom test_recurrent_op.py + book ch.6)
+# ---------------------------------------------------------------------------
+
+
+def test_fluid_lstm_text_classifier_converges():
+    rs = np.random.RandomState(0)
+    vocab, emb_d, hid, b, t, ncls = 30, 8, 16, 16, 6, 2
+    # class-separable synthetic text: class c's tokens cluster in one range
+    lbl = rs.randint(0, ncls, (b, 1))
+    ids = np.where(
+        lbl == 0,
+        rs.randint(2, vocab // 2, (b, t)),
+        rs.randint(vocab // 2, vocab, (b, t)),
+    ).astype(np.int64)
+    lengths = np.full((b,), t, np.int32)
+
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var("ids", shape=[t], dtype=np.int64, is_data=True)
+    block.create_var("lengths", shape=[], dtype=np.int32, is_data=True)
+    block.create_var("label", shape=[1], dtype=np.int64, is_data=True)
+
+    emb_w = block.create_parameter(
+        "emb.w", shape=[vocab, emb_d], initializer=("uniform", -0.1, 0.1)
+    )
+    block.append_op("lookup_table", {"W": emb_w, "Ids": "ids"}, {"Out": "emb"}, {})
+    proj_w = block.create_parameter(
+        "proj.w", shape=[emb_d, 4 * hid], initializer=("uniform", -0.3, 0.3)
+    )
+    block.append_op(
+        "mul", {"X": "emb", "Y": proj_w}, {"Out": "proj"}, {"x_num_col_dims": 2}
+    )
+    lstm_w = block.create_parameter(
+        "lstm.w", shape=[hid, 4 * hid], initializer=("uniform", -0.3, 0.3)
+    )
+    lstm_b = block.create_parameter(
+        "lstm.b", shape=[4 * hid], initializer=("constant", 0.0)
+    )
+    block.append_op(
+        "lstm",
+        {"Input": "proj", "Weight": lstm_w, "Bias": lstm_b, "SeqLengths": "lengths"},
+        {"Hidden": "hidden", "Cell": "cell", "LastH": "last_h"},
+        {},
+    )
+    fc_w = block.create_parameter(
+        "fc.w", shape=[hid, ncls], initializer=("uniform", -0.3, 0.3)
+    )
+    block.append_op("mul", {"X": "last_h", "Y": fc_w}, {"Out": "logits"}, {})
+    block.append_op("softmax", {"X": "logits"}, {"Y": "probs"}, {})
+    block.append_op(
+        "cross_entropy", {"X": "probs", "Label": "label"}, {"Y": "xent"}, {}
+    )
+    loss = block.create_var("loss", shape=[])
+    block.append_op("mean", {"X": "xent"}, {"Out": loss}, {})
+
+    fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor()
+    feed = {"ids": ids, "lengths": lengths, "label": lbl}
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+        losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] / 4, (losses[0], losses[-1])

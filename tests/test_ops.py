@@ -148,3 +148,40 @@ def test_fused_batch_norm_matches_autodiff_oracle():
     # inference path
     y_i = N.batch_norm_inference(x, gamma, beta, m_f, v_f, eps)
     np.testing.assert_allclose(np.asarray(y_i), np.asarray(y_f), rtol=2e-3, atol=2e-3)
+
+
+def test_softmax_xent_matches_log_softmax_oracle():
+    """Fused big-vocab CE (ops/xent.py) vs the naive f32 log_softmax path:
+    value and gradient, in f32 exactly and in bf16 at bf16 tolerance."""
+    import jax
+    from paddle_tpu.ops import xent as xent_ops
+
+    rng = np.random.RandomState(7)
+    n, v = 32, 97
+    logits = rng.randn(n, v).astype(np.float32) * 3.0
+    labels = rng.randint(0, v, n).astype(np.int32)
+
+    def oracle(x, y):
+        logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+
+    got = xent_ops.softmax_xent_with_logits(jnp.asarray(logits), jnp.asarray(labels))
+    want = oracle(jnp.asarray(logits), jnp.asarray(labels))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+    g_got = jax.grad(lambda x: xent_ops.softmax_xent_with_logits(x, jnp.asarray(labels)).sum())(
+        jnp.asarray(logits)
+    )
+    g_want = jax.grad(lambda x: oracle(x, jnp.asarray(labels)).sum())(jnp.asarray(logits))
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want), rtol=1e-5, atol=1e-6)
+
+    # bf16 logits: big tensors stay bf16 end-to-end, loss still finite/close
+    lb = jnp.asarray(logits, jnp.bfloat16)
+    got16 = xent_ops.softmax_xent_with_logits(lb, jnp.asarray(labels))
+    assert got16.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got16), np.asarray(want), rtol=5e-2, atol=5e-2)
+    g16 = jax.grad(lambda x: xent_ops.softmax_xent_with_logits(x, jnp.asarray(labels)).sum())(lb)
+    assert g16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(g16, np.float32), np.asarray(g_want), rtol=5e-2, atol=5e-2
+    )
