@@ -160,6 +160,8 @@ from paddle_tpu.v2.layer import (  # noqa: E402
     recurrent_group,
     memory,
     StaticInput,
+    SubsequenceInput,
+    SubSequenceInput,
     beam_search,
     get_output_layer,
 )
@@ -330,7 +332,8 @@ __all__ = [
     # detection
     "priorbox_layer", "multibox_loss_layer", "detection_output_layer",
     # recurrent groups
-    "recurrent_group", "memory", "StaticInput", "beam_search",
+    "recurrent_group", "memory", "StaticInput", "SubsequenceInput",
+    "SubSequenceInput", "beam_search",
     "get_output_layer",
     # networks
     "simple_img_conv_pool", "img_conv_group", "vgg_16_network",

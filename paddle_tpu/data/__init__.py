@@ -3,3 +3,4 @@ from paddle_tpu.data.feeder import DataFeeder, InputSpec  # noqa: F401
 from paddle_tpu.data.feeder import dense_vector, integer_value  # noqa: F401
 from paddle_tpu.data.feeder import dense_array, integer_value_sequence  # noqa: F401
 from paddle_tpu.data.feeder import dense_vector_sequence, sparse_binary_vector  # noqa: F401
+from paddle_tpu.data.feeder import dense_vector_sub_sequence, integer_value_sub_sequence  # noqa: F401

@@ -47,6 +47,17 @@ def integer_value_sequence(value_range: int = 0) -> InputSpec:
     return InputSpec("index_seq", value_range, np.int32)
 
 
+def dense_vector_sub_sequence(dim: int, dtype=np.float32) -> InputSpec:
+    """Nested sequence of dense vectors (PyDataProvider2
+    dense_vector_sub_sequence): each sample is a list of subsequences, each a
+    list of dim-vectors → padded [B, S, T, dim] + lengths + sub_lengths."""
+    return InputSpec("dense_subseq", dim, dtype)
+
+
+def integer_value_sub_sequence(value_range: int = 0) -> InputSpec:
+    return InputSpec("index_subseq", value_range, np.int32)
+
+
 def sparse_binary_vector(dim: int) -> InputSpec:
     return InputSpec("sparse_binary", dim, np.float32)
 
@@ -115,6 +126,43 @@ class DataFeeder:
                     out[i, : len(v)] = v.reshape((len(v),) + out.shape[2:])
                 batch[n] = out
                 batch[n + ".lengths"] = np.minimum(lengths, max_len)
+            elif spec.kind in ("dense_subseq", "index_subseq"):
+                # vals[i] = list of subsequences, each a list of tokens/vectors
+                # → [B, S, T, ...] + lengths [B] (subseq counts) + sub_lengths
+                # [B, S] (the padded encoding of subSequenceStartPositions)
+                for i, subs in enumerate(vals):
+                    if any(len(sub) == 0 for sub in subs):
+                        raise ValueError(
+                            f"{n}: sample {i} contains an empty subsequence; "
+                            "the reference rejects zero-length subsequences "
+                            "(subSequenceStartPositions must be strictly "
+                            "increasing)"
+                        )
+                s_counts = np.asarray([len(v) for v in vals], np.int32)
+                s_max = _bucket_len(
+                    max(int(s_counts.max()) if len(vals) else 1, 1),
+                    spec.seq_bucket,
+                )
+                t_raw = max(
+                    (len(sub) for v in vals for sub in v), default=1
+                )
+                t_max = _bucket_len(t_raw, spec.seq_bucket)
+                sub_lengths = np.ones((len(vals), s_max), np.int32)
+                if spec.kind == "dense_subseq":
+                    dim = spec.dim if isinstance(spec.dim, tuple) else (spec.dim,)
+                    out = np.zeros((len(vals), s_max, t_max) + dim, spec.dtype)
+                else:
+                    out = np.zeros((len(vals), s_max, t_max), np.int32)
+                for i, subs in enumerate(vals):
+                    for s, sub in enumerate(subs[:s_max]):
+                        sub = np.asarray(sub, out.dtype)[:t_max]
+                        out[i, s, : len(sub)] = sub.reshape(
+                            (len(sub),) + out.shape[3:]
+                        )
+                        sub_lengths[i, s] = max(len(sub), 1)
+                batch[n] = out
+                batch[n + ".lengths"] = np.minimum(s_counts, s_max)
+                batch[n + ".sub_lengths"] = sub_lengths
             elif spec.kind == "sparse_binary":
                 out = np.zeros((len(vals), spec.dim), np.float32)
                 for i, idxs in enumerate(vals):

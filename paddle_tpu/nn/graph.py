@@ -17,6 +17,7 @@ plus a per-example `lengths` vector (segment-id style), the TPU-friendly encodin
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -240,6 +241,22 @@ def reset_name_scope() -> None:
         _name_counters.clear()
 
 
+_record_tls = threading.local()
+
+
+@contextlib.contextmanager
+def record_layers(sink: List["Layer"]):
+    """Collect every Layer constructed inside the block (used by
+    recurrent_group to see step-net layers that are not output ancestors,
+    e.g. a last_seq serving only as a memory link target)."""
+    old = getattr(_record_tls, "sink", None)
+    _record_tls.sink = sink
+    try:
+        yield sink
+    finally:
+        _record_tls.sink = old
+
+
 class Layer:
     """A pure layer spec node in the graph.
 
@@ -269,6 +286,9 @@ class Layer:
         self.inputs: List[Layer] = inputs
         self.name = name or _auto_name(self.type_name)
         self.cfg = kwargs
+        sink = getattr(_record_tls, "sink", None)
+        if sink is not None:
+            sink.append(self)
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         raise NotImplementedError
@@ -400,5 +420,7 @@ def _feed_to_argument(batch: Dict[str, Any], layer: Layer) -> Argument:
     v = jnp.asarray(v)
     lengths_key = layer.name + ".lengths"
     if lengths_key in batch:
-        return Argument(v, jnp.asarray(batch[lengths_key]))
+        sub_key = layer.name + ".sub_lengths"
+        sub = jnp.asarray(batch[sub_key]) if sub_key in batch else None
+        return Argument(v, jnp.asarray(batch[lengths_key]), sub)
     return Argument(v)

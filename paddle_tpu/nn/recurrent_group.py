@@ -32,6 +32,7 @@ from paddle_tpu.nn.graph import (
     Layer,
     ParamAttr,
     _topo_sort,
+    record_layers,
 )
 
 Array = jax.Array
@@ -95,6 +96,24 @@ class GeneratedInput:
         self.size = size  # vocabulary size
         self.embedding_name = embedding_name
         self.embedding_size = embedding_size
+
+
+class SubsequenceInput:
+    """Wrapper marking a *nested*-sequence input iterated one subsequence per
+    outer timestep (layers.py SubsequenceInput; RecurrentGradientMachine.h:32
+    hierarchical unroll over Argument.subSequenceStartPositions, Argument.h:90).
+
+    TPU encoding: the wrapped layer's Argument is padded [B, S, T, ...] with
+    `lengths` = valid subsequence count [B] and `sub_lengths` = per-subsequence
+    token counts [B, S]. Each outer step seeds the step net with the [B, T, ...]
+    slice as a level-1 sequence (lengths = sub_lengths[:, s]), so an inner
+    recurrent_group nests naturally — two stacked lax.scans."""
+
+    def __init__(self, input: Layer):
+        self.input = input
+
+
+SubSequenceInput = SubsequenceInput  # both spellings appear in reference confs
 
 
 # ---------------------------------------------------------------------------
@@ -166,13 +185,15 @@ class _GroupCore:
     ):
         self.reverse = reverse
         self.seq_inputs: List[Layer] = []
+        self.sub_seq_flags: List[bool] = []  # parallel to seq_inputs
         self.static_inputs: List[StaticInput] = []
         self.generated: Optional[GeneratedInput] = None
 
         bctx = _BuildCtx()
         step_args: List[Any] = []
         self.placeholders: List[_Placeholder] = []
-        with _building(bctx):
+        created: List[Layer] = []
+        with _building(bctx), record_layers(created):
             for item in inputs if isinstance(inputs, (list, tuple)) else [inputs]:
                 if isinstance(item, StaticInput):
                     ph = _Placeholder(None)
@@ -187,30 +208,51 @@ class _GroupCore:
                     self.gen_placeholder = ph
                     self.placeholders.append(ph)
                     step_args.append(ph)
+                elif isinstance(item, SubsequenceInput):
+                    ph = _Placeholder(None)
+                    ph.static = None
+                    self.seq_inputs.append(item.input)
+                    self.sub_seq_flags.append(True)
+                    self.placeholders.append(ph)
+                    step_args.append(ph)
                 elif isinstance(item, Layer):
                     ph = _Placeholder(None)
                     ph.static = None
                     self.seq_inputs.append(item)
+                    self.sub_seq_flags.append(False)
                     self.placeholders.append(ph)
                     step_args.append(ph)
                 else:
                     raise TypeError(f"bad recurrent_group input: {item!r}")
             outs = step(*step_args)
         self.memories: List[MemoryLayer] = bctx.memories
+        self.is_nested = any(self.sub_seq_flags)
+        if self.is_nested and not all(self.sub_seq_flags):
+            raise ValueError(
+                "recurrent_group mixes SubsequenceInput with flat sequence "
+                "inputs; all iterated inputs must share one nesting level "
+                "(RecurrentGradientMachine requires equal sequence structure)"
+            )
         self.out_layers: List[Layer] = [outs] if isinstance(outs, Layer) else list(outs)
 
-        # resolve memory links: the step layer whose output feeds t+1
+        # resolve memory links: the step layer whose output feeds t+1. The
+        # link target need not be an output ancestor (e.g. a last_seq whose
+        # only purpose is to carry state across outer steps in a nested
+        # group) — any layer constructed inside the step counts, matching
+        # the reference's name-based in-frame lookup
+        # (RecurrentGradientMachine.cpp memory frame resolution).
         roots = list(self.out_layers)
+        created_by_name = {l.name: l for l in created}
+        for m in self.memories:
+            extra = created_by_name.get(m.link_name)
+            if extra is not None and extra not in roots:
+                roots.append(extra)
         self.order = _topo_sort(roots)
         by_name = {l.name: l for l in self.order}
         self.links: Dict[str, Layer] = {}
         for m in self.memories:
             link = by_name.get(m.link_name)
             if link is None:
-                # the linked layer may only be reachable through the memory
-                # itself (pure self-recurrence outside the outputs); search
-                # again including all placeholders' consumers is not possible,
-                # so require it to be an output ancestor or an output itself
                 raise ValueError(
                     f"memory links to {m.link_name!r} but no step layer has "
                     f"that name (step outputs: {[l.name for l in self.out_layers]})"
@@ -292,6 +334,8 @@ class RecurrentGroup(Layer):
         lengths = seq[0].lengths
         if lengths is None:
             raise ValueError("recurrent_group inputs must be sequences")
+        if core.is_nested:
+            return self._run_nested(ctx, seq, static, boot_map)
         batch, t_max = seq[0].value.shape[:2]
 
         seeded_static: Dict[str, Argument] = {}
@@ -357,6 +401,107 @@ class RecurrentGroup(Layer):
             if core.reverse:
                 ys = jnp.flip(ys, axis=1)
             outs[n] = Argument(ys, lengths)
+        return outs
+
+    def _run_nested(
+        self,
+        ctx: Context,
+        seq: List[Argument],
+        static: List[Argument],
+        boot_map: Dict[str, Argument],
+    ) -> Dict[str, Argument]:
+        """Hierarchical unroll (SubsequenceInput): outer scan over the
+        subsequence axis of [B, S, T, ...] inputs, each step seeding the step
+        net with a level-1 sequence slice — an inner recurrent_group in the
+        step net becomes the inner scan. Mirrors RecurrentGradientMachine's
+        nested frame expansion (sequence_nest_rnn.conf idiom) as two stacked
+        lax.scans over static shapes."""
+        core = self.core
+        for a in seq:
+            if a.sub_lengths is None or a.value.ndim < 3:
+                raise ValueError(
+                    f"{self.name}: SubsequenceInput needs a nested [B, S, T, ...] "
+                    "Argument with sub_lengths [B, S]"
+                )
+        outer_len = seq[0].lengths  # [B] valid subsequence counts
+        sub_lengths = seq[0].sub_lengths  # [B, S]
+        batch, s_max = seq[0].value.shape[:2]
+
+        seeded_static: Dict[str, Argument] = {}
+        core.seed_static(seeded_static, static)
+        carry0 = core.init_carry(ctx, batch, boot_map)
+        seq_phs = [
+            ph for ph in core.placeholders if getattr(ph, "static", None) is None
+        ]
+        out_names = [l.name for l in core.out_layers]
+
+        def seed_s(sub_vals: List[Array], sub_len: Array) -> Dict[str, Argument]:
+            seeded = dict(seeded_static)
+            for ph, x in zip(seq_phs, sub_vals):
+                seeded[ph.name] = Argument(x, sub_len)
+            return seeded
+
+        if ctx.mode == "init":
+            seeded = seed_s([a.value[:, 0] for a in seq], sub_lengths[:, 0])
+            for m in core.memories:
+                seeded[m.name] = Argument(carry0[m.name])
+            values = _eval_subnet(core.order, ctx, seeded)
+            outs: Dict[str, Argument] = {}
+            for n in out_names:
+                v = values[n]
+                tiled = jnp.repeat(v.value[:, None], s_max, axis=1)
+                if v.is_seq:  # [B, S, T, ...] nested output
+                    outs[n] = Argument(tiled, outer_len, sub_lengths)
+                else:  # [B, S, D] level-1 sequence over subsequence index
+                    outs[n] = Argument(tiled, outer_len)
+            return outs
+
+        ss = jnp.arange(s_max - 1, -1, -1) if core.reverse else jnp.arange(s_max)
+        keys0_state = set(ctx.state_updates)
+        keys0_cache = set(ctx.cache)
+        out_is_seq: Dict[str, bool] = {}
+
+        def body(carry: Dict[str, Array], s: Array):
+            seeded = seed_s(
+                [a.value[:, s] for a in seq],
+                sub_lengths[:, s],
+            )
+            for m in core.memories:
+                seeded[m.name] = Argument(carry[m.name])
+            values = _eval_subnet(core.order, ctx, seeded)
+            for n in out_names:  # body traces once; record output seq-ness
+                out_is_seq[n] = values[n].is_seq
+            valid = (s < outer_len)  # [B]
+            new_carry = {}
+            for m in core.memories:
+                new = values[core.links[m.name].name].value
+                old = carry[m.name]
+                mask = valid.reshape((-1,) + (1,) * (new.ndim - 1))
+                new_carry[m.name] = jnp.where(mask, new, old)
+            return new_carry, tuple(values[n].value for n in out_names)
+
+        _, stacked = lax.scan(body, carry0, ss)
+        # inner groups cache their per-trace results and state updates under
+        # ctx while the body traces; those hold scan tracers — drop them
+        for k in list(ctx.state_updates):
+            if k not in keys0_state:
+                del ctx.state_updates[k]
+        for k in list(ctx.cache):
+            if k not in keys0_cache:
+                del ctx.cache[k]
+
+        outs = {}
+        for n, ys in zip(out_names, stacked):
+            ys = jnp.swapaxes(ys, 0, 1)  # [B, S, ...]
+            if core.reverse:
+                ys = jnp.flip(ys, axis=1)
+            if out_is_seq[n]:
+                # sequence-valued step output (e.g. an inner group's full
+                # unroll): stacks to a nested [B, S, T, ...] Argument
+                outs[n] = Argument(ys, outer_len, sub_lengths)
+            else:
+                # flat [B, D] step output → level-1 sequence over s
+                outs[n] = Argument(ys, outer_len)
         return outs
 
 

@@ -42,25 +42,45 @@ class SeqPool(Layer):
         return Argument(fn(arg.value, arg.lengths))
 
 
+def _last_valid_subseq(arg: Argument):
+    """For a nested [B, S, T, ...] Argument: → ([B, T, ...] slice of the last
+    valid subsequence, its [B] token lengths)."""
+    b = arg.value.shape[0]
+    s_idx = jnp.maximum(arg.lengths - 1, 0)  # [B]
+    sub = arg.value[jnp.arange(b), s_idx]
+    sub_len = arg.sub_lengths[jnp.arange(b), s_idx]
+    return sub, sub_len
+
+
 @LAYERS.register("last_seq")
 class LastSeq(Layer):
-    """SequenceLastInstanceLayer."""
+    """SequenceLastInstanceLayer. On a nested sequence the default (non-seq)
+    aggregation spans the whole flat token stream — the last valid token of
+    the last valid subsequence (SequenceLastInstanceLayer.cpp uses the outer
+    sequenceStartPositions)."""
 
     type_name = "last_seq"
 
     def forward(self, ctx, ins):
         arg = ins[0]
+        if arg.sub_lengths is not None and arg.value.ndim > 2:
+            sub, sub_len = _last_valid_subseq(arg)
+            return Argument(seq_ops.seq_last(sub, sub_len))
         return Argument(seq_ops.seq_last(arg.value, arg.lengths))
 
 
 @LAYERS.register("first_seq")
 class FirstSeq(Layer):
-    """SequenceLastInstanceLayer with select_first=True."""
+    """SequenceLastInstanceLayer with select_first=True. On a nested sequence:
+    first token of the first subsequence."""
 
     type_name = "first_seq"
 
     def forward(self, ctx, ins):
-        return Argument(seq_ops.seq_first(ins[0].value))
+        arg = ins[0]
+        if arg.sub_lengths is not None and arg.value.ndim > 2:
+            return Argument(seq_ops.seq_first(arg.value[:, 0]))
+        return Argument(seq_ops.seq_first(arg.value))
 
 
 @LAYERS.register("expand")

@@ -73,13 +73,14 @@ def save_pass(
     states: Optional[Dict[str, Any]] = None,
     opt_state: Optional[Any] = None,
     extra_meta: Optional[Dict[str, Any]] = None,
-    v1_binary: bool = False,
+    v1_binary: bool = True,
 ) -> str:
     """Write save_dir/pass-%05d/{params,states,opt}.npz + manifest.json.
 
-    v1_binary=True additionally writes each parameter as a reference-format
-    `Parameter::save` file in the pass dir (ParamUtil layout — SURVEY §7
-    step 8 model interchange; see trainer/v1_format.py)."""
+    v1_binary (default on) additionally writes each parameter as a
+    reference-format `Parameter::save` file in the pass dir (ParamUtil layout
+    — SURVEY §7 step 8 model interchange; see trainer/v1_format.py), so every
+    pass dir doubles as a reference-consumable model dir."""
     pdir = os.path.join(save_dir, f"pass-{pass_id:05d}")
     os.makedirs(pdir, exist_ok=True)
     if v1_binary:
@@ -107,20 +108,84 @@ def save_pass(
     return pdir
 
 
+def is_v1_model_dir(dirname: str) -> bool:
+    """True when `dirname` looks like a reference ParamUtil model directory:
+    no manifest.json, and at least one regular file whose 16 leading bytes
+    parse as a `Parameter::Header` (Parameter.h:263) consistent with the
+    file's length (16 + 4*size bytes)."""
+    from paddle_tpu.trainer import v1_format
+
+    if not os.path.isdir(dirname) or os.path.exists(
+        os.path.join(dirname, "manifest.json")
+    ):
+        return False
+    for fn in os.listdir(dirname):
+        path = os.path.join(dirname, fn)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path, "rb") as f:
+                raw = f.read(v1_format.HEADER.size)
+            if len(raw) != v1_format.HEADER.size:
+                continue
+            fmt, value_size, size = v1_format.HEADER.unpack(raw)
+            if (
+                fmt == v1_format.PARAM_FORMAT_ORIGINAL
+                and value_size == 4
+                and os.path.getsize(path) == v1_format.HEADER.size + 4 * size
+            ):
+                return True
+        except OSError:
+            continue
+    return False
+
+
 def load_pass(
-    save_dir: str, pass_id: Optional[int] = None
+    save_dir: str,
+    pass_id: Optional[int] = None,
+    params_template: Optional[Dict[str, Any]] = None,
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray], Dict[str, np.ndarray], Dict]:
-    """Load (params, states, opt_flat, manifest). pass_id=None → latest."""
-    if pass_id is None:
-        passes = sorted(
-            int(d.split("-")[1])
-            for d in os.listdir(save_dir)
-            if d.startswith("pass-") and os.path.isdir(os.path.join(save_dir, d))
-        )
-        if not passes:
-            raise FileNotFoundError(f"no pass-* checkpoints under {save_dir}")
-        pass_id = passes[-1]
-    pdir = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    """Load (params, states, opt_flat, manifest). pass_id=None → latest.
+
+    Accepts three on-disk layouts, sniffed in order:
+    - save_dir/pass-%05d/ with manifest.json (this repo's native format);
+    - save_dir itself is a pass dir (manifest.json directly inside);
+    - save_dir (or save_dir/pass-%05d) is a reference ParamUtil model
+      directory of raw `Parameter::save` files (paddle/trainer/ParamUtil.cpp:50
+      loadParameters) — needs `params_template` for shapes; conv filters are
+      transposed from the reference flat [cin,kh,kw,cout] layout to HWIO by
+      v1_format.read_param. Optimizer state/states are absent in that case
+      (the reference checkpoints values only)."""
+    v1_sniffed = False
+    if os.path.exists(os.path.join(save_dir, "manifest.json")):
+        pdir = save_dir
+    elif pass_id is None and is_v1_model_dir(save_dir):
+        pdir = save_dir
+        v1_sniffed = True
+    else:
+        if pass_id is None:
+            passes = sorted(
+                int(d.split("-")[1])
+                for d in os.listdir(save_dir)
+                if d.startswith("pass-") and os.path.isdir(os.path.join(save_dir, d))
+            )
+            if not passes:
+                raise FileNotFoundError(f"no pass-* checkpoints under {save_dir}")
+            pass_id = passes[-1]
+        pdir = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    if not os.path.exists(os.path.join(pdir, "manifest.json")) and (
+        v1_sniffed or is_v1_model_dir(pdir)
+    ):
+        if params_template is None:
+            raise ValueError(
+                f"{pdir!r} is a reference-format (v1 binary) model dir; loading "
+                "it needs a params_template for shapes — init the trainer state "
+                "first (Trainer.load does this automatically)"
+            )
+        from paddle_tpu.trainer import v1_format
+
+        params = v1_format.load_model_dir(pdir, _to_numpy_tree(params_template))
+        return params, {}, {}, {"pass_id": pass_id, "v1_binary": True, "files": {}}
     with open(os.path.join(pdir, "manifest.json")) as f:
         manifest = json.load(f)
     out = {}

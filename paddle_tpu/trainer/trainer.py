@@ -312,7 +312,9 @@ class SGDTrainer:
         reference which checkpoints only parameter values (SURVEY §5
         'Optimizer state ... is not checkpointed in v1')."""
         assert self.state is not None, "init_state() with a sample batch first"
-        params, states, opt_flat, manifest = ckpt_mod.load_pass(save_dir, pass_id)
+        params, states, opt_flat, manifest = ckpt_mod.load_pass(
+            save_dir, pass_id, params_template=self.state["params"]
+        )
         self.state["params"] = {k: jnp.asarray(v) for k, v in params.items()}
         if states:
             self.state["states"] = {k: jnp.asarray(v) for k, v in states.items()}

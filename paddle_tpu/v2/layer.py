@@ -81,6 +81,11 @@ def data(name: str, type: InputSpec, height: int = 0, width: int = 0) -> Layer:
         is_seq = True
     elif spec.kind == "index_seq":
         shape, is_seq = (), True
+    elif spec.kind == "dense_subseq":
+        shape = spec.dim if isinstance(spec.dim, tuple) else (int(spec.dim),)
+        is_seq = True
+    elif spec.kind == "index_subseq":
+        shape, is_seq = (), True
     elif spec.kind in ("sparse_binary", "sparse_value"):
         shape, is_seq = (int(spec.dim),), False
     elif spec.kind == "sparse_binary_seq":
@@ -719,6 +724,8 @@ def _with_drop(node: Layer, layer_attr) -> Layer:
 from paddle_tpu.nn.recurrent_group import (  # noqa: E402
     GeneratedInput,
     StaticInput,
+    SubsequenceInput,
+    SubSequenceInput,
     beam_search,
     get_output_layer,
     memory,
@@ -727,5 +734,6 @@ from paddle_tpu.nn.recurrent_group import (  # noqa: E402
 
 __all__ += [
     "recurrent_group", "memory", "StaticInput", "GeneratedInput",
+    "SubsequenceInput", "SubSequenceInput",
     "beam_search", "get_output_layer",
 ]

@@ -204,3 +204,103 @@ def test_beam_engine_hand_checkable():
     np.testing.assert_array_equal(np.asarray(res.lengths)[0], [2, 2])
     # batch row 1 identical (same dynamics)
     np.testing.assert_array_equal(hist[1], hist[0])
+
+
+def test_nested_recurrent_group_matches_flat_chain():
+    """sequence_nest_rnn.conf vs sequence_rnn.conf equivalence
+    (gserver/tests/test_RecurrentGradientMachine.cpp idiom): the hierarchical
+    group — outer scan over SubsequenceInput, inner rnn booted from an outer
+    memory of the last inner state — must equal one flat RNN over the
+    concatenated valid tokens."""
+    b, s_max, t_sub, d, h = 3, 3, 4, 8, 16
+    rs = np.random.RandomState(0)
+    x = rs.randn(b, s_max, t_sub, d).astype(np.float32)
+    outer_len = np.array([3, 2, 1], np.int32)
+    sub_len = np.array([[4, 2, 3], [3, 4, 1], [2, 1, 1]], np.int32)
+
+    seq = vl.data(name="x", type=dense_vector_sequence(d))
+
+    def outer_step(xs):
+        outer_mem = vl.memory(name="outer_state", size=h)
+
+        def inner_step(y):
+            inner_mem = vl.memory(name="inner_state", size=h, boot_layer=outer_mem)
+            return vl.fc(input=[y, inner_mem], size=h, act=Tanh(), name="inner_state")
+
+        inner_out = vl.recurrent_group(inner_step, xs, name="inner_rnn")
+        # memory link target only — not a step output (the reference conf's
+        # last_seq(name="outer_rnn_state") pattern)
+        vl.last_seq(input=inner_out, name="outer_state")
+        return inner_out
+
+    out = vl.recurrent_group(outer_step, vl.SubsequenceInput(seq), name="outer_rnn")
+    rep = vl.last_seq(input=out)
+    net = Network([rep, out])
+    batch = {"x": x, "x.lengths": outer_len, "x.sub_lengths": sub_len}
+    params, states = net.init(jax.random.PRNGKey(0), batch)
+    outs, _ = net.apply(params, states, batch)
+    got = np.asarray(outs[rep.name].value)          # [B, H]
+    nested = outs[out.name]
+    assert nested.value.shape == (b, s_max, t_sub, h)
+    assert nested.sub_lengths is not None
+
+    # flat chain with the same weights: h_t = tanh(x W0 + h W1 + b) over the
+    # concatenated valid tokens of each example (= sequence_rnn.conf)
+    w0 = np.asarray(params["inner_state.w.0"])
+    w1 = np.asarray(params["inner_state.w.1"])
+    bb = np.asarray(params["inner_state.b"])
+    want = np.zeros((b, h), np.float32)
+    for i in range(b):
+        hh = np.zeros(h, np.float32)
+        for s in range(outer_len[i]):
+            for t in range(sub_len[i, s]):
+                hh = np.tanh(x[i, s, t] @ w0 + hh @ w1 + bb)
+        want[i] = hh
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    # gradients flow end-to-end through both scans
+    def loss(p):
+        o, _ = net.apply(p, states, batch)
+        return jnp.sum(o[rep.name].value ** 2)
+
+    grads = jax.grad(loss)(params)
+    for k in ("inner_state.w.0", "inner_state.w.1", "inner_state.b"):
+        assert float(jnp.abs(grads[k]).sum()) > 0.0, k
+
+
+def test_nested_group_flat_step_output_is_level1_seq():
+    """A non-sequence step output of a nested group becomes a level-1 sequence
+    over the subsequence index (the reference's seqlastins-in-group shape)."""
+    b, s_max, t_sub, d, h = 2, 2, 3, 4, 8
+    rs = np.random.RandomState(1)
+    x = rs.randn(b, s_max, t_sub, d).astype(np.float32)
+    outer_len = np.array([2, 1], np.int32)
+    sub_len = np.array([[3, 2], [1, 1]], np.int32)
+
+    seq = vl.data(name="x", type=dense_vector_sequence(d))
+
+    def outer_step(xs):
+        def inner_step(y):
+            mem = vl.memory(name="m", size=h)
+            return vl.fc(input=[y, mem], size=h, act=Tanh(), name="m")
+
+        inner_out = vl.recurrent_group(inner_step, xs, name="in2")
+        return vl.last_seq(input=inner_out)
+
+    out = vl.recurrent_group(outer_step, vl.SubsequenceInput(seq), name="outer2")
+    net = Network([out])
+    batch = {"x": x, "x.lengths": outer_len, "x.sub_lengths": sub_len}
+    params, states = net.init(jax.random.PRNGKey(0), batch)
+    outs, _ = net.apply(params, states, batch)
+    arg = outs[out.name]
+    assert arg.value.shape == (b, s_max, h)
+    assert arg.sub_lengths is None and arg.lengths is not None
+
+    # row 0, subseq 1 should equal running the inner rnn by hand (fresh boot
+    # per subsequence — no outer memory in this net)
+    w0 = np.asarray(params["m.w.0"]); w1 = np.asarray(params["m.w.1"])
+    bb = np.asarray(params["m.b"])
+    hh = np.zeros(h, np.float32)
+    for t in range(sub_len[0, 1]):
+        hh = np.tanh(x[0, 1, t] @ w0 + hh @ w1 + bb)
+    np.testing.assert_allclose(np.asarray(arg.value)[0, 1], hh, rtol=2e-5, atol=2e-5)
