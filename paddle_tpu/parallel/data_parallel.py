@@ -58,9 +58,18 @@ class DataParallel:
 
     def _put(self, batch: Dict[str, Any], sharding: NamedSharding) -> Dict[str, Any]:
         out = {}
+        multiproc = jax.process_count() > 1
         for k, v in batch.items():
             v = np.asarray(v) if not isinstance(v, jax.Array) else v
-            out[k] = jax.device_put(v, sharding)
+            if multiproc:
+                # each host holds only its shard of the global batch (the
+                # pserver-era trainers never saw each other's data either);
+                # assemble the global array from per-process locals
+                out[k] = jax.make_array_from_process_local_data(
+                    sharding, np.asarray(v)
+                )
+            else:
+                out[k] = jax.device_put(v, sharding)
         return out
 
     def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:

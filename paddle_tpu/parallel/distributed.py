@@ -31,6 +31,13 @@ def initialize(
     if num_processes is None or num_processes <= 1:
         _initialized = True
         return
+    # CPU backends need an explicit cross-process collectives transport (the
+    # TPU path rides ICI/DCN natively); gloo is jaxlib's built-in. Harmless
+    # on TPU — the flag only affects the CPU client.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older jaxlib without the option
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

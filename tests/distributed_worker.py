@@ -1,0 +1,134 @@
+"""Worker process for the 2-process distributed test (the reference's
+in-process-localhost cluster idiom, trainer/tests/test_CompareSparse.cpp:65-73:
+spawn real pservers + trainers on localhost, then compare parameters).
+
+Spawned by tests/test_distributed.py as `python distributed_worker.py
+<pid> <nprocs> <coord_addr> <master_port> <outdir>` with
+XLA_FLAGS=--xla_force_host_platform_device_count=2, so the 2 processes form a
+4-device global CPU mesh wired by gloo collectives.
+
+Each worker:
+1. joins the cluster via paddle_tpu.parallel.distributed.initialize,
+2. pulls recordio tasks from the shared MasterServer (hosted by process 0)
+   through cluster_reader and records which sample ids it consumed,
+3. trains a small classifier via SGDTrainer + DataParallel over the global
+   mesh, feeding only its shard_reader half of the data (grads allreduced by
+   the SPMD partitioner over the data axis),
+4. dumps its final parameters + consumed ids for the parent to compare.
+"""
+
+import json
+import os
+import pickle
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    pid, nprocs = int(sys.argv[1]), int(sys.argv[2])
+    coord_addr, master_port, outdir = sys.argv[3], int(sys.argv[4]), sys.argv[5]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=coord_addr, num_processes=nprocs, process_id=pid
+    )
+    assert jax.process_count() == nprocs
+
+    from paddle_tpu.data import reader as rd
+    from paddle_tpu.data.sharded_reader import shard_reader
+    from paddle_tpu.nn import costs as C
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn.graph import Network, reset_name_scope
+    from paddle_tpu.optim import SGD
+    from paddle_tpu.parallel import DataParallel, make_mesh
+    from paddle_tpu.runtime.master import MasterServer, TaskMaster, cluster_reader
+    from paddle_tpu.trainer import SGDTrainer
+
+    # -- master-backed data dispatch across the process boundary -------------
+    shards = sorted(
+        os.path.join(outdir, f) for f in os.listdir(outdir) if f.endswith(".recordio")
+    )
+    server = None
+    if pid == 0:
+        master = TaskMaster(timeout_s=30.0, failure_max=3)
+        master.set_dataset(shards, chunks_per_task=1)
+        server = MasterServer(master, port=master_port).start()
+    else:  # wait for process 0's server to come up
+        import socket
+        import time
+
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", master_port), 1.0).close()
+                break
+            except OSError:
+                time.sleep(0.2)
+    import time
+
+    distributed.barrier()  # don't let one host drain the queue before the
+    consumed = []          # other has even connected
+    for s in cluster_reader(("127.0.0.1", master_port), pickle.loads)():
+        consumed.append(s["sid"])
+        # simulate per-sample work on both hosts so the task stream
+        # demonstrably interleaves across the process boundary (whichever
+        # host connects first would otherwise drain the whole queue)
+        time.sleep(0.05)
+    with open(os.path.join(outdir, f"consumed_{pid}.json"), "w") as f:
+        json.dump(sorted(consumed), f)
+
+    # -- deterministic sharded allreduce training ----------------------------
+    reset_name_scope()
+    dim, classes, batch_local = 16, 4, 8
+    x = L.Data("x", shape=(dim,))
+    lbl = L.Data("label", shape=())
+    h = L.Fc(x, 32, act="relu", name="h")
+    logits = L.Fc(h, classes, act=None, name="out")
+    cost = C.ClassificationCost(logits, lbl, name="cost")
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(96, dim).astype(np.float32)
+    ys = (rs.rand(96) * classes).astype(np.int32)
+
+    def full_reader():
+        for i in range(len(xs)):
+            yield {"x": xs[i], "label": ys[i]}
+
+    mine = shard_reader(full_reader)  # idx % nprocs == process_index
+    mesh = make_mesh({"data": len(jax.devices())})
+    dp = DataParallel(mesh)
+    tr = SGDTrainer(cost, SGD(learning_rate=0.1), parallel=dp, seed=11)
+
+    costs = []
+    for raw in rd.batch(mine, batch_local, drop_last=True)():
+        batch = {
+            "x": np.stack([s["x"] for s in raw]),
+            "label": np.asarray([s["label"] for s in raw], np.int32),
+        }
+        batch = dp.shard_batch(batch)
+        if tr.state is None:
+            tr.init_state(batch)
+            tr._step_fn = tr._make_step()
+        tr.state, c, _ = tr._step_fn(tr.state, batch)
+        costs.append(float(c))
+
+    distributed.barrier()
+    np.savez(
+        os.path.join(outdir, f"params_{pid}.npz"),
+        **{k: np.asarray(v) for k, v in tr.state["params"].items()},
+    )
+    with open(os.path.join(outdir, f"costs_{pid}.json"), "w") as f:
+        json.dump(costs, f)
+    if server is not None:
+        server.stop()
+    print(f"worker {pid}: done, final cost {costs[-1]:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,8 @@
 embedding lookup (+ row-sparse grads), updater protocol, deterministic
 sharded readers. SURVEY §2.5 sparse/EP row and §5 data sharding."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -96,3 +98,115 @@ def test_shard_file_list():
     parts = [shard_file_list(files, 3, i) for i in range(3)]
     assert sorted(sum(parts, [])) == files
     assert parts[0] == ["f0", "f3", "f6", "f9"]
+
+
+# -- real 2-process cluster (VERDICT r3 missing #3) ---------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_cluster_trains_identically(tmp_path):
+    """The reference's in-process-localhost cluster test
+    (trainer/tests/test_CompareSparse.cpp:65-73: real pservers + trainers on
+    localhost, compare parameters) — here with real OS processes: 2 workers
+    join via jax.distributed (gloo CPU collectives), pull recordio tasks from
+    one MasterServer across the process boundary, train data-parallel over the
+    4-device global mesh with partitioner-inserted allreduce, and must end
+    with (a) byte-identical params on both hosts and (b) params matching a
+    single-process run over the same global batches."""
+    import json
+    import subprocess
+    import sys
+
+    from paddle_tpu.runtime import native, recordio
+
+    if native.lib() is None:
+        pytest.skip("native runtime unavailable")
+
+    outdir = str(tmp_path)
+    recordio.convert(
+        outdir, lambda: ({"sid": i} for i in range(24)), records_per_file=3
+    )
+
+    coord_port, master_port = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)), "distributed_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(p), "2", f"127.0.0.1:{coord_port}",
+             str(master_port), outdir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for p in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    # exactly-once task dispatch across the process boundary
+    consumed = [
+        json.load(open(os.path.join(outdir, f"consumed_{i}.json"))) for i in range(2)
+    ]
+    assert sorted(consumed[0] + consumed[1]) == list(range(24))
+    assert consumed[0] and consumed[1]  # both hosts actually pulled tasks
+
+    # identical replicated params on both hosts
+    p0 = dict(np.load(os.path.join(outdir, "params_0.npz")))
+    p1 = dict(np.load(os.path.join(outdir, "params_1.npz")))
+    assert set(p0) == set(p1)
+    for k in p0:
+        np.testing.assert_array_equal(p0[k], p1[k])
+
+    # ...and equal to a single-process run over the same global batches
+    from paddle_tpu.nn import costs as C
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn.graph import reset_name_scope
+    from paddle_tpu.optim import SGD
+    from paddle_tpu.trainer import SGDTrainer
+
+    reset_name_scope()
+    dim, classes, batch_local = 16, 4, 8
+    x = L.Data("x", shape=(dim,))
+    lbl = L.Data("label", shape=())
+    h = L.Fc(x, 32, act="relu", name="h")
+    logits = L.Fc(h, classes, act=None, name="out")
+    cost = C.ClassificationCost(logits, lbl, name="cost")
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(96, dim).astype(np.float32)
+    ys = (rs.rand(96) * classes).astype(np.int32)
+    tr = SGDTrainer(cost, SGD(learning_rate=0.1), seed=11)
+    for j in range(96 // (2 * batch_local)):
+        idx0 = [16 * j + 2 * t for t in range(batch_local)]      # host 0 shard
+        idx1 = [16 * j + 2 * t + 1 for t in range(batch_local)]  # host 1 shard
+        batch = {
+            "x": np.concatenate([xs[idx0], xs[idx1]]),
+            "label": np.concatenate([ys[idx0], ys[idx1]]),
+        }
+        if tr.state is None:
+            tr.init_state(batch)
+            tr._step_fn = tr._make_step()
+        tr.state, c, _ = tr._step_fn(tr.state, batch)
+    for k, v in tr.state["params"].items():
+        np.testing.assert_allclose(p0[k], np.asarray(v), rtol=2e-4, atol=2e-5)
