@@ -173,7 +173,11 @@ def parse_config(
     """Execute `config` (a .py file path or a zero-arg callable using the DSL)
     and return the parsed result. Mirrors parse_config(trainer_config,
     config_arg_str) → TrainerConfig proto."""
-    with fresh_context(_parse_arg_str(config_arg_str)) as ctx:
+    from paddle_tpu.nn.graph import record_layers
+
+    with fresh_context(_parse_arg_str(config_arg_str)) as ctx, record_layers(
+        []
+    ) as created:
         reset_name_scope()
         if callable(config):
             ret = config()
@@ -207,7 +211,17 @@ def parse_config(
             for dc in (ctx.data_config, ctx.test_data_config):
                 if dc is not None and not dc.config_dir:
                     dc.config_dir = cfg_dir
-        topology = Topology(ctx.outputs)
+        # layers created by the script but unreachable from outputs() stay in
+        # the config, as the reference's do (unused_layers.py golden; print
+        # layers have no consumers by design) — carried as extra_layers
+        reachable = {
+            l.name for l in Topology(ctx.outputs).network.layer_order
+        }
+        dangling = []
+        for l in created:
+            if l.name not in reachable and l.name not in {d.name for d in dangling}:
+                dangling.append(l)
+        topology = Topology(ctx.outputs, extra_layers=dangling)
         tc = proto.TrainerConfig(
             opt_config=ctx.opt_config or proto.OptimizationConfig(),
             data_config=ctx.data_config,

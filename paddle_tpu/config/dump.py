@@ -2,43 +2,400 @@
 
 The reference's config_parser builds the protobuf as the DSL executes, doing
 shape inference per @config_layer class. Here the graph nodes already carry
-full shape-inference logic in their `forward`, so the emitter simply traces
-the network once on a synthetic batch (Topology.sample_batch) and reads every
+full shape-inference logic in their `forward`, so the emitter traces the
+network once on a synthetic batch (Topology.sample_batch) and reads every
 layer's concrete output shape and created parameters — one source of truth
 instead of two (python/paddle/utils/dump_config.py, config_parser.py:4208).
+
+Emission is typed against the reference field set (proto/ModelConfig.proto:347
+LayerConfig and the per-input sub-confs at :319) so the output structurally
+diffs against the reference's 51 golden protostrs
+(trainer_config_helpers/tests/configs/protostr/ — see config/protostr.py).
+Geometry conventions follow the reference: x = width, y = height; image
+tensors here are NHWC, so input shape [B, H, W, C] maps to
+img_size_y=H, img_size=W, channels=C.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from paddle_tpu import proto
-from paddle_tpu.nn.graph import Context, Layer, Network
+from paddle_tpu.nn.graph import Argument, Context, Layer, Network
 from paddle_tpu.v2.topology import Topology
 
+# our registry name → the reference's REGISTER_LAYER wire name, where they
+# differ (gserver/layers/*.cpp registrations)
+_TYPE_ALIAS = {
+    "conv": "exconv",
+    "conv_transpose": "exconvt",
+    "cos_sim": "cos",
+    "smooth_l1_cost": "smooth_l1",
+    "lrn": "norm",
+    "outer_prod": "out_prod",
+    "last_seq": "seqlastins",
+    "first_seq": "seqlastins",
+    "feature_map_expand": "featmap_expand",
+    "seq_concat": "seqconcat",
+    "seq_reshape": "seqreshape",
+}
 
 _SKIP_ATTRS = {
     "name", "type_name", "inputs", "cfg", "act", "param_attr", "bias_attr",
-    "data_type", "rate", "core",
+    "data_type", "rate", "core", "bias",
 }
 
 
-def _scalar_attr(layer: Layer, *names: str):
-    for n in names:
-        v = getattr(layer, n, None)
-        if isinstance(v, (str, int, float, bool)):
-            return v
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def _act_name(layer: Layer) -> str:
+    a = getattr(layer, "act", None)
+    if not isinstance(a, str) or a in ("linear", "identity"):
+        return ""
+    return a
+
+
+def _geom(arg: Argument) -> Optional[Tuple[int, int, int, int]]:
+    """(D, H, W, C) of an NHWC/NDHWC argument (D=1 for 2-D images)."""
+    shape = arg.value.shape
+    feat = shape[2:] if arg.is_seq else shape[1:]
+    if len(feat) == 3:
+        return 1, int(feat[0]), int(feat[1]), int(feat[2])
+    if len(feat) == 4:
+        return int(feat[0]), int(feat[1]), int(feat[2]), int(feat[3])
     return None
 
 
-def _layer_attrs(layer: Layer) -> Dict[str, object]:
-    """Scalar/int-tuple hyperparameters from the spec's instance attributes
-    (layer constructors store e.g. filter_size/stride/padding as attributes)."""
+def _hw(arg: Argument) -> Optional[Tuple[int, int, int]]:
+    """(H, W, C) of an NHWC argument ([B,H,W,C] or seq [B,T,H,W,C])."""
+    g = _geom(arg)
+    if g is None or g[0] != 1:
+        return None
+    return g[1], g[2], g[3]
+
+
+def _image_conf(arg: Argument) -> Optional[proto.ImageConfig]:
+    g = _geom(arg)
+    if g is None:
+        return None
+    d, h, w, c = g
+    ic = proto.ImageConfig(channels=c, img_size=w, img_size_y=h)
+    if d != 1:
+        ic.img_size_z = d
+    return ic
+
+
+# ---------------------------------------------------------------------------
+# per-type typed emitters: fill LayerConfig fields + input sub-confs
+# ---------------------------------------------------------------------------
+
+_EMITTERS: Dict[str, Callable[[Layer, List[Argument], Argument, proto.LayerConfig], None]] = {}
+
+
+def _emitter(*types: str):
+    def deco(fn):
+        for t in types:
+            _EMITTERS[t] = fn
+        return fn
+
+    return deco
+
+
+def _set_hw(lc: proto.LayerConfig, out: Argument) -> None:
+    g = _geom(out)
+    if g is not None:
+        lc.height, lc.width = g[1], g[2]
+        if g[0] != 1:
+            lc.depth = g[0]
+
+
+@_emitter("conv", "conv_transpose")
+def _emit_conv(layer, ins, out, lc):
+    kh, kw = _pair(layer.filter_size)
+    sh, sw = _pair(layer.stride)
+    pad = layer.padding
+    ph, pw = _pair(pad) if not isinstance(pad, str) else (0, 0)
+    dh, dw = _pair(getattr(layer, "dilation", 1))
+    ihwc, ohwc = _hw(ins[0]), _hw(out)
+    cin = ihwc[2] if ihwc else 0
+    cc = proto.ConvConfig(
+        filter_size=kw, filter_size_y=kh,
+        channels=cin,
+        stride=sw, stride_y=sh,
+        padding=pw, padding_y=ph,
+        groups=layer.groups,
+        filter_channels=cin // max(layer.groups, 1),
+        caffe_mode=True,
+    )
+    if dh != 1 or dw != 1:
+        cc.dilation, cc.dilation_y = dw, dh
+    if ihwc:
+        cc.img_size, cc.img_size_y = ihwc[1], ihwc[0]
+    if ohwc:
+        cc.output_x, cc.output_y = ohwc[1], ohwc[0]
+    lc.inputs[0].conv_conf = cc
+    lc.num_filters = layer.num_filters
+    lc.shared_biases = True
+    _set_hw(lc, out)
+
+
+@_emitter("pool")
+def _emit_pool(layer, ins, out, lc):
+    kh, kw = _pair(layer.pool_size)
+    sh, sw = _pair(layer.stride if layer.stride is not None else layer.pool_size)
+    ph, pw = _pair(layer.padding)
+    ihwc, ohwc = _hw(ins[0]), _hw(out)
+    pc = proto.PoolConfig(
+        pool_type=f"{layer.pool_type}-projection",
+        channels=ihwc[2] if ihwc else 0,
+        size_x=kw, size_y=kh,
+        stride=sw, stride_y=sh,
+        padding=pw, padding_y=ph,
+    )
+    if ihwc:
+        pc.img_size, pc.img_size_y = ihwc[1], ihwc[0]
+    if ohwc:
+        pc.output_x, pc.output_y = ohwc[1], ohwc[0]
+    lc.inputs[0].pool_conf = pc
+    _set_hw(lc, out)
+
+
+@_emitter("batch_norm")
+def _emit_bn(layer, ins, out, lc):
+    ic = _image_conf(ins[0])
+    if ic is None:
+        feat = ins[0].value.shape[1:]
+        ic = proto.ImageConfig(channels=int(feat[-1]) if feat else 1, img_size=1, img_size_y=1)
+    lc.inputs[0].image_conf = ic
+    lc.moving_average_fraction = getattr(layer, "maf", 0.9)
+    ugs = getattr(layer, "use_global_stats", None)
+    if ugs is not None:
+        lc.use_global_stats = bool(ugs)
+    _set_hw(lc, out)
+
+
+@_emitter("sampling_id")
+def _emit_sampling_id(layer, ins, out, lc):
+    # SamplingIdLayer keeps its input's size in the config even though the
+    # forward emits one sampled id per row (SamplingIdLayer.cpp)
+    feat = ins[0].value.shape[1:]
+    lc.size = int(np.prod(feat)) if feat else 1
+
+
+@_emitter("lrn")
+def _emit_norm(layer, ins, out, lc):
+    ihwc = _hw(ins[0])
+    nc = proto.NormConfig(
+        norm_type="cmrnorm-projection",
+        channels=ihwc[2] if ihwc else 0,
+        size=getattr(layer, "size", 0),
+        scale=getattr(layer, "scale", 0.0),
+        pow=getattr(layer, "power", 0.0),
+        blocked=False,
+    )
+    if ihwc:
+        nc.img_size, nc.img_size_y = ihwc[1], ihwc[0]
+        nc.output_x, nc.output_y = ihwc[1], ihwc[0]
+    lc.inputs[0].norm_conf = nc
+    _set_hw(lc, out)
+
+
+@_emitter("clip")
+def _emit_clip(layer, ins, out, lc):
+    lc.inputs[0].clip_conf = proto.ClipConfig(
+        min=getattr(layer, "lo", 0.0), max=getattr(layer, "hi", 0.0)
+    )
+
+
+@_emitter("pad")
+def _emit_pad(layer, ins, out, lc):
+    ic = _image_conf(ins[0])
+    pc = proto.PadConfig(image_conf=ic)
+    pad_c = getattr(layer, "pad_c", None)
+    pad_h = getattr(layer, "pad_h", None)
+    pad_w = getattr(layer, "pad_w", None)
+    if pad_c is not None:
+        pc.pad_c = list(pad_c)
+    if pad_h is not None:
+        pc.pad_h = list(pad_h)
+    if pad_w is not None:
+        pc.pad_w = list(pad_w)
+    lc.inputs[0].pad_conf = pc
+    _set_hw(lc, out)
+
+
+@_emitter("maxout")
+def _emit_maxout(layer, ins, out, lc):
+    lc.inputs[0].maxout_conf = proto.MaxOutConfig(
+        image_conf=_image_conf(ins[0]), groups=getattr(layer, "groups", 0)
+    )
+    _set_hw(lc, out)
+
+
+@_emitter("spp")
+def _emit_spp(layer, ins, out, lc):
+    lc.inputs[0].spp_conf = proto.SppConfig(
+        image_conf=_image_conf(ins[0]),
+        pool_type=f"{getattr(layer, 'pool_type', 'max')}-projection",
+        pyramid_height=getattr(layer, "pyramid_height", 0),
+    )
+
+
+@_emitter("bilinear_interp")
+def _emit_bilinear(layer, ins, out, lc):
+    ohwc = _hw(out)
+    lc.inputs[0].bilinear_interp_conf = proto.BilinearInterpConfig(
+        image_conf=_image_conf(ins[0]),
+        out_size_x=ohwc[1] if ohwc else 0,
+        out_size_y=ohwc[0] if ohwc else 0,
+    )
+    _set_hw(lc, out)
+
+
+@_emitter("row_conv")
+def _emit_row_conv(layer, ins, out, lc):
+    lc.inputs[0].row_conv_conf = proto.RowConvConfig(
+        context_length=getattr(layer, "context_length", 0)
+    )
+
+
+@_emitter("block_expand")
+def _emit_block_expand(layer, ins, out, lc):
+    ihwc = _hw(ins[0])
+    bx, by = _pair(getattr(layer, "block", (0, 0)))
+    sx, sy = _pair(getattr(layer, "stride", (1, 1)))
+    px, py = _pair(getattr(layer, "padding", (0, 0)))
+    bc = proto.BlockExpandConfig(
+        channels=ihwc[2] if ihwc else 0,
+        block_x=bx, block_y=by,
+        stride_x=sx, stride_y=sy,
+        padding_x=px, padding_y=py,
+    )
+    if ihwc:
+        bc.img_size_x, bc.img_size_y = ihwc[1], ihwc[0]
+    lc.inputs[0].block_expand_conf = bc
+
+
+@_emitter("dropout")
+def _emit_dropout(layer, ins, out, lc):
+    lc.drop_rate = getattr(layer, "rate", None)
+
+
+@_emitter("last_seq", "first_seq")
+def _emit_seq_ins(layer, ins, out, lc):
+    lc.select_first = layer.type_name == "first_seq"
+    lc.trans_type = getattr(layer, "agg_level", None) or "non-seq"
+    lc.seq_pool_stride = getattr(layer, "stride", -1) or -1
+
+
+@_emitter("recurrent")
+def _emit_recurrent(layer, ins, out, lc):
+    lc.reversed = bool(getattr(layer, "reverse", False))
+
+
+@_emitter("lstmemory")
+def _emit_lstm(layer, ins, out, lc):
+    lc.reversed = bool(getattr(layer, "reverse", False))
+    lc.active_gate_type = getattr(layer, "gate_act", "sigmoid")
+    lc.active_state_type = getattr(layer, "state_act", "tanh")
+
+
+@_emitter("gated_recurrent")
+def _emit_gru(layer, ins, out, lc):
+    lc.reversed = bool(getattr(layer, "reverse", False))
+    lc.active_gate_type = getattr(layer, "gate_act", "sigmoid")
+
+
+@_emitter("crop")
+def _emit_crop(layer, ins, out, lc):
+    lc.axis = getattr(layer, "axis", 2)
+    off = getattr(layer, "offset", None)
+    shp = getattr(layer, "crop_shape", None) or getattr(layer, "shape_arg", None)
+    if off:
+        lc.offset = list(off)
+    if shp:
+        lc.shape = list(shp)
+
+
+@_emitter("prelu")
+def _emit_prelu(layer, ins, out, lc):
+    lc.partial_sum = getattr(layer, "partial_sum", 1)
+
+
+@_emitter("slope_intercept")
+def _emit_slope(layer, ins, out, lc):
+    lc.slope = getattr(layer, "slope", 1.0)
+    lc.intercept = getattr(layer, "intercept", 0.0)
+
+
+@_emitter("cos_sim", "cos_vm")
+def _emit_cos(layer, ins, out, lc):
+    lc.cos_scale = getattr(layer, "scale", 1.0)
+
+
+@_emitter("ctc", "warp_ctc")
+def _emit_ctc(layer, ins, out, lc):
+    lc.norm_by_times = bool(getattr(layer, "norm_by_times", False))
+    lc.blank = getattr(layer, "blank", 0)
+
+
+@_emitter("nce")
+def _emit_nce(layer, ins, out, lc):
+    lc.num_classes = getattr(layer, "num_classes", None)
+    lc.num_neg_samples = getattr(layer, "num_neg_samples", 10)
+
+
+@_emitter("hsigmoid")
+def _emit_hsigmoid(layer, ins, out, lc):
+    lc.num_classes = getattr(layer, "num_classes", None)
+
+
+@_emitter("expand")
+def _emit_expand(layer, ins, out, lc):
+    lc.trans_type = getattr(layer, "expand_level", "non-seq")
+
+
+@_emitter("seq_pool", "global_pool")
+def _emit_seqpool(layer, ins, out, lc):
+    lc.trans_type = getattr(layer, "agg_level", None) or "non-seq"
+    lc.seq_pool_stride = getattr(layer, "stride", -1) or -1
+    if getattr(layer, "output_max_index", None):
+        lc.output_max_index = True
+    # MaxLayer is its own type; everything else is AverageLayer + strategy
+    pt = getattr(layer, "pool_type", "sum")
+    if pt == "max":
+        lc.type = "max"
+    else:
+        lc.type = "average"
+        lc.average_strategy = {
+            "avg": "average", "average": "average", "sum": "sum",
+            "sqrt": "squarerootn",
+        }.get(pt, pt)
+
+
+_COST_TYPES = {
+    "multi-class-cross-entropy", "mse", "square_error", "rank-cost",
+    "lambda_cost", "sum_cost", "huber_regression", "huber_classification",
+    "smooth_l1_cost", "multi_binary_label_cross_entropy", "cross_entropy",
+    "soft_binary_class_cross_entropy", "cross_entropy_with_selfnorm",
+}
+
+
+# ---------------------------------------------------------------------------
+
+
+def _layer_attrs(layer: Layer, consumed: set) -> Dict[str, object]:
+    """Scalar/int-tuple hyperparameters with no typed field (kept under
+    `attrs`, emitted as repeated scalars)."""
     out: Dict[str, object] = {}
     for k, v in sorted(vars(layer).items()):
-        if k.startswith("_") or k in _SKIP_ATTRS:
+        if k.startswith("_") or k in _SKIP_ATTRS or k in consumed:
             continue
         if isinstance(v, (bool, int, float, str)):
             out[k] = v
@@ -63,48 +420,76 @@ def build_model_config(
     ctx = Context("init", {}, {}, jax.random.PRNGKey(0), train=False)
     values = net._run(ctx, topology.sample_batch(batch_size, seq_len))
 
-    # group created parameters by owning layer (Context.param names them
-    # "{layer}.{pname}" unless shared via ParamAttr.name)
+    # group created parameters by owning layer; Context.param records the
+    # (layer, slot) → parameter-name binding, which survives sharing via
+    # ParamAttr.name (a shared global name binds to every consuming layer)
     by_layer: Dict[str, Dict[str, str]] = {}
-    for full in ctx.params:
-        if "." in full:
-            lname, pname = full.rsplit(".", 1)
-            by_layer.setdefault(lname, {})[pname] = full
+    for (lname, pname), full in getattr(ctx, "param_owners", {}).items():
+        by_layer.setdefault(lname, {})[pname] = full
 
     mc = proto.ModelConfig()
     for layer in net.layer_order:
         arg = values[layer.name]
         shape = tuple(int(d) for d in arg.value.shape)
-        feat = shape[2:] if arg.is_seq else shape[1:]
+        if arg.is_seq and arg.sub_lengths is not None and len(shape) > 3:
+            feat = shape[3:]  # nested [B, S, T, ...]
+        elif arg.is_seq:
+            feat = shape[2:]
+        else:
+            feat = shape[1:]
         size = int(np.prod(feat)) if feat else 1
 
         lc = proto.LayerConfig(
             name=layer.name,
-            type=layer.type_name,
+            type=_TYPE_ALIAS.get(layer.type_name, layer.type_name),
             size=size,
-            shape=list(feat),
-            active_type=_scalar_attr(layer, "act"),
-            drop_rate=_scalar_attr(layer, "rate", "dropout_rate"),
+            active_type=_act_name(layer),
         )
         owned = by_layer.get(layer.name, {})
-        if "b" in owned:
-            lc.bias_parameter_name = owned.pop("b")
+        for bias_key in ("b", "bias"):  # batch_norm names its beta "bias"
+            if bias_key in owned:
+                lc.bias_parameter_name = owned.pop(bias_key)
+                break
         weight_names = sorted(owned.values())
+        in_args: List[Argument] = []
         for i, inp in enumerate(layer.inputs):
             lic = proto.LayerInputConfig(input_layer_name=inp.name)
             if i < len(weight_names):
                 lic.input_parameter_name = weight_names[i]
             lc.inputs.append(lic)
-        # layer-specific scalars (filter_size, stride, ...): introspected from
-        # the spec's instance attributes — layer constructors store their
-        # hyperparameters as plain attributes, not via cfg kwargs
-        lc.attrs = _layer_attrs(layer)
+            in_args.append(values[inp.name])
+        if layer.type_name in _COST_TYPES or layer.type_name.endswith("cost"):
+            lc.coeff = getattr(layer, "coeff", 1.0)
+        emitter = _EMITTERS.get(layer.type_name)
+        if emitter is not None and lc.inputs:
+            emitter(layer, in_args, arg, lc)
+        # remaining layer-specific scalars with no reference field
+        consumed = _emitted_attr_names(layer.type_name)
+        lc.attrs = _layer_attrs(layer, consumed)
         mc.layers.append(lc)
 
         if layer.type_name == "data":
             mc.input_layer_names.append(layer.name)
+            _set_hw(lc, arg)
+            # v1 data slots are flat; declared image geometry rides on the node
+            g3 = getattr(layer, "_v1_geom3d", None)
+            g2 = getattr(layer, "_v1_geom", None)
+            if g3 is not None:
+                _, lc.depth, lc.height, lc.width = g3
+            elif g2 is not None and lc.height is None:
+                _, lc.height, lc.width = g2
 
-    mc.output_layer_names = [l.name for l in net.outputs]
+    declared = getattr(topology, "declared_outputs", None)
+    mc.output_layer_names = [l.name for l in (declared or net.outputs)]
+    mc.sub_models.append(
+        proto.SubModelConfig(
+            name="root",
+            layer_names=[l.name for l in net.layer_order],
+            input_layer_names=list(mc.input_layer_names),
+            output_layer_names=list(mc.output_layer_names),
+            is_recurrent_layer_group=False,
+        )
+    )
 
     for full, value in ctx.params.items():
         attr = ctx.param_attrs.get(full)
@@ -127,6 +512,45 @@ def build_model_config(
                 pc.sharding = [a or "" for a in attr.sharding]
         mc.parameters.append(pc)
     return mc
+
+
+# attr names consumed by each typed emitter (kept out of the attrs block so
+# the same fact is not emitted twice)
+_EMITTED_ATTRS = {
+    "conv": {"filter_size", "stride", "padding", "dilation", "groups", "num_filters"},
+    "conv_transpose": {"filter_size", "stride", "padding", "dilation", "groups", "num_filters"},
+    "pool": {"pool_size", "pool_type", "stride", "padding", "ceil_mode"},
+    "batch_norm": {"maf", "use_global_stats", "epsilon"},
+    "lrn": {"size", "scale", "power"},
+    "clip": {"lo", "hi"},
+    "pad": {"pad_c", "pad_h", "pad_w"},
+    "maxout": {"groups"},
+    "spp": {"pool_type", "pyramid_height"},
+    "row_conv": {"context_length"},
+    "block_expand": {"block", "stride", "padding"},
+    "dropout": {"rate"},
+    "last_seq": {"stride"},
+    "first_seq": {"stride"},
+    "recurrent": {"reverse"},
+    "lstmemory": {"reverse", "gate_act", "state_act"},
+    "gated_recurrent": {"reverse", "gate_act"},
+    "crop": {"axis", "offset", "crop_shape", "shape_arg"},
+    "prelu": {"partial_sum"},
+    "slope_intercept": {"slope", "intercept"},
+    "cos_sim": {"scale"},
+    "cos_vm": {"scale"},
+    "ctc": {"norm_by_times", "blank"},
+    "warp_ctc": {"norm_by_times", "blank"},
+    "nce": {"num_classes", "num_neg_samples"},
+    "hsigmoid": {"num_classes"},
+    "expand": {"expand_level"},
+    "seq_pool": {"agg_level", "pool_type"},
+    "global_pool": {"agg_level", "pool_type"},
+}
+
+
+def _emitted_attr_names(type_name: str) -> set:
+    return _EMITTED_ATTRS.get(type_name, set())
 
 
 def dump_config(

@@ -52,8 +52,31 @@ from paddle_tpu.config.optimizers import (
     settings,
 )
 
+from paddle_tpu.v2.activation import (  # noqa: E402
+    Identity as IdentityActivation,
+    Reciprocal as ReciprocalActivation,
+    Sqrt as SqrtActivation,
+)
+
 ParameterAttribute = ParamAttr
 ExtraAttr = ExtraLayerAttribute
+
+
+class AggregateLevel:
+    """layers.py:275 — pooling/aggregation level over (nested) sequences."""
+
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel:
+    """layers.py:1762 — expansion source level."""
+
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
 
 # -- input types (PyDataProvider2.py:63-236) --------------------------------
 dense_vector = _feeder.dense_vector
@@ -180,22 +203,36 @@ from paddle_tpu.config.v1_layers import (  # noqa: E402
     classification_cost,
     concat_layer,
     conv_projection,
+    crf_decoding_layer,
+    crf_layer,
     cross_entropy,
+    ctc_layer,
     data_layer,
     dropout_layer,
     embedding_layer,
+    expand_layer,
     fc_layer,
+    first_seq,
     img_cmrnorm_layer,
     img_conv_group,
     img_conv_layer,
+    hsigmoid,
     img_pool_layer,
+    kmax_sequence_score_layer,
+    last_seq,
     maxid_layer,
+    nce_layer,
     pooling_layer,
+    seq_concat_layer,
+    seq_reshape_layer,
+    seq_slice_layer,
     sequence_conv_pool,
     simple_gru,
     simple_img_conv_pool,
     simple_lstm,
+    sub_nested_seq_layer,
     text_conv_pool,
+    warp_ctc_layer,
 )
 
 
@@ -282,7 +319,100 @@ def detection_map_evaluator(input=None, label=None, name=None, **kw):
     return _declare_evaluator("detection_map", input, label, name=name, **kw)
 
 
+# -- reference default naming (default_decorators.py wrap_name_default) ----
+# The reference auto-names every helper's layer "__{prefix}_{n}__" with a
+# per-helper counter (prefix = the decorator argument, else the helper's own
+# __name__). The golden protostrs encode those names, so the DSL surface
+# wraps each helper to inject the same default; counters live in the graph's
+# name scope and reset with reset_name_scope().
+_REF_NAME_PREFIX = {
+    # explicit wrap_name_default("...") prefixes in layers.py / networks.py
+    "mixed_layer": "mixed", "embedding_layer": "embedding",
+    "print_layer": "print", "printer_layer": "print",
+    "priorbox_layer": "priorbox", "multibox_loss_layer": "multibox_loss",
+    "detection_output_layer": "detection_output",
+    "cross_channel_norm_layer": "cross_channel_norm",
+    "pooling_layer": "seq_pooling", "lstmemory": "lstmemory",
+    "grumemory": "gru", "seq_reshape_layer": "seqreshape",
+    "img_conv_layer": "conv", "img_pool_layer": "pool",
+    "img_pool3d_layer": "pool3d", "spp_layer": "spp",
+    "img_cmrnorm_layer": "crmnorm", "batch_norm_layer": "batch_norm",
+    "addto_layer": "addto", "concat_layer": "concat",
+    "seq_concat_layer": "seqconcat", "lstm_step_layer": "lstm_step",
+    "gru_step_layer": "gru_step", "gru_step_naive_layer": "gru_step_naive",
+    "recurrent_group": "recurrent_group", "dropout_layer": "dropout",
+    "switch_order_layer": "switch_order", "clip_layer": "clip",
+    "scale_shift_layer": "scale_shift", "resize_layer": "resize",
+    "pad_layer": "pad", "classification_cost": "cost",
+    "kmax_sequence_score_layer": "kmax_seq_score_layer",
+    # networks.py composites
+    "sequence_conv_pool": "sequence_conv_pooling",
+    "simple_img_conv_pool": "conv_pool", "img_conv_bn_pool": "conv_bn_pool",
+    "simple_lstm": "lstm", "lstmemory_unit": "lstm_unit",
+    "lstmemory_group": "lstm_group", "gru_unit": "gru_unit",
+    "gru_group": "gru_group", "simple_gru": "simple_gru",
+    "simple_gru2": "simple_gru2", "bidirectional_gru": "bidirectional_gru",
+    "bidirectional_lstm": "bidirectional_lstm",
+}
+
+# helpers auto-named by their own __name__ (wrap_name_default() bare)
+_REF_NAMED_HELPERS = [
+    "fc_layer", "selective_fc_layer", "last_seq", "first_seq", "expand_layer",
+    "repeat_layer", "interpolation_layer", "bilinear_interp_layer",
+    "power_layer", "scaling_layer", "trans_layer", "rotate_layer", "cos_sim",
+    "hsigmoid", "sum_to_one_norm_layer", "row_l2_norm_layer",
+    "get_output_layer", "recurrent_layer", "maxid_layer", "out_prod_layer",
+    "eos_layer", "beam_search", "square_error_cost", "conv_shift_layer",
+    "sampling_id_layer", "slope_intercept_layer", "linear_comb_layer",
+    "block_expand_layer", "maxout_layer", "ctc_layer", "warp_ctc_layer",
+    "crf_layer", "crf_decoding_layer", "nce_layer", "rank_cost",
+    "lambda_cost", "cross_entropy", "cross_entropy_with_selfnorm",
+    "sum_cost", "huber_regression_cost", "huber_classification_cost",
+    "multi_binary_label_cross_entropy", "cross_entropy_over_beam",
+    "smooth_l1_cost", "multiplex_layer", "prelu_layer", "crop_layer",
+    "sub_nested_seq_layer", "seq_slice_layer", "gated_unit_layer",
+    "dot_prod_layer", "tensor_layer", "convex_comb_layer", "row_conv_layer",
+    "img_conv3d_layer", "data_norm_layer",
+]
+
+
+def _with_ref_default_name(fn, prefix):
+    import functools
+
+    from paddle_tpu.nn.graph import _auto_name
+
+    @functools.wraps(fn)
+    def named(*args, **kw):
+        if kw.get("name") is None:
+            kw["name"] = _auto_name(prefix)
+        return fn(*args, **kw)
+
+    return named
+
+
+def _install_ref_naming():
+    g = globals()
+    table = dict(_REF_NAME_PREFIX)
+    table.update({h: h for h in _REF_NAMED_HELPERS})
+    for helper, prefix in table.items():
+        fn = g.get(helper)
+        if callable(fn):
+            g[helper] = _with_ref_default_name(fn, prefix)
+
+
+_install_ref_naming()
+
+printer_layer = print_layer  # both spellings exist across reference versions
+kmax_seq_score_layer = kmax_sequence_score_layer
+
+# layer_math must import after the wrapped helpers exist (it resolves them
+# lazily, but importing it installs the Layer arithmetic operators)
+from paddle_tpu.config import layer_math  # noqa: E402
+
 __all__ = [
+    "printer_layer", "kmax_seq_score_layer", "layer_math",
+    "AggregateLevel", "ExpandLevel", "IdentityActivation",
+    "SqrtActivation", "ReciprocalActivation",
     # attrs / activations / poolings
     "ParamAttr", "ParameterAttribute", "ExtraLayerAttribute", "ExtraAttr",
     "LinearActivation", "SigmoidActivation", "SoftmaxActivation",

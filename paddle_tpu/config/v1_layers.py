@@ -40,7 +40,11 @@ __all__ = [
     "maxid_layer", "classification_cost", "cross_entropy",
     "img_conv_group", "simple_img_conv_pool", "sequence_conv_pool",
     "text_conv_pool", "simple_lstm", "simple_gru", "bidirectional_lstm",
-    "bidirectional_gru",
+    "bidirectional_gru", "last_seq", "first_seq", "expand_layer",
+    "ctc_layer", "warp_ctc_layer", "crf_layer", "crf_decoding_layer",
+    "nce_layer", "hsigmoid",
+    "seq_slice_layer", "kmax_sequence_score_layer", "seq_concat_layer",
+    "seq_reshape_layer", "sub_nested_seq_layer",
 ]
 
 
@@ -161,12 +165,17 @@ def _or_none(attr):
 
 def data_layer(name, size, depth=None, height=None, width=None,
                layer_attr=None):
-    """layers.py:916 — flat data slot; height/width declare image geometry."""
+    """layers.py:916 — flat data slot; height/width (/depth for 3D) declare
+    image geometry."""
     node = L.Data(name, shape=(int(size),), is_seq=False)
     _annotate(node, size=size)
     if height and width:
-        ch = int(size) // (int(height) * int(width))
-        node._v1_geom = (ch, int(height), int(width))
+        if depth:
+            ch = int(size) // (int(depth) * int(height) * int(width))
+            node._v1_geom3d = (ch, int(depth), int(height), int(width))
+        else:
+            ch = int(size) // (int(height) * int(width))
+            node._v1_geom = (ch, int(height), int(width))
     return node
 
 
@@ -218,8 +227,18 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
                    stride_y=None, padding_y=None, dilation_y=None,
                    trans=False, layer_type=None):
     """layers.py:2373 — act defaults to ReluActivation (@wrap_act_default);
-    non-square kernels via the *_y parameters; trans=True is deconv."""
+    non-square kernels via the *_y parameters or (x, y) pairs; trans=True is
+    deconv."""
     nhwc, (cin, h, w) = _ensure_nhwc(input, num_channels)
+    # the reference unpacks sequence args as (x, y) pairs (layers.py:2525)
+    if isinstance(filter_size, (tuple, list)):
+        filter_size, filter_size_y = filter_size
+    if isinstance(stride, (tuple, list)):
+        stride, stride_y = stride
+    if isinstance(padding, (tuple, list)):
+        padding, padding_y = padding
+    if isinstance(dilation, (tuple, list)):
+        dilation, dilation_y = dilation
     fy = filter_size_y if filter_size_y is not None else filter_size
     sy = stride_y if stride_y is not None else stride
     py = padding_y if padding_y is not None else padding
@@ -285,11 +304,26 @@ def batch_norm_layer(input, act=None, name=None, img3D=False,
     normalize per channel, so flat image data goes through the NHWC adapter
     first, matching CudnnBatchNorm's per-channel statistics)."""
     geom = getattr(input, "_v1_geom", None)
+    geom3d = getattr(input, "_v1_geom3d", None)
     node_in = input
-    if geom is not None or num_channels is not None:
+    if img3D and (geom3d is not None or num_channels is not None):
+        if geom3d is None:
+            size = _size_of(input)
+            side = round((size // num_channels) ** (1 / 3))
+            geom3d = (num_channels, side, side, side)
+        c, d, h, w = geom3d
+        cached = getattr(input, "_v1_ndhwc_node", None)
+        if cached is not None:
+            node_in = cached
+        else:
+            node_in = L.Reshape(input, (c, d, h, w), name=f"{input.name}.as_vol")
+            node_in = L.SwitchOrder(node_in, to="NDHWC", name=f"{input.name}.to_ndhwc")
+            input._v1_ndhwc_node = node_in
+    elif geom is not None or num_channels is not None:
         node_in, geom = _ensure_nhwc(input, num_channels)
     node = L.BatchNorm(
-        node_in, act=_act(act), epsilon=epsilon,
+        # @wrap_act_default(act=ReluActivation()) on the reference helper
+        node_in, act=_act(act) if act is not None else "relu", epsilon=epsilon,
         moving_average_fraction=moving_average_fraction,
         use_global_stats=use_global_stats, param_attr=_or_none(param_attr),
         bias_attr=_or_none(bias_attr), name=name,
@@ -370,35 +404,187 @@ def concat_layer(input, act=None, name=None, layer_attr=None, bias_attr=None):
 
 def pooling_layer(input, pooling_type=None, name=None, bias_attr=None,
                   agg_level=None, stride=-1, layer_attr=None):
-    """layers.py:1343 — sequence pooling; pooling_type defaults MaxPooling."""
-    if stride not in (-1, None):
-        raise NotImplementedError(
-            "pooling_layer stride>0 (windowed sequence pooling) is not "
-            "implemented; use stride=-1 (whole-sequence)"
-        )
+    """layers.py:1343 — sequence pooling; pooling_type defaults MaxPooling.
+    stride>0 pools fixed windows (SequencePoolLayer stride mode);
+    agg_level=AggregateLevel.TO_SEQUENCE pools within subsequences."""
     _mark_seq_root(input)
     nm = _pool_name(pooling_type) if pooling_type is not None else "max"
     seq_kind = {"max": "max", "avg": "average", "sum": "sum", "sqrt": "sqrt"}[nm]
-    node = S.SeqPool(input, seq_kind, name=name)
+    node = S.SeqPool(input, seq_kind, name=name, agg_level=agg_level,
+                     stride=-1 if stride is None else stride)
+    if getattr(pooling_type, "output_max_index", None):
+        node.output_max_index = True
     sz = _size_of(input)
     if sz is not None:
         _annotate(node, size=sz)
     return _with_drop(node, layer_attr)
 
 
+def last_seq(input, agg_level=None, stride=-1, name=None, layer_attr=None):
+    _mark_seq_root(input)
+    node = _v2.last_seq(input, agg_level=agg_level, stride=stride, name=name)
+    sz = _size_of(input)
+    if sz is not None:
+        _annotate(node, size=sz)
+    return _with_drop(node, layer_attr)
+
+
+def first_seq(input, agg_level=None, stride=-1, name=None, layer_attr=None):
+    _mark_seq_root(input)
+    node = _v2.first_seq(input, agg_level=agg_level, stride=stride, name=name)
+    sz = _size_of(input)
+    if sz is not None:
+        _annotate(node, size=sz)
+    return _with_drop(node, layer_attr)
+
+
+def expand_layer(input, expand_as, name=None, bias_attr=None,
+                 expand_level=None, layer_attr=None):
+    _mark_seq_root(expand_as)
+    node = _v2.expand(input, expand_as, expand_level=expand_level, name=name)
+    sz = _size_of(input)
+    if sz is not None:
+        _annotate(node, size=sz)
+    return _with_drop(node, layer_attr)
+
+
+def seq_slice_layer(input, starts=None, ends=None, name=None):
+    _mark_seq_root(input)
+    return _v2.seq_slice(input, starts=starts, ends=ends, name=name)
+
+
+def kmax_sequence_score_layer(input, name=None, beam_size=1):
+    _mark_seq_root(input)
+    return _v2.kmax_seq_score(input, beam_size=beam_size, name=name)
+
+
+def seq_concat_layer(a, b, act=None, name=None, layer_attr=None,
+                     bias_attr=None):
+    _mark_seq_root(a)
+    _mark_seq_root(b)
+    node = S.SeqConcat(a, b, name=name)
+    sz = _size_of(a)
+    if sz is not None:
+        _annotate(node, size=sz)
+    return _with_drop(node, layer_attr)
+
+
+def seq_reshape_layer(input, reshape_size, act=None, name=None,
+                      layer_attr=None, bias_attr=None):
+    _mark_seq_root(input)
+    node = _v2.seq_reshape(input, reshape_size, name=name)
+    _annotate(node, size=reshape_size)
+    return _with_drop(node, layer_attr)
+
+
+def sub_nested_seq_layer(input, selected_indices, name=None):
+    _mark_seq_root(input, nested=True)
+    return _v2.sub_nested_seq(input, selected_indices, name=name)
+
+
 def maxid_layer(input, name=None, layer_attr=None):
     return _with_drop(_v2.max_id(input, name=name), layer_attr)
 
 
-def _mark_seq_root(node: Layer) -> None:
+def _mark_label_as_id_seq(label: Layer) -> None:
+    """Sequence-label costs (ctc/crf): the label slot is an id sequence."""
+    from paddle_tpu.data.feeder import integer_value_sequence
+
+    if getattr(label, "type_name", None) == "data" and (
+        getattr(label, "data_type", None) is None
+        or label.data_type.kind in ("dense", "index")
+    ):
+        label.data_type = integer_value_sequence(_size_of(label) or 0)
+        label.shape = ()
+        label.is_seq = True
+
+
+def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
+              layer_attr=None):
+    """layers.py ctc_layer: size defaults to the input layer's size (the
+    alphabet incl. blank, CTCLayer.cpp)."""
+    _mark_seq_root(input)
+    _mark_label_as_id_seq(label)
+    size = size or _size_of(input)
+    return _with_drop(
+        _v2.ctc(input, label, size=size, norm_by_times=norm_by_times, name=name),
+        layer_attr,
+    )
+
+
+def warp_ctc_layer(input, label, size=None, name=None, blank=0,
+                   norm_by_times=False, layer_attr=None):
+    _mark_seq_root(input)
+    _mark_label_as_id_seq(label)
+    return _with_drop(
+        _v2.warp_ctc(input, label, size=size or _size_of(input), blank=blank,
+                     norm_by_times=norm_by_times, name=name),
+        layer_attr,
+    )
+
+
+def crf_layer(input, label, size=None, weight=None, param_attr=None,
+              name=None, coeff=1.0, layer_attr=None):
+    _mark_seq_root(input)
+    _mark_label_as_id_seq(label)
+    return _with_drop(
+        _v2.crf(input, label, size=size or _size_of(input),
+                param_attr=_or_none(param_attr), name=name, coeff=coeff),
+        layer_attr,
+    )
+
+
+def crf_decoding_layer(input, size=None, label=None, param_attr=None,
+                       name=None, layer_attr=None):
+    _mark_seq_root(input)
+    if label is not None:
+        _mark_label_as_id_seq(label)
+    return _with_drop(
+        _v2.crf_decoding(input, size=size or _size_of(input), label=label,
+                         param_attr=_or_none(param_attr), name=name),
+        layer_attr,
+    )
+
+
+def nce_layer(input, label, num_classes=None, weight=None, num_neg_samples=10,
+              neg_distribution=None, name=None, bias_attr=None,
+              param_attr=None, layer_attr=None):
+    """layers.py nce_layer: num_classes defaults to the label layer's size."""
+    _mark_label_as_ids(label)
+    if num_classes is None:
+        num_classes = _size_of(label) or 0
+    return _with_drop(
+        _v2.nce(input, label, num_classes, num_neg_samples=num_neg_samples,
+                neg_distribution=neg_distribution, bias_attr=bias_attr,
+                param_attr=_or_none(param_attr), name=name),
+        layer_attr,
+    )
+
+
+def hsigmoid(input, label, num_classes=None, name=None, bias_attr=None,
+             param_attr=None, layer_attr=None):
+    _mark_label_as_ids(label)
+    if num_classes is None:
+        num_classes = _size_of(label) or 0
+    return _with_drop(
+        _v2.hsigmoid(input, label, num_classes, bias_attr=bias_attr,
+                     param_attr=_or_none(param_attr), name=name),
+        layer_attr,
+    )
+
+
+def _mark_seq_root(node: Layer, nested: bool = False) -> None:
     """A sequence-consuming wrapper (seq pooling, lstm/gru, context conv)
     reveals that the data layers feeding it carry sequences — information the
     reference gets from the provider's input_types at runtime
     (PyDataProvider2 slot binding). Walk back to the data roots and mark
-    them, so shape inference and auto-built feeders produce [B, T, ...]."""
+    them, so shape inference and auto-built feeders produce [B, T, ...]
+    (nested=True → SUB_SEQUENCE slots, [B, S, T, ...])."""
     from paddle_tpu.data.feeder import (
         dense_vector_sequence,
+        dense_vector_sub_sequence,
         integer_value_sequence,
+        integer_value_sub_sequence,
     )
 
     seen = set()
@@ -412,9 +598,21 @@ def _mark_seq_root(node: Layer) -> None:
             cur.is_seq = True
             spec = getattr(cur, "data_type", None)
             if spec is not None and spec.kind == "index":
-                cur.data_type = integer_value_sequence(int(spec.dim))
+                cur.data_type = (
+                    integer_value_sub_sequence(int(spec.dim))
+                    if nested
+                    else integer_value_sequence(int(spec.dim))
+                )
             elif spec is not None and spec.kind == "dense":
-                cur.data_type = dense_vector_sequence(spec.dim)
+                cur.data_type = (
+                    dense_vector_sub_sequence(spec.dim)
+                    if nested
+                    else dense_vector_sequence(spec.dim)
+                )
+            elif spec is not None and spec.kind == "dense_seq" and nested:
+                cur.data_type = dense_vector_sub_sequence(spec.dim)
+            elif spec is not None and spec.kind == "index_seq" and nested:
+                cur.data_type = integer_value_sub_sequence(int(spec.dim))
             continue
         stack.extend(getattr(cur, "inputs", []) or [])
 

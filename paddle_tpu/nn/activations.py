@@ -98,3 +98,13 @@ def get(act: ActLike) -> Callable[[Array], Array]:
 
 def apply(act: ActLike, x: Array) -> Array:
     return get(act)(x)
+
+
+@register("sqrt")
+def sqrt(x: Array) -> Array:
+    return jnp.sqrt(x)
+
+
+@register("reciprocal")
+def reciprocal(x: Array) -> Array:
+    return 1.0 / x

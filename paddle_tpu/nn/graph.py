@@ -113,6 +113,10 @@ class ParamAttr:
     is_static: bool = False
     is_sparse: bool = False
     gradient_clipping_threshold: Optional[float] = None
+    # uniform init range (ParameterConfig initial_min/initial_max); wins over
+    # initial_std when set
+    initial_min: Optional[float] = None
+    initial_max: Optional[float] = None
     # Logical sharding axes for pjit (None → replicated), e.g. ("model", None).
     sharding: Optional[Tuple[Optional[str], ...]] = None
 
@@ -173,10 +177,21 @@ class Context:
     ) -> Array:
         attr = attr or ParamAttr()
         full = attr.name or f"{layer.name}.{pname}"
+        if not hasattr(self, "param_owners"):
+            self.param_owners = {}
+        self.param_owners.setdefault((layer.name, pname), full)
         if self.mode == "init":
             if full not in self.params:
                 initializer = attr.initializer or init
-                if attr.initial_std is not None and attr.initializer is None:
+                if attr.initial_max is not None and attr.initializer is None:
+                    lo = attr.initial_min if attr.initial_min is not None else -attr.initial_max
+                    hi = attr.initial_max
+                    initializer = (
+                        lambda k, s, d: jax.random.uniform(
+                            k, s, d, minval=lo, maxval=hi
+                        )
+                    )
+                elif attr.initial_std is not None and attr.initializer is None:
                     std, mean = attr.initial_std, attr.initial_mean
                     initializer = (
                         lambda k, s, d: mean + std * jax.random.normal(k, s, d)

@@ -27,6 +27,10 @@ Array = jax.Array
 def _attr(a: Optional[Union[ParamAttr, dict]]) -> Optional[ParamAttr]:
     if a is None or isinstance(a, ParamAttr):
         return a
+    if isinstance(a, bool):  # bias_attr=True/False toggles, carries no attrs
+        return None
+    if isinstance(a, (list, tuple)):  # per-input attrs (multi-input fc/mixed)
+        return [_attr(x) for x in a]
     return ParamAttr(**a)
 
 
@@ -81,8 +85,11 @@ class Fc(Layer):
                 x = x.reshape(x.shape[0], -1)
             d = x.shape[-1]
             suffix = "" if len(ins) == 1 else f".{i}"
+            pa = self.param_attr
+            if isinstance(pa, list):
+                pa = pa[i] if i < len(pa) else None
             w = ctx.param(
-                self, "w" + suffix, (d, self.size), init_mod.smart_normal, self.param_attr
+                self, "w" + suffix, (d, self.size), init_mod.smart_normal, pa
             )
             y = linalg.matmul(x, w, ctx.policy)
             total = y if total is None else total + y
@@ -878,28 +885,64 @@ class SwitchOrder(Layer):
 
     def __init__(self, input: Layer, to: str = "NCHW", name=None):
         super().__init__(input, name=name)
-        assert to in ("NCHW", "NHWC")
+        assert to in ("NCHW", "NHWC", "NCDHW", "NDHWC")
         self.to = to
 
     def forward(self, ctx, ins):
         x = ins[0].value
-        perm = (0, 3, 1, 2) if self.to == "NCHW" else (0, 2, 3, 1)
+        perm = {
+            "NCHW": (0, 3, 1, 2),
+            "NHWC": (0, 2, 3, 1),
+            "NCDHW": (0, 4, 1, 2, 3),
+            "NDHWC": (0, 2, 3, 4, 1),
+        }[self.to]
         return ins[0].with_value(jnp.transpose(x, perm))
 
 
 @LAYERS.register("feature_map_expand")
 class FeatureMapExpand(Layer):
-    """Tile a [B, D] vector across feature-map positions (FeatureMapExpandLayer)."""
+    """Tile a [B, D] vector across feature-map positions
+    (FeatureMapExpandLayer.cpp). as_row_vector=True tiles whole rows
+    [a b c a b c]; False repeats each element [a a b b c c]."""
 
     type_name = "feature_map_expand"
 
-    def __init__(self, input: Layer, num_filters: int, name=None):
+    def __init__(self, input: Layer, num_filters: int, as_row_vector: bool = True,
+                 act: Any = None, name=None):
         super().__init__(input, name=name)
         self.num_filters = num_filters
+        self.as_row_vector = as_row_vector
+        self.act = act
 
     def forward(self, ctx, ins):
         x = ins[0].value
-        return ins[0].with_value(jnp.repeat(x[:, None, :], self.num_filters, axis=1).reshape(x.shape[0], -1))
+        if self.as_row_vector:
+            out = jnp.repeat(x[:, None, :], self.num_filters, axis=1)
+            out = out.reshape(x.shape[0], -1)
+        else:
+            out = jnp.repeat(x, self.num_filters, axis=-1)
+        return ins[0].with_value(act_mod.apply(self.act, out))
+
+
+@LAYERS.register("resize")
+class Resize(Layer):
+    """ResizeLayer.cpp: reinterpret the whole [B, D] buffer as
+    [B*D/size, size] — batch and feature trade off."""
+
+    type_name = "resize"
+
+    def __init__(self, input: Layer, size: int, name=None):
+        super().__init__(input, name=name)
+        self.size = size
+
+    def forward(self, ctx, ins):
+        x = ins[0].value
+        total = x.size
+        assert total % self.size == 0, (
+            f"resize {self.name}: {tuple(x.shape)} has {total} elements, "
+            f"not divisible by size={self.size}"
+        )
+        return Argument(x.reshape(-1, self.size))
 
 
 @LAYERS.register("clip")
@@ -918,15 +961,26 @@ class Clip(Layer):
 
 @LAYERS.register("scale_shift")
 class ScaleShift(Layer):
-    """y = w*x + b with scalar learned w,b (ScaleShiftLayer.cpp)."""
+    """y = w*x + b with scalar learned w, optional scalar b
+    (ScaleShiftLayer.cpp: bias only when biasParameter is set)."""
 
     type_name = "scale_shift"
 
+    def __init__(self, input: Layer, bias: bool = True, param_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__(input, name=name)
+        self.bias = bias
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
+
     def forward(self, ctx, ins):
         x = ins[0].value
-        w = ctx.param(self, "w", (1,), init_mod.ones, None)
-        b = ctx.param(self, "b", (1,), init_mod.zeros, None)
-        return ins[0].with_value(w[0] * x + b[0])
+        w = ctx.param(self, "w", (1,), init_mod.ones, self.param_attr)
+        y = w[0] * x
+        if self.bias:
+            b = ctx.param(self, "b", (1,), init_mod.zeros, self.bias_attr)
+            y = y + b[0]
+        return ins[0].with_value(y)
 
 
 @LAYERS.register("prelu")
@@ -936,15 +990,16 @@ class ParameterRelu(Layer):
 
     type_name = "prelu"
 
-    def __init__(self, input: Layer, partial_sum: int = 1, name=None):
+    def __init__(self, input: Layer, partial_sum: int = 1, param_attr=None, name=None):
         super().__init__(input, name=name)
         self.partial_sum = partial_sum
+        self.param_attr = _attr(param_attr)
 
     def forward(self, ctx, ins):
         x = ins[0].value
         d = x.shape[-1]
         n_slope = d // self.partial_sum
-        w = ctx.param(self, "w", (n_slope,), init_mod.constant(0.25), None)
+        w = ctx.param(self, "w", (n_slope,), init_mod.constant(0.25), self.param_attr)
         slopes = jnp.repeat(w, self.partial_sum)
         return ins[0].with_value(jnp.where(x > 0, x, x * slopes))
 
@@ -1020,17 +1075,25 @@ class TensorLayer(Layer):
 
     type_name = "tensor"
 
-    def __init__(self, input1: Layer, input2: Layer, size: int, act=None, name=None):
+    def __init__(self, input1: Layer, input2: Layer, size: int, act=None,
+                 bias: bool = True, param_attr=None, bias_attr=None, name=None):
         super().__init__([input1, input2], name=name)
         self.size = size
         self.act = act
+        self.bias = bias
+        self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
 
     def forward(self, ctx, ins):
         x, y = ins[0].value, ins[1].value
         w = ctx.param(
-            self, "w", (self.size, x.shape[-1], y.shape[-1]), init_mod.smart_normal, None
+            self, "w", (self.size, x.shape[-1], y.shape[-1]),
+            init_mod.smart_normal, self.param_attr,
         )
         out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+        if self.bias:
+            b = ctx.param(self, "b", (self.size,), init_mod.zeros, self.bias_attr)
+            out = out + b
         out = act_mod.apply(self.act, out)
         return ins[0].with_value(out)
 
@@ -1097,6 +1160,8 @@ class PrintLayer(Layer):
         self.message = message
 
     def forward(self, ctx, ins):
+        if ctx.mode == "init":  # config tracing/shape inference: stay quiet
+            return ins[0]
         # escape user braces — only the {x} placeholder is a format field
         msg = self.message.replace("{", "{{").replace("}", "}}")
         jax.debug.print((msg + " {x}").lstrip(), x=ins[0].value)

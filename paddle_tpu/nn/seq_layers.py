@@ -8,7 +8,7 @@ batches with masks (the TPU encoding of Argument.sequenceStartPositions)."""
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -18,28 +18,74 @@ from paddle_tpu.nn.graph import Argument, Context, Layer
 from paddle_tpu.ops import sequence as seq_ops
 
 
+def _seq_view(arg: Argument):
+    """(values [B,T,...], lengths [B]) — a non-seq input is a length-1
+    sequence (the reference's SequencePoolLayer tolerates NO_SEQUENCE)."""
+    if arg.lengths is None:
+        v = arg.value[:, None]
+        return v, jnp.ones((v.shape[0],), jnp.int32)
+    return arg.value, arg.lengths
+
+
+def _strided_windows(x, lengths, stride: int):
+    """Split [B,T,...] into fixed windows of `stride` steps →
+    (windows [B,W,stride,...], per-window valid counts [B,W] clamped ≥1,
+    output lengths ceil(len/stride)) — SequencePoolLayer.cpp stride mode."""
+    b, t = x.shape[:2]
+    n_win = -(-t // stride)
+    pad = n_win * stride - t
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)) + ((0, 0),) * (x.ndim - 2))
+    win = x.reshape((b, n_win, stride) + x.shape[2:])
+    starts = jnp.arange(n_win) * stride
+    wlen = jnp.clip(lengths[:, None] - starts[None, :], 1, stride)
+    return win, wlen, -(-lengths // stride)
+
+
+_POOL_FNS = {
+    "sum": seq_ops.seq_sum,
+    "average": seq_ops.seq_mean,
+    "avg": seq_ops.seq_mean,
+    "max": seq_ops.seq_max,
+    "sqrt": seq_ops.seq_sqrt_pool,
+}
+
+
 @LAYERS.register("seq_pool")
 class SeqPool(Layer):
-    """SequencePoolLayer: pool over time → [B, D]."""
+    """SequencePoolLayer: pool over time → [B, D]. agg_level="seq"
+    (AggregateLevel.TO_SEQUENCE) pools within each subsequence of a nested
+    input → level-1 sequence; stride>0 pools fixed windows of `stride` steps
+    → sequence of window results (SequencePoolLayer.cpp stride support)."""
 
     type_name = "seq_pool"
 
-    def __init__(self, input: Layer, pool_type: str = "sum", name=None):
+    def __init__(self, input: Layer, pool_type: str = "sum", name=None,
+                 agg_level: str = "non-seq", stride: int = -1):
         super().__init__(input, name=name)
         assert pool_type in ("sum", "average", "avg", "max", "sqrt")
         self.pool_type = pool_type
+        self.agg_level = agg_level or "non-seq"
+        self.stride = stride if stride and stride > 0 else -1
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         arg = ins[0]
-        assert arg.is_seq, f"{self.name}: needs sequence input"
-        fn = {
-            "sum": seq_ops.seq_sum,
-            "average": seq_ops.seq_mean,
-            "avg": seq_ops.seq_mean,
-            "max": seq_ops.seq_max,
-            "sqrt": seq_ops.seq_sqrt_pool,
-        }[self.pool_type]
-        return Argument(fn(arg.value, arg.lengths))
+        fn = _POOL_FNS[self.pool_type]
+        if self.agg_level == "seq":
+            if arg.sub_lengths is not None and arg.value.ndim > 2:
+                # [B,S,T,...] → pool each subsequence → [B,S,...]
+                pooled = jax.vmap(fn, in_axes=1, out_axes=1)(
+                    arg.value, arg.sub_lengths
+                )
+                return Argument(pooled, arg.lengths)
+            x, lengths = _seq_view(arg)
+            return Argument(fn(x, lengths)[:, None], jnp.ones_like(lengths))
+        x, lengths = _seq_view(arg)
+        if self.stride > 0:
+            win, wlen, out_len = _strided_windows(x, lengths, self.stride)
+            pooled = jax.vmap(fn, in_axes=1, out_axes=1)(win, wlen)
+            return Argument(pooled, out_len)
+        return Argument(fn(x, lengths))
 
 
 def _last_valid_subseq(arg: Argument):
@@ -52,35 +98,66 @@ def _last_valid_subseq(arg: Argument):
     return sub, sub_len
 
 
+class _SeqInstance(Layer):
+    """SequenceLastInstanceLayer (select_first toggles last/first).
+    agg_level="seq" picks per-subsequence instances of a nested input →
+    level-1 sequence; stride>0 picks one instance per fixed window →
+    sequence of window instances (SequenceLastInstanceLayer.cpp)."""
+
+    select_first = False
+
+    def __init__(self, input: Layer, name=None, agg_level: str = "non-seq",
+                 stride: int = -1):
+        super().__init__(input, name=name)
+        self.agg_level = agg_level or "non-seq"
+        self.stride = stride if stride and stride > 0 else -1
+
+    def _pick(self, x, lengths):
+        if self.select_first:
+            return seq_ops.seq_first(x)
+        return seq_ops.seq_last(x, lengths)
+
+    def forward(self, ctx, ins):
+        arg = ins[0]
+        if arg.sub_lengths is not None and arg.value.ndim > 2:
+            if self.agg_level == "seq":
+                # one instance per subsequence → [B, S, ...] sequence
+                pick = jax.vmap(self._pick, in_axes=1, out_axes=1)
+                return Argument(
+                    pick(arg.value, arg.sub_lengths), arg.lengths
+                )
+            if self.select_first:
+                return Argument(seq_ops.seq_first(arg.value[:, 0]))
+            sub, sub_len = _last_valid_subseq(arg)
+            return Argument(seq_ops.seq_last(sub, sub_len))
+        x, lengths = _seq_view(arg)
+        if self.agg_level == "seq":
+            return Argument(self._pick(x, lengths)[:, None], jnp.ones_like(lengths))
+        if self.stride > 0:
+            win, wlen, out_len = _strided_windows(x, lengths, self.stride)
+            pick = jax.vmap(self._pick, in_axes=1, out_axes=1)
+            return Argument(pick(win, wlen), out_len)
+        return Argument(self._pick(x, lengths))
+
+
 @LAYERS.register("last_seq")
-class LastSeq(Layer):
+class LastSeq(_SeqInstance):
     """SequenceLastInstanceLayer. On a nested sequence the default (non-seq)
     aggregation spans the whole flat token stream — the last valid token of
     the last valid subsequence (SequenceLastInstanceLayer.cpp uses the outer
     sequenceStartPositions)."""
 
     type_name = "last_seq"
-
-    def forward(self, ctx, ins):
-        arg = ins[0]
-        if arg.sub_lengths is not None and arg.value.ndim > 2:
-            sub, sub_len = _last_valid_subseq(arg)
-            return Argument(seq_ops.seq_last(sub, sub_len))
-        return Argument(seq_ops.seq_last(arg.value, arg.lengths))
+    select_first = False
 
 
 @LAYERS.register("first_seq")
-class FirstSeq(Layer):
+class FirstSeq(_SeqInstance):
     """SequenceLastInstanceLayer with select_first=True. On a nested sequence:
     first token of the first subsequence."""
 
     type_name = "first_seq"
-
-    def forward(self, ctx, ins):
-        arg = ins[0]
-        if arg.sub_lengths is not None and arg.value.ndim > 2:
-            return Argument(seq_ops.seq_first(arg.value[:, 0]))
-        return Argument(seq_ops.seq_first(arg.value))
+    select_first = True
 
 
 @LAYERS.register("expand")
@@ -90,8 +167,10 @@ class Expand(Layer):
 
     type_name = "expand"
 
-    def __init__(self, input: Layer, expand_as: Layer, name=None):
+    def __init__(self, input: Layer, expand_as: Layer, name=None,
+                 expand_level: str = "non-seq"):
         super().__init__([input, expand_as], name=name)
+        self.expand_level = expand_level or "non-seq"
 
     def forward(self, ctx, ins):
         x, ref = ins[0], ins[1]
@@ -154,19 +233,55 @@ class SeqReshape(Layer):
 
 @LAYERS.register("seq_slice")
 class SeqSlice(Layer):
-    """SequenceSliceLayer: keep the first/last k steps of each sequence."""
+    """SequenceSliceLayer: keep the first/last k steps of each sequence
+    (k mode), or cut [start, end) windows given by companion integer layers
+    (SequenceSliceLayer.cpp: starts/ends hold K offsets per sequence →
+    K sub-slices, a nested sequence here)."""
 
     type_name = "seq_slice"
 
-    def __init__(self, input: Layer, k: int, from_start: bool = True, name=None):
-        super().__init__(input, name=name)
+    def __init__(self, input: Layer, k: Optional[int] = None,
+                 from_start: bool = True, starts: Optional[Layer] = None,
+                 ends: Optional[Layer] = None, name=None):
+        extra = [l for l in (starts, ends) if l is not None]
+        super().__init__([input] + extra, name=name)
+        if k is None and not extra:
+            raise ValueError(f"{name}: seq_slice needs k= or starts=/ends=")
         self.k = k
         self.from_start = from_start
+        self.has_starts = starts is not None
+        self.has_ends = ends is not None
 
     def forward(self, ctx, ins):
         arg = ins[0]
         x, lengths = arg.value, arg.lengths
         b, t = x.shape[:2]
+        if self.has_starts or self.has_ends:
+            nxt = 1
+            if self.has_starts:
+                starts = ins[nxt].value.astype(jnp.int32)
+                nxt += 1
+            else:
+                starts = None
+            ends = ins[nxt].value.astype(jnp.int32) if self.has_ends else None
+            if starts is None:
+                starts = jnp.zeros_like(ends)
+            if ends is None:
+                ends = jnp.broadcast_to(lengths[:, None], starts.shape)
+            k = starts.shape[1]  # K slices per row
+            # slice s of row i = x[i, starts[i,s] : ends[i,s]+? )  (inclusive
+            # end per SequenceSliceLayer semantics: ends is the last index)
+            idx = starts[:, :, None] + jnp.arange(t)[None, None, :]
+            idx_c = jnp.minimum(idx, t - 1)
+            gat = jnp.take_along_axis(
+                x[:, None],
+                idx_c.reshape(idx_c.shape + (1,) * (x.ndim - 2)),
+                axis=2,
+            )
+            sub_len = jnp.clip(ends - starts + 1, 1, t)
+            return Argument(
+                gat, jnp.full((b,), k, jnp.int32), sub_len
+            )
         k = min(self.k, t)
         new_len = jnp.minimum(lengths, k)
         if self.from_start:

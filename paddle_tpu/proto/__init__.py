@@ -45,7 +45,13 @@ def _emit(value: Any, name: str, indent: int, out: List[str]) -> None:
             _emit(item, name, indent, out)
     elif isinstance(value, dict):
         # free-form extras: emitted as key: value pairs under the field name
-        body = [f"{pad}  {k}: {_scalar(v)}" for k, v in sorted(value.items())]
+        # (list values unrolled to repeated scalar lines, text-proto style)
+        body = []
+        for k, v in sorted(value.items()):
+            if isinstance(v, (list, tuple)):
+                body.extend(f"{pad}  {k}: {_scalar(x)}" for x in v)
+            else:
+                body.append(f"{pad}  {k}: {_scalar(v)}")
         out.append(f"{pad}{name} {{")
         out.extend(body)
         out.append(f"{pad}}}")
@@ -110,6 +116,183 @@ class ParameterConfig:
 
 
 @dataclass
+class ConvConfig:
+    """proto/ModelConfig.proto:38 (x = width, y = height)."""
+
+    filter_size: int = 0
+    channels: int = 0
+    stride: int = 1
+    padding: int = 0
+    groups: int = 1
+    filter_channels: int = 0
+    output_x: int = 0
+    img_size: int = 0
+    caffe_mode: bool = True
+    filter_size_y: int = 0
+    padding_y: int = 0
+    stride_y: int = 1
+    output_y: Optional[int] = None
+    img_size_y: Optional[int] = None
+    dilation: Optional[int] = None
+    dilation_y: Optional[int] = None
+    filter_size_z: Optional[int] = None
+    padding_z: Optional[int] = None
+    stride_z: Optional[int] = None
+    output_z: Optional[int] = None
+    img_size_z: Optional[int] = None
+
+
+@dataclass
+class PoolConfig:
+    """proto/ModelConfig.proto:96."""
+
+    pool_type: str = ""
+    channels: int = 0
+    size_x: int = 0
+    stride: int = 1
+    output_x: int = 0
+    img_size: int = 0
+    padding: int = 0
+    size_y: Optional[int] = None
+    stride_y: Optional[int] = None
+    output_y: Optional[int] = None
+    img_size_y: Optional[int] = None
+    padding_y: Optional[int] = None
+    size_z: Optional[int] = None
+    stride_z: Optional[int] = None
+    output_z: Optional[int] = None
+    img_size_z: Optional[int] = None
+    padding_z: Optional[int] = None
+
+
+@dataclass
+class NormConfig:
+    """proto/ModelConfig.proto:149."""
+
+    norm_type: str = ""
+    channels: int = 0
+    size: int = 0
+    scale: float = 0.0
+    pow: float = 0.0
+    output_x: int = 0
+    img_size: int = 0
+    blocked: bool = False
+    output_y: Optional[int] = None
+    img_size_y: Optional[int] = None
+
+
+@dataclass
+class ImageConfig:
+    """proto/ModelConfig.proto:259."""
+
+    channels: int = 0
+    img_size: int = 0
+    img_size_y: Optional[int] = None
+    img_size_z: Optional[int] = None
+
+
+@dataclass
+class BlockExpandConfig:
+    """proto/ModelConfig.proto:184."""
+
+    channels: int = 0
+    stride_x: int = 0
+    stride_y: int = 0
+    padding_x: int = 0
+    padding_y: int = 0
+    block_x: int = 0
+    block_y: int = 0
+    output_x: int = 0
+    output_y: int = 0
+    img_size_x: int = 0
+    img_size_y: int = 0
+
+
+@dataclass
+class MaxOutConfig:
+    image_conf: Optional[ImageConfig] = None
+    groups: int = 0
+
+
+@dataclass
+class SppConfig:
+    image_conf: Optional[ImageConfig] = None
+    pool_type: str = ""
+    pyramid_height: int = 0
+
+
+@dataclass
+class BilinearInterpConfig:
+    image_conf: Optional[ImageConfig] = None
+    out_size_x: int = 0
+    out_size_y: int = 0
+
+
+@dataclass
+class PadConfig:
+    image_conf: Optional[ImageConfig] = None
+    pad_c: List[int] = field(default_factory=list)
+    pad_h: List[int] = field(default_factory=list)
+    pad_w: List[int] = field(default_factory=list)
+
+
+@dataclass
+class RowConvConfig:
+    context_length: int = 0
+
+
+@dataclass
+class ClipConfig:
+    min: float = 0.0
+    max: float = 0.0
+
+
+@dataclass
+class PriorBoxConfig:
+    min_size: List[int] = field(default_factory=list)
+    max_size: List[int] = field(default_factory=list)
+    aspect_ratio: List[float] = field(default_factory=list)
+    variance: List[float] = field(default_factory=list)
+
+
+@dataclass
+class MultiBoxLossConfig:
+    num_classes: int = 0
+    overlap_threshold: float = 0.0
+    neg_pos_ratio: float = 0.0
+    neg_overlap: float = 0.0
+    background_id: int = 0
+    input_num: int = 0
+    height: Optional[int] = None
+    width: Optional[int] = None
+
+
+@dataclass
+class DetectionOutputConfig:
+    num_classes: int = 0
+    nms_threshold: float = 0.0
+    nms_top_k: int = 0
+    background_id: int = 0
+    input_num: int = 0
+    keep_top_k: int = 0
+    confidence_threshold: float = 0.0
+    height: Optional[int] = None
+    width: Optional[int] = None
+
+
+@dataclass
+class ReshapeConfig:
+    height_axis: List[int] = field(default_factory=list)
+    width_axis: List[int] = field(default_factory=list)
+
+
+@dataclass
+class SliceConfig:
+    start: int = 0
+    end: int = 0
+
+
+@dataclass
 class ProjectionConfig:
     type: str = ""
     name: str = ""
@@ -117,6 +300,12 @@ class ProjectionConfig:
     output_size: int = 0
     context_start: Optional[int] = None
     context_length: Optional[int] = None
+    trainable_padding: Optional[bool] = None
+    conv_conf: Optional[ConvConfig] = None
+    num_filters: Optional[int] = None
+    offset: Optional[int] = None
+    pool_conf: Optional[PoolConfig] = None
+    slices: List[SliceConfig] = field(default_factory=list)
 
 
 @dataclass
@@ -125,27 +314,95 @@ class OperatorConfig:
     input_indices: List[int] = field(default_factory=list)
     input_sizes: List[int] = field(default_factory=list)
     output_size: int = 0
+    dotmul_scale: Optional[float] = None
+    conv_conf: Optional[ConvConfig] = None
+    num_filters: Optional[int] = None
 
 
 @dataclass
 class LayerInputConfig:
+    """proto/ModelConfig.proto:319."""
+
     input_layer_name: str = ""
     input_parameter_name: Optional[str] = None
+    conv_conf: Optional[ConvConfig] = None
+    pool_conf: Optional[PoolConfig] = None
+    norm_conf: Optional[NormConfig] = None
     proj_conf: Optional[ProjectionConfig] = None
+    block_expand_conf: Optional[BlockExpandConfig] = None
+    image_conf: Optional[ImageConfig] = None
+    input_layer_argument: Optional[str] = None
+    bilinear_interp_conf: Optional[BilinearInterpConfig] = None
+    maxout_conf: Optional[MaxOutConfig] = None
+    spp_conf: Optional[SppConfig] = None
+    priorbox_conf: Optional[PriorBoxConfig] = None
+    pad_conf: Optional[PadConfig] = None
+    row_conv_conf: Optional[RowConvConfig] = None
+    multibox_loss_conf: Optional[MultiBoxLossConfig] = None
+    detection_output_conf: Optional[DetectionOutputConfig] = None
+    clip_conf: Optional[ClipConfig] = None
 
 
 @dataclass
 class LayerConfig:
+    """proto/ModelConfig.proto:347 — typed field set of the reference's
+    LayerConfig (fields this runtime has no use for are still modeled so
+    golden protostrs diff structurally; see config/protostr.py)."""
+
     name: str = ""
     type: str = ""
     size: int = 0
-    active_type: Optional[str] = None
+    active_type: str = ""
     inputs: List[LayerInputConfig] = field(default_factory=list)
     bias_parameter_name: Optional[str] = None
+    num_filters: Optional[int] = None
+    shared_biases: Optional[bool] = None
+    partial_sum: Optional[int] = None
     drop_rate: Optional[float] = None
-    shape: List[int] = field(default_factory=list)  # full output shape sans batch
+    num_classes: Optional[int] = None
+    reversed: Optional[bool] = None
+    active_gate_type: Optional[str] = None
+    active_state_type: Optional[str] = None
+    num_neg_samples: Optional[int] = None
+    neg_sampling_dist: List[float] = field(default_factory=list)
+    output_max_index: Optional[bool] = None
+    softmax_selfnorm_alpha: Optional[float] = None
+    directions: List[bool] = field(default_factory=list)
+    norm_by_times: Optional[bool] = None
+    coeff: Optional[float] = None
+    average_strategy: Optional[str] = None
+    error_clipping_threshold: Optional[float] = None
     operator_confs: List[OperatorConfig] = field(default_factory=list)
-    # free-form layer-specific attributes (filter_size, stride, ...)
+    NDCG_num: Optional[int] = None
+    max_sort_size: Optional[int] = None
+    slope: Optional[float] = None
+    intercept: Optional[float] = None
+    cos_scale: Optional[float] = None
+    data_norm_strategy: Optional[str] = None
+    bos_id: Optional[int] = None
+    eos_id: Optional[int] = None
+    beam_size: Optional[int] = None
+    select_first: Optional[bool] = None
+    trans_type: Optional[str] = None
+    selective_fc_pass_generation: Optional[bool] = None
+    has_selected_colums: Optional[bool] = None
+    selective_fc_full_mul_ratio: Optional[float] = None
+    use_global_stats: Optional[bool] = None
+    moving_average_fraction: Optional[float] = None
+    bias_size: Optional[int] = None
+    user_arg: Optional[str] = None
+    height: Optional[int] = None
+    width: Optional[int] = None
+    blank: Optional[int] = None
+    seq_pool_stride: Optional[int] = None
+    axis: Optional[int] = None
+    offset: List[int] = field(default_factory=list)
+    shape: List[int] = field(default_factory=list)  # crop layer (proto field 56)
+    delta: Optional[float] = None
+    depth: Optional[int] = None
+    reshape_conf: Optional[ReshapeConfig] = None
+    # free-form layer-specific attributes with no reference field; kept out
+    # of the typed surface so protostr output stays reference-shaped
     attrs: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -175,12 +432,34 @@ class EvaluatorConfig:
 
 
 @dataclass
+class LinkConfig:
+    layer_name: str = ""
+    link_name: str = ""
+
+
+@dataclass
+class MemoryConfig:
+    link_name: str = ""
+    layer_name: str = ""
+    boot_layer_name: Optional[str] = None
+    boot_bias_parameter_name: Optional[str] = None
+    boot_bias_active_type: Optional[str] = None
+    boot_with_const_id: Optional[int] = None
+    is_sequence: Optional[bool] = None
+
+
+@dataclass
 class SubModelConfig:
     name: str = ""
     layer_names: List[str] = field(default_factory=list)
     input_layer_names: List[str] = field(default_factory=list)
     output_layer_names: List[str] = field(default_factory=list)
     is_recurrent_layer_group: bool = False
+    reversed: Optional[bool] = None
+    memories: List[MemoryConfig] = field(default_factory=list)
+    in_links: List[LinkConfig] = field(default_factory=list)
+    out_links: List[LinkConfig] = field(default_factory=list)
+    target_inlinkid: Optional[int] = None
 
 
 @dataclass
@@ -251,4 +530,9 @@ __all__ = [
     "ParameterConfig", "ProjectionConfig", "OperatorConfig", "LayerInputConfig",
     "LayerConfig", "EvaluatorConfig", "SubModelConfig", "ModelConfig",
     "OptimizationConfig", "DataConfig", "TrainerConfig", "to_text", "to_dict",
+    "ConvConfig", "PoolConfig", "NormConfig", "ImageConfig",
+    "BlockExpandConfig", "MaxOutConfig", "SppConfig", "BilinearInterpConfig",
+    "PadConfig", "RowConvConfig", "ClipConfig", "PriorBoxConfig",
+    "MultiBoxLossConfig", "DetectionOutputConfig", "ReshapeConfig",
+    "SliceConfig", "LinkConfig", "MemoryConfig",
 ]

@@ -34,6 +34,9 @@ Abs = _make("abs")
 Square = _make("square")
 Exp = _make("exponential")
 Log = _make("log")
+Sqrt = _make("sqrt")
+Reciprocal = _make("reciprocal")
+Identity = Linear  # IdentityActivation is the reference's alias for linear
 
 
 def resolve(act) -> str:

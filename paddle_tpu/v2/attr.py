@@ -20,11 +20,15 @@ def Param(
     gradient_clipping_threshold: Optional[float] = None,
     sharding: Any = None,
     initializer: Any = None,
+    initial_min: Optional[float] = None,
+    initial_max: Optional[float] = None,
 ) -> _GraphParamAttr:
     """ParameterAttribute factory keeping the reference's knob names."""
     return _GraphParamAttr(
         name=name,
         initializer=initializer,
+        initial_min=initial_min,
+        initial_max=initial_max,
         initial_std=initial_std,
         initial_mean=initial_mean,
         learning_rate=learning_rate,

@@ -235,28 +235,32 @@ def _pool_seq(p) -> str:
     return {"max": "max", "avg": "average", "sum": "sum", "sqrt": "sqrt"}[nm]
 
 
-def last_seq(input, name=None, **_compat):
-    return S.LastSeq(input, name=name)
+def last_seq(input, agg_level=None, stride=-1, name=None, **_compat):
+    return S.LastSeq(input, agg_level=agg_level, stride=stride, name=name)
 
 
-def first_seq(input, name=None, **_compat):
-    return S.FirstSeq(input, name=name)
+def first_seq(input, agg_level=None, stride=-1, name=None, **_compat):
+    return S.FirstSeq(input, agg_level=agg_level, stride=stride, name=name)
 
 
-def expand(input, expand_as, name=None, **_compat):
-    return S.Expand(input, expand_as, name=name)
+def expand(input, expand_as, expand_level=None, name=None, **_compat):
+    return S.Expand(input, expand_as, expand_level=expand_level, name=name)
 
 
-def repeat(input, num_repeats, name=None):
-    return L.FeatureMapExpand(input, num_repeats, name=name)
+def repeat(input, num_repeats, as_row_vector=True, act=None, name=None, **_compat):
+    return L.FeatureMapExpand(input, num_repeats, as_row_vector=as_row_vector,
+                              act=_act(act), name=name)
 
 
 def seq_reshape(input, reshape_size, name=None):
     return S.SeqReshape(input, reshape_size, name=name)
 
 
-def seq_slice(input, k, from_start=True, name=None):
-    return S.SeqSlice(input, k, from_start=from_start, name=name)
+def seq_slice(input, k=None, from_start=True, starts=None, ends=None, name=None):
+    starts = None if starts is False else starts
+    ends = None if ends is False else ends
+    return S.SeqSlice(input, k, from_start=from_start, starts=starts,
+                      ends=ends, name=name)
 
 
 def kmax_seq_score(input, beam_size=1, name=None):
@@ -378,8 +382,9 @@ def conv_shift(a, b, name=None):
     return L.ConvShift(a, b, name=name)
 
 
-def tensor(a, b, size, act=None, param_attr=None, name=None, **_compat):
-    return L.TensorLayer(a, b, size, act=_act(act), name=name)
+def tensor(a, b, size, act=None, param_attr=None, bias_attr=None, name=None, **_compat):
+    return L.TensorLayer(a, b, size, act=_act(act), bias=bias_attr is not False,
+                         param_attr=param_attr, bias_attr=bias_attr, name=name)
 
 
 def multiplex(input, name=None):
@@ -408,7 +413,8 @@ def clip(input, min, max, name=None):
 
 
 def scale_shift(input, param_attr=None, bias_attr=None, name=None):
-    return L.ScaleShift(input, name=name)
+    return L.ScaleShift(input, bias=bias_attr is not False,
+                        param_attr=param_attr, bias_attr=bias_attr, name=name)
 
 
 def prelu(input, partial_sum=1, param_attr=None, name=None):
@@ -474,7 +480,7 @@ def featmap_expand(input, num_filters, name=None):
 
 
 def resize(input, size, name=None):
-    return L.Reshape(input, (size,), name=name)
+    return L.Resize(input, size, name=name)
 
 
 def block_expand(input, block_x, block_y, stride_x=None, stride_y=None,

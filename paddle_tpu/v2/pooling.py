@@ -8,19 +8,38 @@ class BasePoolingType:
 
 
 class Max(BasePoolingType):
+    """poolings.py MaxPooling; output_max_index mirrors MaxLayer's
+    argmax-output mode (accepted; the index output is maxid semantics)."""
+
     name = "max"
+
+    def __init__(self, output_max_index=None):
+        self.output_max_index = output_max_index
 
 
 class Avg(BasePoolingType):
+    STRATEGY_AVG = "average"
+    STRATEGY_SUM = "sum"
+    STRATEGY_SQROOTN = "squarerootn"
+
     name = "avg"
 
+    def __init__(self, strategy=STRATEGY_AVG):
+        self.name = {"average": "avg", "sum": "sum", "squarerootn": "sqrt"}[strategy]
 
-class Sum(BasePoolingType):
+
+class Sum(Avg):
     name = "sum"
 
+    def __init__(self):
+        super().__init__(Avg.STRATEGY_SUM)
 
-class SquareRootN(BasePoolingType):
+
+class SquareRootN(Avg):
     name = "sqrt"
+
+    def __init__(self):
+        super().__init__(Avg.STRATEGY_SQROOTN)
 
 
 # cuDNN variants in the reference are just kernels for the same math

@@ -21,6 +21,9 @@ class Topology:
     def __init__(self, layers: Union[Layer, Sequence[Layer]], extra_layers: Sequence[Layer] = ()):
         if isinstance(layers, Layer):
             layers = [layers]
+        # extra_layers ride along in the graph (reference: unused/print layers
+        # stay in the config) but are not declared outputs
+        self.declared_outputs: List[Layer] = list(layers)
         self.output_layers: List[Layer] = list(layers) + list(extra_layers)
         self.network = Network(self.output_layers)
 
@@ -79,6 +82,18 @@ class Topology:
                 else:
                     batch[name] = np.zeros((batch_size,), np.int32)
                 _ = hi
+            elif spec is not None and spec.kind in ("dense_subseq", "index_subseq"):
+                s_max = max(seq_len // 2, 1)
+                if spec.kind == "dense_subseq":
+                    batch[name] = np.zeros(
+                        (batch_size, s_max, seq_len) + shape, np.float32
+                    )
+                else:
+                    batch[name] = np.zeros((batch_size, s_max, seq_len), np.int32)
+                batch[name + ".lengths"] = np.full((batch_size,), s_max, np.int32)
+                batch[name + ".sub_lengths"] = np.full(
+                    (batch_size, s_max), seq_len, np.int32
+                )
             elif is_seq:
                 batch[name] = np.zeros((batch_size, seq_len) + shape, np.float32)
                 batch[name + ".lengths"] = np.full((batch_size,), seq_len, np.int32)

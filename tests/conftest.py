@@ -50,6 +50,7 @@ _SLOW_MODULES = {
     "test_multi_network",
     "test_seq2seq",
     "test_distributed",
+    "test_protostr",
 }
 
 
