@@ -40,6 +40,10 @@ _TYPE_ALIAS = {
     "feature_map_expand": "featmap_expand",
     "seq_concat": "seqconcat",
     "seq_reshape": "seqreshape",
+    "classification_cost": "multi-class-cross-entropy",
+    "grumemory": "gated_recurrent",
+    "block_expand": "blockexpand",
+    "square_error": "square_error",
 }
 
 _SKIP_ATTRS = {
@@ -52,6 +56,12 @@ def _pair(v) -> Tuple[int, int]:
     if isinstance(v, (tuple, list)):
         return int(v[0]), int(v[1])
     return int(v), int(v)
+
+
+def _triple(v) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1]), int(v[2])
+    return int(v), int(v), int(v)
 
 
 def _act_name(layer: Layer) -> str:
@@ -124,24 +134,84 @@ def _emit_conv(layer, ins, out, lc):
     dh, dw = _pair(getattr(layer, "dilation", 1))
     ihwc, ohwc = _hw(ins[0]), _hw(out)
     cin = ihwc[2] if ihwc else 0
+    groups = getattr(layer, "groups", 1)
+    trans = layer.type_name == "conv_transpose"
     cc = proto.ConvConfig(
         filter_size=kw, filter_size_y=kh,
         channels=cin,
         stride=sw, stride_y=sh,
         padding=pw, padding_y=ph,
-        groups=layer.groups,
-        filter_channels=cin // max(layer.groups, 1),
+        groups=groups,
+        # exconvt describes the equivalent forward conv: filter_channels is
+        # the conv's input-channel count = this deconv's num_filters
+        filter_channels=(layer.num_filters if trans else cin) // max(groups, 1),
         caffe_mode=True,
     )
     if dh != 1 or dw != 1:
         cc.dilation, cc.dilation_y = dw, dh
-    if ihwc:
-        cc.img_size, cc.img_size_y = ihwc[1], ihwc[0]
-    if ohwc:
-        cc.output_x, cc.output_y = ohwc[1], ohwc[0]
+    if trans:  # img/output swap likewise (parse_conv trans=True)
+        if ohwc:
+            cc.img_size, cc.img_size_y = ohwc[1], ohwc[0]
+        if ihwc:
+            cc.output_x, cc.output_y = ihwc[1], ihwc[0]
+    else:
+        if ihwc:
+            cc.img_size, cc.img_size_y = ihwc[1], ihwc[0]
+        if ohwc:
+            cc.output_x, cc.output_y = ohwc[1], ohwc[0]
     lc.inputs[0].conv_conf = cc
     lc.num_filters = layer.num_filters
     lc.shared_biases = True
+    _set_hw(lc, out)
+
+
+@_emitter("conv3d", "deconv3d")
+def _emit_conv3d(layer, ins, out, lc):
+    fd, fh, fw = _triple(layer.filter_size)
+    sd, sh, sw = _triple(layer.stride)
+    pd, ph, pw = _triple(layer.padding)
+    ig, og = _geom(ins[0]), _geom(out)
+    trans = layer.type_name == "deconv3d"
+    groups = getattr(layer, "groups", 1)
+    cin = ig[3] if ig else 0
+    cc = proto.ConvConfig(
+        filter_size=fw, filter_size_y=fh, filter_size_z=fd,
+        channels=cin,
+        stride=sw, stride_y=sh, stride_z=sd,
+        padding=pw, padding_y=ph, padding_z=pd,
+        groups=groups,
+        filter_channels=(layer.num_filters if trans else cin) // max(groups, 1),
+        caffe_mode=True,
+    )
+    src, dst = (og, ig) if trans else (ig, og)
+    if src:
+        cc.img_size, cc.img_size_y, cc.img_size_z = src[2], src[1], src[0]
+    if dst:
+        cc.output_x, cc.output_y, cc.output_z = dst[2], dst[1], dst[0]
+    lc.inputs[0].conv_conf = cc
+    lc.num_filters = layer.num_filters
+    lc.shared_biases = True
+    _set_hw(lc, out)
+
+
+@_emitter("pool3d")
+def _emit_pool3d(layer, ins, out, lc):
+    fd, fh, fw = _triple(layer.pool_size)
+    sd, sh, sw = _triple(layer.stride if layer.stride is not None else layer.pool_size)
+    pd, ph, pw = _triple(getattr(layer, "padding", 0))
+    ig, og = _geom(ins[0]), _geom(out)
+    pc = proto.PoolConfig(
+        pool_type=f"{layer.pool_type}-projection",
+        channels=ig[3] if ig else 0,
+        size_x=fw, size_y=fh, size_z=fd,
+        stride=sw, stride_y=sh, stride_z=sd,
+        padding=pw, padding_y=ph, padding_z=pd,
+    )
+    if ig:
+        pc.img_size, pc.img_size_y, pc.img_size_z = ig[2], ig[1], ig[0]
+    if og:
+        pc.output_x, pc.output_y, pc.output_z = og[2], og[1], og[0]
+    lc.inputs[0].pool_conf = pc
     _set_hw(lc, out)
 
 
@@ -191,11 +261,14 @@ def _emit_sampling_id(layer, ins, out, lc):
 @_emitter("lrn")
 def _emit_norm(layer, ins, out, lc):
     ihwc = _hw(ins[0])
+    size = getattr(layer, "size", 0)
     nc = proto.NormConfig(
         norm_type="cmrnorm-projection",
         channels=ihwc[2] if ihwc else 0,
-        size=getattr(layer, "size", 0),
-        scale=getattr(layer, "scale", 0.0),
+        size=size,
+        # config_parser stores scale/size (parse_norm); the kernel multiplies
+        # by the window sum so the product is the user's scale
+        scale=getattr(layer, "scale", 0.0) / max(size, 1),
         pow=getattr(layer, "power", 0.0),
         blocked=False,
     )
@@ -261,24 +334,25 @@ def _emit_bilinear(layer, ins, out, lc):
 @_emitter("row_conv")
 def _emit_row_conv(layer, ins, out, lc):
     lc.inputs[0].row_conv_conf = proto.RowConvConfig(
-        context_length=getattr(layer, "context_length", 0)
+        context_length=getattr(layer, "context_length", None)
+        or getattr(layer, "context_len", 0)
     )
 
 
 @_emitter("block_expand")
 def _emit_block_expand(layer, ins, out, lc):
     ihwc = _hw(ins[0])
-    bx, by = _pair(getattr(layer, "block", (0, 0)))
-    sx, sy = _pair(getattr(layer, "stride", (1, 1)))
-    px, py = _pair(getattr(layer, "padding", (0, 0)))
+    by, bx = _pair(getattr(layer, "block", (0, 0)))  # stored (y, x)
+    sy, sx = _pair(getattr(layer, "stride", (1, 1)))
+    py, px = _pair(getattr(layer, "padding", (0, 0)))
     bc = proto.BlockExpandConfig(
         channels=ihwc[2] if ihwc else 0,
         block_x=bx, block_y=by,
         stride_x=sx, stride_y=sy,
         padding_x=px, padding_y=py,
     )
-    if ihwc:
-        bc.img_size_x, bc.img_size_y = ihwc[1], ihwc[0]
+    # img_size_x/y and output_x/y intentionally omitted: the reference
+    # leaves them 0 at parse time (computed by the runtime kernel)
     lc.inputs[0].block_expand_conf = bc
 
 
@@ -339,16 +413,25 @@ def _emit_cos(layer, ins, out, lc):
     lc.cos_scale = getattr(layer, "scale", 1.0)
 
 
+@_emitter("crf", "crf_decoding")
+def _emit_crf(layer, ins, out, lc):
+    if getattr(layer, "size", None):
+        lc.size = layer.size
+
+
 @_emitter("ctc", "warp_ctc")
 def _emit_ctc(layer, ins, out, lc):
     lc.norm_by_times = bool(getattr(layer, "norm_by_times", False))
     lc.blank = getattr(layer, "blank", 0)
+    if getattr(layer, "size", None):
+        lc.size = layer.size
 
 
 @_emitter("nce")
 def _emit_nce(layer, ins, out, lc):
     lc.num_classes = getattr(layer, "num_classes", None)
     lc.num_neg_samples = getattr(layer, "num_neg_samples", 10)
+    lc.active_type = "sigmoid"  # NCELayer's fixed activation
 
 
 @_emitter("hsigmoid")
@@ -377,6 +460,52 @@ def _emit_seqpool(layer, ins, out, lc):
             "avg": "average", "average": "average", "sum": "sum",
             "sqrt": "squarerootn",
         }.get(pt, pt)
+
+
+_PROJ_TYPES = {
+    "FullMatrix": "fc",
+    "TransposedFullMatrix": "trans_fc",
+    "Identity": "identity",
+    "DotMul": "dot_mul",
+    "Scaling": "scaling",
+    "Table": "table",
+    "Context_": "context",
+}
+
+
+@_emitter("mixed")
+def _emit_mixed(layer, ins, out, lc):
+    out_feat = out.value.shape[2:] if out.is_seq else out.value.shape[1:]
+    out_size = int(np.prod(out_feat)) if out_feat else 1
+    pos = 0
+    for proj in getattr(layer, "projections", []):
+        n = len(proj.sources)
+        arg = ins[pos]
+        lic = lc.inputs[pos]
+        pos += n
+        cls = type(proj).__name__
+        ptype = _PROJ_TYPES.get(cls)
+        if ptype is None:
+            if cls == "DotMulOperator":
+                lc.operator_confs.append(
+                    proto.OperatorConfig(
+                        type="dot_mul",
+                        input_indices=list(range(pos - n, pos)),
+                        output_size=out_size,
+                    )
+                )
+            continue
+        feat = arg.value.shape[2:] if arg.is_seq else arg.value.shape[1:]
+        in_size = int(np.prod(feat)) if feat else 1
+        if ptype == "table":  # input is ids; input_size is the vocab
+            in_size = getattr(proj, "vocab_size", None) or in_size
+        pc = proto.ProjectionConfig(
+            type=ptype, name=None, input_size=in_size, output_size=out_size
+        )
+        if ptype == "context":
+            pc.context_start = getattr(proj, "context_start", None)
+            pc.context_length = getattr(proj, "context_length", None)
+        lic.proj_conf = pc
 
 
 _COST_TYPES = {
@@ -471,6 +600,9 @@ def build_model_config(
         if layer.type_name == "data":
             mc.input_layer_names.append(layer.name)
             _set_hw(lc, arg)
+            spec = getattr(layer, "data_type", None)
+            if spec is not None and spec.kind.startswith("index") and spec.dim:
+                lc.size = int(spec.dim)  # id slots keep their declared range
             # v1 data slots are flat; declared image geometry rides on the node
             g3 = getattr(layer, "_v1_geom3d", None)
             g2 = getattr(layer, "_v1_geom", None)

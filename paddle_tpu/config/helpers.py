@@ -199,6 +199,8 @@ simple_attention = _nets.simple_attention
 from paddle_tpu.config.v1_layers import (  # noqa: E402
     batch_norm_layer,
     bidirectional_gru,
+    bilinear_interp_layer,
+    block_expand_layer,
     bidirectional_lstm,
     classification_cost,
     concat_layer,
@@ -213,21 +215,32 @@ from paddle_tpu.config.v1_layers import (  # noqa: E402
     expand_layer,
     fc_layer,
     first_seq,
+    gated_unit_layer,
     img_cmrnorm_layer,
+    img_conv3d_layer,
     img_conv_group,
     img_conv_layer,
     hsigmoid,
+    img_pool3d_layer,
     img_pool_layer,
     kmax_sequence_score_layer,
+    lambda_cost,
     last_seq,
+    lstmemory,
+    grumemory,
     maxid_layer,
+    maxout_layer,
     nce_layer,
     pooling_layer,
+    recurrent_layer,
+    row_conv_layer,
+    spp_layer,
     seq_concat_layer,
     seq_reshape_layer,
     seq_slice_layer,
     sequence_conv_pool,
     simple_gru,
+    simple_gru2,
     simple_img_conv_pool,
     simple_lstm,
     sub_nested_seq_layer,

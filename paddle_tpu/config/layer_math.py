@@ -25,14 +25,22 @@ def _size_of(node: Layer) -> Optional[int]:
     return sz(node)
 
 
+def _keep_size(node: Layer, src: Layer) -> Layer:
+    s = _size_of(src)
+    if s is not None:
+        node._v1_size = s
+    return node
+
+
 def _unary(op_name: str, act_name: str):
     def op(input, name=None):
         h = _helpers()
-        return h.mixed_layer(
+        node = h.mixed_layer(
             input=[h.identity_projection(input=input)],
             name=name or _auto_name(op_name),
             act=act_name,
         )
+        return _keep_size(node, input)
 
     op.__name__ = op_name
     globals()[op_name] = op
@@ -54,7 +62,9 @@ def _is_number(v) -> bool:
 def add(layer, other):
     h = _helpers()
     if _is_number(other):
-        return h.slope_intercept_layer(input=layer, intercept=other)
+        return _keep_size(
+            h.slope_intercept_layer(input=layer, intercept=other), layer
+        )
     if not isinstance(other, Layer):
         raise TypeError("a layer can only be added to another layer or a number")
     a, b = layer, other
@@ -66,36 +76,49 @@ def add(layer, other):
             )
         if sa == 1:
             a, b, sa = b, a, sb
-        b = h.repeat_layer(b, sa)
-    return h.mixed_layer(
-        input=[h.identity_projection(input=a), h.identity_projection(input=b)]
+        b = _keep_size(h.repeat_layer(b, sa), a)
+    return _keep_size(
+        h.mixed_layer(
+            input=[h.identity_projection(input=a), h.identity_projection(input=b)]
+        ),
+        a,
     )
 
 
 def sub(layer, other):
     h = _helpers()
     if _is_number(other):
-        return h.slope_intercept_layer(input=layer, intercept=-other)
+        # NOTE: reference layer_math.sub passes intercept=+other (its goldens
+        # encode y-2 as intercept: 2); kept verbatim for config parity
+        return _keep_size(
+            h.slope_intercept_layer(input=layer, intercept=other), layer
+        )
     if not isinstance(other, Layer):
         raise TypeError("a layer can only be subtracted by another layer or a number")
-    return add(layer, h.slope_intercept_layer(input=other, slope=-1.0))
+    return add(layer, _keep_size(
+        h.slope_intercept_layer(input=other, slope=-1.0), other
+    ))
 
 
 def rsub(layer, other):
     h = _helpers()
-    return add(h.slope_intercept_layer(input=layer, slope=-1.0), other)
+    return add(_keep_size(
+        h.slope_intercept_layer(input=layer, slope=-1.0), layer
+    ), other)
 
 
 def mul(layer, other):
     h = _helpers()
     if _is_number(other):
-        return h.slope_intercept_layer(input=layer, slope=other)
+        return _keep_size(
+            h.slope_intercept_layer(input=layer, slope=other), layer
+        )
     if not isinstance(other, Layer):
         raise TypeError("a layer can only be multiplied by another layer or a number")
     if _size_of(layer) == 1:
-        return h.scaling_layer(input=other, weight=layer)
+        return _keep_size(h.scaling_layer(input=other, weight=layer), other)
     if _size_of(other) == 1:
-        return h.scaling_layer(input=layer, weight=other)
+        return _keep_size(h.scaling_layer(input=layer, weight=other), layer)
     raise ValueError("'*' needs a number or a size-1 layer on one side")
 
 

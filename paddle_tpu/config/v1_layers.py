@@ -42,9 +42,13 @@ __all__ = [
     "text_conv_pool", "simple_lstm", "simple_gru", "bidirectional_lstm",
     "bidirectional_gru", "last_seq", "first_seq", "expand_layer",
     "ctc_layer", "warp_ctc_layer", "crf_layer", "crf_decoding_layer",
-    "nce_layer", "hsigmoid",
+    "nce_layer", "hsigmoid", "lstmemory", "grumemory", "recurrent_layer",
+    "lambda_cost", "maxout_layer", "bilinear_interp_layer", "spp_layer",
+    "row_conv_layer", "block_expand_layer", "img_conv3d_layer",
+    "img_pool3d_layer",
     "seq_slice_layer", "kmax_sequence_score_layer", "seq_concat_layer",
-    "seq_reshape_layer", "sub_nested_seq_layer",
+    "seq_reshape_layer", "sub_nested_seq_layer", "gated_unit_layer",
+    "simple_gru2",
 ]
 
 
@@ -131,6 +135,84 @@ def _ensure_nhwc(input: Layer, num_channels: Optional[int]):
     _annotate(node, geom=(c, h, w))
     input._v1_nhwc_node = node
     return node, (c, h, w)
+
+
+def _annotate3d(node: Layer, geom3d) -> Layer:
+    c, d, h, w = (int(v) for v in geom3d)
+    node._v1_geom3d = (c, d, h, w)
+    node._v1_size = c * d * h * w
+    return node
+
+
+def _ensure_ndhwc(input: Layer, num_channels: Optional[int]):
+    """3-D analog of _ensure_nhwc: flat [B, c*d*h*w] (CDHW order) → NDHWC."""
+    geom3d = getattr(input, "_v1_geom3d", None)
+    if geom3d is not None and not _is_flat(input):
+        return input, geom3d
+    cached = getattr(input, "_v1_ndhwc_node", None)
+    if cached is not None:
+        return cached, cached._v1_geom3d
+    if geom3d is None:
+        size = _size_of(input)
+        if size is None or num_channels is None:
+            raise ValueError(
+                f"cannot infer 3-D geometry of {getattr(input, 'name', input)!r}; "
+                "declare height/width/depth on the data layer or pass num_channels"
+            )
+        side = round((size // num_channels) ** (1 / 3))
+        geom3d = (num_channels, side, side, side)
+    c, d, h, w = geom3d
+    node = L.Reshape(input, (c, d, h, w), name=f"{input.name}.as_vol")
+    node = L.SwitchOrder(node, to="NDHWC", name=f"{input.name}.to_ndhwc")
+    _annotate3d(node, (c, d, h, w))
+    input._v1_ndhwc_node = node
+    return node, (c, d, h, w)
+
+
+def img_conv3d_layer(input, filter_size, num_filters=None, name=None,
+                     num_channels=None, act=None, groups=1, stride=1,
+                     padding=0, bias_attr=None, param_attr=None,
+                     shared_biases=True, layer_attr=None,
+                     trans=False, layer_type=None, **_compat):
+    """layers.py img_conv3d_layer — flat CDHW data gets the NDHWC adapter;
+    filter/stride/padding may be scalars or (x, y, z)? no: scalars or
+    [d, h, w]-style lists per the reference (one value used for all axes)."""
+    ndhwc, (cin, dz, h, w) = _ensure_ndhwc(input, num_channels)
+    f = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size,) * 3
+    s = stride if isinstance(stride, (list, tuple)) else (stride,) * 3
+    p = padding if isinstance(padding, (list, tuple)) else (padding,) * 3
+    node = _v2.img_conv3d(
+        ndhwc, tuple(f), num_filters, stride=tuple(s), padding=tuple(p),
+        groups=groups, act=_act(act),
+        bias_attr=bias_attr, param_attr=_or_none(param_attr), name=name,
+        trans=trans,
+    )
+    if trans:
+        od = (dz - 1) * s[0] - 2 * p[0] + f[0]
+        oh = (h - 1) * s[1] - 2 * p[1] + f[1]
+        ow = (w - 1) * s[2] - 2 * p[2] + f[2]
+    else:
+        od = _conv_out(dz, f[0], p[0], s[0])
+        oh = _conv_out(h, f[1], p[1], s[1])
+        ow = _conv_out(w, f[2], p[2], s[2])
+    return _with_drop(
+        _annotate3d(node, (num_filters, od, oh, ow)), layer_attr
+    )
+
+
+def img_pool3d_layer(input, pool_size, name=None, num_channels=None,
+                     pool_type=None, stride=1, padding=0, layer_attr=None,
+                     ceil_mode=True, **_compat):
+    ndhwc, (c, dz, h, w) = _ensure_ndhwc(input, num_channels)
+    f = pool_size if isinstance(pool_size, (list, tuple)) else (pool_size,) * 3
+    s = stride if isinstance(stride, (list, tuple)) else (stride,) * 3
+    p = padding if isinstance(padding, (list, tuple)) else (padding,) * 3
+    node = _v2.img_pool3d(ndhwc, tuple(f), pool_type=pool_type,
+                          stride=tuple(s), padding=tuple(p), name=name)
+    od = _pool_out(dz, f[0], p[0], s[0], ceil_mode)
+    oh = _pool_out(h, f[1], p[1], s[1], ceil_mode)
+    ow = _pool_out(w, f[2], p[2], s[2], ceil_mode)
+    return _with_drop(_annotate3d(node, (c, od, oh, ow)), layer_attr)
 
 
 def _conv_out(size: int, filt: int, pad: int, stride: int, dilation: int = 1) -> int:
@@ -486,6 +568,107 @@ def maxid_layer(input, name=None, layer_attr=None):
     return _with_drop(_v2.max_id(input, name=name), layer_attr)
 
 
+def lstmemory(input, name=None, size=None, reverse=False, act=None,
+              gate_act=None, state_act=None, bias_attr=None, param_attr=None,
+              layer_attr=None):
+    """layers.py lstmemory: input is the pre-projected [4*size] mixed/fc."""
+    _mark_seq_root(input)
+    if size is None:
+        insz = _size_of(input)
+        size = insz // 4 if insz else None
+    node = _v2.lstmemory(input, size=size, reverse=reverse, act=act,
+                         gate_act=gate_act, state_act=state_act,
+                         param_attr=_or_none(param_attr),
+                         bias_attr=bias_attr, name=name)
+    if size:
+        _annotate(node, size=size)
+    return _with_drop(node, layer_attr)
+
+
+def grumemory(input, name=None, size=None, reverse=False, act=None,
+              gate_act=None, bias_attr=None, param_attr=None, layer_attr=None):
+    """layers.py grumemory: input is the pre-projected [3*size] mixed/fc."""
+    _mark_seq_root(input)
+    if size is None:
+        insz = _size_of(input)
+        size = insz // 3 if insz else None
+    node = _v2.grumemory(input, size=size, reverse=reverse, act=act,
+                         gate_act=gate_act, param_attr=_or_none(param_attr),
+                         bias_attr=bias_attr, name=name)
+    if size:
+        _annotate(node, size=size)
+    return _with_drop(node, layer_attr)
+
+
+def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
+                    name=None, reverse=False, layer_attr=None):
+    _mark_seq_root(input)
+    node = _v2.recurrent(input, act=act, reverse=reverse,
+                         bias_attr=bias_attr, param_attr=_or_none(param_attr),
+                         name=name)
+    sz = _size_of(input)
+    if sz:
+        _annotate(node, size=sz)
+    return _with_drop(node, layer_attr)
+
+
+def maxout_layer(input, groups, num_channels=None, name=None, layer_attr=None):
+    nhwc, (c, h, w) = _ensure_nhwc(input, num_channels)
+    node = _v2.maxout(nhwc, groups, name=name)
+    return _with_drop(_annotate(node, geom=(c // groups, h, w)), layer_attr)
+
+
+def bilinear_interp_layer(input, out_size_x=None, out_size_y=None, name=None,
+                          layer_attr=None):
+    nhwc, (c, h, w) = _ensure_nhwc(input, None)
+    node = _v2.bilinear_interp(nhwc, out_size_x, out_size_y, name=name)
+    return _with_drop(_annotate(node, geom=(c, out_size_y, out_size_x)), layer_attr)
+
+
+def spp_layer(input, name=None, num_channels=None, pool_type=None,
+              pyramid_height=None, layer_attr=None):
+    nhwc, (c, h, w) = _ensure_nhwc(input, num_channels)
+    node = _v2.spp(nhwc, pyramid_height=pyramid_height, pool_type=pool_type,
+                   name=name)
+    bins = sum(4 ** i for i in range(pyramid_height))
+    return _with_drop(_annotate(node, size=c * bins), layer_attr)
+
+
+def row_conv_layer(input, context_len, act=None, name=None, param_attr=None,
+                   layer_attr=None):
+    _mark_seq_root(input)
+    node = _v2.row_conv(input, context_len, act=act,
+                        param_attr=_or_none(param_attr), name=name)
+    sz = _size_of(input)
+    if sz:
+        _annotate(node, size=sz)
+    return _with_drop(node, layer_attr)
+
+
+def block_expand_layer(input, block_x=0, block_y=0, stride_x=0, stride_y=0,
+                       padding_x=0, padding_y=0, num_channels=None, name=None,
+                       layer_attr=None):
+    nhwc, (c, h, w) = _ensure_nhwc(input, num_channels)
+    node = _v2.block_expand(nhwc, block_x=block_x, block_y=block_y,
+                            stride_x=stride_x or block_x,
+                            stride_y=stride_y or block_y,
+                            padding_x=padding_x, padding_y=padding_y,
+                            name=name)
+    _annotate(node, size=c * block_x * block_y)
+    return _with_drop(node, layer_attr)
+
+
+def lambda_cost(input, score, name=None, NDCG_num=5, max_sort_size=-1,
+                layer_attr=None):
+    """LambdaRank works on score sequences (LambdaCost.cpp)."""
+    _mark_seq_root(input)
+    _mark_seq_root(score)
+    return _with_drop(
+        _v2.lambda_cost(input, score, NDCG_num=NDCG_num, name=name),
+        layer_attr,
+    )
+
+
 def _mark_label_as_id_seq(label: Layer) -> None:
     """Sequence-label costs (ctc/crf): the label slot is an id sequence."""
     from paddle_tpu.data.feeder import integer_value_sequence
@@ -504,8 +687,10 @@ def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
     """layers.py ctc_layer: size defaults to the input layer's size (the
     alphabet incl. blank, CTCLayer.cpp)."""
     _mark_seq_root(input)
+    lbl_size = _size_of(label)
     _mark_label_as_id_seq(label)
-    size = size or _size_of(input)
+    if size is None:  # layers.py:5251: size = label dict size + 1 (blank last)
+        size = (lbl_size + 1) if lbl_size else _size_of(input)
     return _with_drop(
         _v2.ctc(input, label, size=size, norm_by_times=norm_by_times, name=name),
         layer_attr,
@@ -515,9 +700,12 @@ def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
 def warp_ctc_layer(input, label, size=None, name=None, blank=0,
                    norm_by_times=False, layer_attr=None):
     _mark_seq_root(input)
+    lbl_size = _size_of(label)
     _mark_label_as_id_seq(label)
+    if size is None:
+        size = (lbl_size + 1) if lbl_size else _size_of(input)
     return _with_drop(
-        _v2.warp_ctc(input, label, size=size or _size_of(input), blank=blank,
+        _v2.warp_ctc(input, label, size=size, blank=blank,
                      norm_by_times=norm_by_times, name=name),
         layer_attr,
     )
@@ -554,7 +742,8 @@ def nce_layer(input, label, num_classes=None, weight=None, num_neg_samples=10,
     if num_classes is None:
         num_classes = _size_of(label) or 0
     return _with_drop(
-        _v2.nce(input, label, num_classes, num_neg_samples=num_neg_samples,
+        _v2.nce(input, label, num_classes, weight=weight,
+                num_neg_samples=num_neg_samples,
                 neg_distribution=neg_distribution, bias_attr=bias_attr,
                 param_attr=_or_none(param_attr), name=name),
         layer_attr,
@@ -597,7 +786,9 @@ def _mark_seq_root(node: Layer, nested: bool = False) -> None:
         if getattr(cur, "type_name", None) == "data":
             cur.is_seq = True
             spec = getattr(cur, "data_type", None)
-            if spec is not None and spec.kind == "index":
+            if spec is None and nested:
+                cur.data_type = dense_vector_sub_sequence(_size_of(cur) or 1)
+            elif spec is not None and spec.kind == "index":
                 cur.data_type = (
                     integer_value_sub_sequence(int(spec.dim))
                     if nested
@@ -786,24 +977,68 @@ def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
     return _with_drop(_annotate(node, size=size), lstm_cell_attr)
 
 
+def gated_unit_layer(input, size, act=None, name=None, gate_attr=None,
+                     gate_param_attr=None, gate_bias_attr=True,
+                     inproj_attr=None, inproj_param_attr=None,
+                     inproj_bias_attr=True, layer_attr=None):
+    """layers.py gated_unit_layer: input_proj fc ⊙ sigmoid gate fc via a
+    dot_mul-operator mixed (GLU)."""
+    input_proj = fc_layer(
+        input=input, name=f"{name}_input_proj", size=size,
+        act=act if act is not None else "linear",
+        layer_attr=inproj_attr, param_attr=inproj_param_attr,
+        bias_attr=inproj_bias_attr,
+    )
+    gate = fc_layer(
+        input=input, name=f"{name}_gate", size=size, act="sigmoid",
+        layer_attr=gate_attr, param_attr=gate_param_attr,
+        bias_attr=gate_bias_attr,
+    )
+    node = _v2.mixed(
+        size=size,
+        input=_v2.dotmul_operator(input_proj, gate),
+        name=f"{name}_gated_act", layer_attr=layer_attr,
+    )
+    return _annotate(node, size=size)
+
+
+def _gru_transform(input, size, name, param_attr, bias_attr, layer_attr):
+    """The `%s_transform` mixed(3H) projection both simple_gru variants
+    share (networks.py simple_gru/simple_gru2)."""
+    _mark_seq_root(input)
+    m = _v2.mixed(
+        size=size * 3,
+        input=[_v2.full_matrix_projection(input, param_attr=_or_none(param_attr))],
+        bias_attr=bias_attr,
+        name=f"{name}_transform" if name else None,
+        layer_attr=layer_attr,
+    )
+    return _annotate(m, size=size * 3)
+
+
 def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
                mixed_bias_param_attr=None, mixed_layer_attr=None,
                gru_param_attr=None, gru_bias_attr=None, act=None,
-               gate_act=None, gru_layer_attr=None):
-    """networks.py:981 — fc(3H) projection + grumemory."""
-    _mark_seq_root(input)
-    proj = fc_layer(
-        input, size * 3, act="linear", name=f"{name}.input_proj" if name else None,
-        param_attr=mixed_param_attr, bias_attr=mixed_bias_param_attr,
-        layer_attr=mixed_layer_attr,
-    )
-    node = R.Gru(
-        proj, size=size, reverse=reverse, act=_act(act) or "tanh",
-        gate_act=_act(gate_act) or "sigmoid",
-        param_attr=_or_none(gru_param_attr), bias_attr=_or_none(gru_bias_attr),
-        name=name,
-    )
-    return _with_drop(_annotate(node, size=size), gru_layer_attr)
+               gate_act=None, gru_layer_attr=None, naive=False):
+    """networks.py:981 — `%s_transform` mixed(3H) + gru cell (the reference
+    routes through gru_group; the fused grumemory computes the same math)."""
+    m = _gru_transform(input, size, name, mixed_param_attr,
+                       mixed_bias_param_attr, mixed_layer_attr)
+    return grumemory(m, name=name, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, bias_attr=gru_bias_attr,
+                     param_attr=gru_param_attr, layer_attr=gru_layer_attr)
+
+
+def simple_gru2(input, size, name=None, reverse=False, mixed_param_attr=None,
+                mixed_bias_attr=None, gru_param_attr=None, gru_bias_attr=None,
+                act=None, gate_act=None, mixed_layer_attr=None,
+                gru_cell_attr=None):
+    """networks.py simple_gru2: `%s_transform` mixed(3H) + grumemory."""
+    m = _gru_transform(input, size, name, mixed_param_attr, mixed_bias_attr,
+                       mixed_layer_attr)
+    return grumemory(m, name=name, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, bias_attr=gru_bias_attr,
+                     param_attr=gru_param_attr, layer_attr=gru_cell_attr)
 
 
 def bidirectional_lstm(input, size, name=None, return_seq=False, **kw):
@@ -821,13 +1056,16 @@ def bidirectional_lstm(input, size, name=None, return_seq=False, **kw):
 
 
 def bidirectional_gru(input, size, name=None, return_seq=False, **kw):
-    fwd = simple_gru(input, size, name=f"{name}_fw" if name else None)
-    bwd = simple_gru(input, size, name=f"{name}_bw" if name else None,
-                     reverse=True)
+    """networks.py bidirectional_gru: two simple_gru2 passes + concat
+    (fwd_/bwd_ prefixed attrs route to the respective pass)."""
+    fwd_kw = {k[4:]: v for k, v in kw.items() if k.startswith("fwd_")}
+    bwd_kw = {k[4:]: v for k, v in kw.items() if k.startswith("bwd_")}
+    fwd = simple_gru2(input, size, name=f"{name}_fw", **fwd_kw)
+    bwd = simple_gru2(input, size, name=f"{name}_bw", reverse=True, **bwd_kw)
     if return_seq:
-        node = L.Concat([fwd, bwd], name=name)
+        node = L.Concat([fwd, bwd], act=_act(kw.get("concat_act")), name=name)
         return _annotate(node, size=size * 2)
-    last_f = S.LastSeq(fwd, name=f"{name}_fw_last" if name else None)
-    first_b = S.FirstSeq(bwd, name=f"{name}_bw_first" if name else None)
-    node = L.Concat([last_f, first_b], name=name)
+    last_f = last_seq(fwd, name=f"{name}_fw_last")
+    first_b = first_seq(bwd, name=f"{name}_bw_last")
+    node = L.Concat([last_f, first_b], act=_act(kw.get("concat_act")), name=name)
     return _annotate(node, size=size * 2)

@@ -107,7 +107,7 @@ class SquareError(CostLayer):
     type_name = "square_error"
 
     def per_example(self, ctx, pred, label):
-        d = pred.astype(jnp.float32) - label.astype(jnp.float32)
+        d = pred.astype(jnp.float32) - _dense_label(pred, label)
         return 0.5 * jnp.sum(d * d, axis=-1)
 
 
@@ -130,6 +130,14 @@ class CrossEntropyWithSelfNorm(CostLayer):
         return ce + self.alpha * logz * logz
 
 
+def _dense_label(pred, label):
+    """Regression costs against an id label slot (the provider binds whatever
+    the cost consumes; one-hot is the dense view of ids)."""
+    if label.ndim == pred.ndim - 1:
+        return jax.nn.one_hot(label.astype(jnp.int32), pred.shape[-1])
+    return label.astype(jnp.float32)
+
+
 @LAYERS.register("huber_regression_cost")
 class HuberRegression(CostLayer):
     """HuberRegressionLoss (CostLayer.cpp)."""
@@ -141,7 +149,7 @@ class HuberRegression(CostLayer):
         self.delta = delta
 
     def per_example(self, ctx, pred, label):
-        d = jnp.abs(pred.astype(jnp.float32) - label.astype(jnp.float32))
+        d = jnp.abs(pred.astype(jnp.float32) - _dense_label(pred, label))
         quad = jnp.minimum(d, self.delta)
         return jnp.sum(0.5 * quad * quad + self.delta * (d - quad), axis=-1)
 
@@ -188,7 +196,7 @@ class MultiBinaryLabelCrossEntropy(CostLayer):
 
     def per_example(self, ctx, pred, label):
         x = pred.astype(jnp.float32)
-        y = label.astype(jnp.float32)
+        y = _dense_label(pred, label)
         # stable sigmoid CE on logits
         return jnp.sum(jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x))), axis=-1)
 
@@ -215,5 +223,5 @@ class SmoothL1(CostLayer):
     type_name = "smooth_l1_cost"
 
     def per_example(self, ctx, pred, label):
-        d = jnp.abs(pred.astype(jnp.float32) - label.astype(jnp.float32))
+        d = jnp.abs(pred.astype(jnp.float32) - _dense_label(pred, label))
         return jnp.sum(jnp.where(d < 1.0, 0.5 * d * d, d - 0.5), axis=-1)

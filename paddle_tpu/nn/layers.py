@@ -608,6 +608,25 @@ class Mixed(Layer):
         self.bias = bias
         self.bias_attr = _attr(bias_attr)
 
+    # -- incremental construction (trainer_config_helpers MixedLayerType:
+    #    `with mixed_layer(size=N) as m: m += full_matrix_projection(x)`) ----
+    def __iadd__(self, proj):
+        from paddle_tpu.nn.projections import Projection
+
+        if not isinstance(proj, Projection):
+            raise TypeError("mixed layer inputs must be Projections")
+        self.projections.append(proj)
+        self.inputs.extend(proj.sources)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None and not self.projections:
+            raise ValueError(f"mixed layer {self.name!r} finalized with no projections")
+        return False
+
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         out = None
         pos = 0
@@ -629,16 +648,25 @@ class Mixed(Layer):
 
 @LAYERS.register("trans")
 class Trans(Layer):
-    """Matrix transpose of the feature block [B, M*N] viewed as MxN (TransLayer)."""
+    """TransLayer. With `height` set: transpose of the feature block
+    [B, M*N] viewed as MxN. Without height: the reference transposes the
+    whole batch matrix [B, D] → [D, B] (TransLayer.cpp) — shape inference
+    keeps size D like the reference config parser does (a real transpose
+    only round-trips when batch == D, the reference's implicit contract), so
+    tracing treats it as identity and the runtime transposes."""
 
     type_name = "trans"
 
-    def __init__(self, input: Layer, height: int, name=None):
+    def __init__(self, input: Layer, height: Optional[int] = None, name=None):
         super().__init__(input, name=name)
         self.height = height
 
     def forward(self, ctx, ins):
         x = ins[0].value
+        if self.height is None:
+            if ctx.mode == "init":
+                return ins[0]  # config-level identity (size preserved)
+            return Argument(x.T)
         b, d = x.shape
         h = self.height
         out = x.reshape(b, h, d // h).swapaxes(1, 2).reshape(b, d)

@@ -133,6 +133,7 @@ class Pool3D(Layer):
         pool_type: str = "max",
         stride: Optional[Int3] = None,
         padding: Int3 = 0,
+        ceil_mode: bool = False,
         name: Optional[str] = None,
     ):
         super().__init__(input, name=name)
@@ -140,14 +141,31 @@ class Pool3D(Layer):
         self.pool_type = pool_type
         self.stride = stride
         self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def _pads(self, x):
+        """ceil_mode: extra trailing padding so partial edge windows survive
+        (the v1 outputSize rule, same as Pool2D._pads but over D/H/W)."""
+        if not self.ceil_mode:
+            return self.padding
+        fs = conv_ops._triple(self.pool_size)
+        ss = conv_ops._triple(self.stride if self.stride is not None else self.pool_size)
+        ps = conv_ops._triple(self.padding)
+        out = []
+        for size, f, s, p in zip(x.shape[1:4], fs, ss, ps):
+            n_out = -(-(size + 2 * p - f) // s) + 1
+            extra = max(0, (n_out - 1) * s + f - size - 2 * p)
+            out.append((p, p + extra))
+        return tuple(out)
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         x = ins[0].value
         assert x.ndim == 5, f"pool3d {self.name}: expect NDHWC input, got {x.shape}"
+        pads = self._pads(x)
         if self.pool_type == "max":
-            out = conv_ops.max_pool3d(x, self.pool_size, self.stride, self.padding)
+            out = conv_ops.max_pool3d(x, self.pool_size, self.stride, pads)
         elif self.pool_type in ("avg", "average"):
-            out = conv_ops.avg_pool3d(x, self.pool_size, self.stride, self.padding)
+            out = conv_ops.avg_pool3d(x, self.pool_size, self.stride, pads)
         else:
             raise ValueError(f"pool3d: unknown pool_type {self.pool_type!r}")
         return ins[0].with_value(out)

@@ -264,6 +264,22 @@ class SeqSlice(Layer):
             else:
                 starts = None
             ends = ins[nxt].value.astype(jnp.int32) if self.has_ends else None
+            if arg.sub_lengths is not None and x.ndim > 3:
+                # nested input: starts/ends index tokens within each
+                # subsequence — shift every subsequence window in place
+                t_sub = x.shape[2]
+                sub_len = arg.sub_lengths
+                if starts is None:
+                    starts = jnp.zeros_like(sub_len)
+                if ends is None:
+                    ends = sub_len - 1
+                idx = starts[:, :, None] + jnp.arange(t_sub)[None, None, :]
+                idx_c = jnp.minimum(idx, t_sub - 1)
+                gat = jnp.take_along_axis(
+                    x, idx_c.reshape(idx_c.shape + (1,) * (x.ndim - 3)), axis=2
+                )
+                new_sub = jnp.clip(ends - starts + 1, 1, t_sub)
+                return Argument(gat, lengths, new_sub)
             if starts is None:
                 starts = jnp.zeros_like(ends)
             if ends is None:
@@ -310,6 +326,19 @@ class KmaxSeqScore(Layer):
     def forward(self, ctx, ins):
         arg = ins[0]
         scores = arg.value
+        if arg.sub_lengths is not None and scores.ndim >= 3:
+            # nested input [B, S, T(, 1)]: top-k over the flattened valid
+            # token stream (ids index into the nested sequence)
+            if scores.ndim == 4:
+                scores = scores[..., 0]
+            b, s_max, t_max = scores.shape
+            valid = (
+                (jnp.arange(s_max)[None, :, None] < arg.lengths[:, None, None])
+                & (jnp.arange(t_max)[None, None, :] < arg.sub_lengths[:, :, None])
+            )
+            flat = jnp.where(valid, scores, seq_ops.NEG_INF).reshape(b, -1)
+            _, idx = jax.lax.top_k(flat, self.beam_size)
+            return Argument(idx)
         if scores.ndim == 3:
             scores = scores[..., 0]
         masked = jnp.where(arg.mask(jnp.bool_), scores, seq_ops.NEG_INF)

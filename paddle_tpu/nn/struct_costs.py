@@ -50,12 +50,14 @@ class CTCCost(Layer):
         label: Layer,
         blank: int = 0,
         norm_by_times: bool = False,
+        size: Optional[int] = None,
         name: Optional[str] = None,
         coeff: float = 1.0,
     ):
         super().__init__([input, label], name=name)
         self.blank = blank
         self.norm_by_times = norm_by_times
+        self.size = size  # alphabet size incl. blank (config-surface value)
         self.coeff = coeff
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
@@ -162,9 +164,12 @@ class NCECost(Layer):
         neg_distribution: Optional[Any] = None,
         bias: bool = True,
         param_attr: Any = None,
+        weight: Optional[Layer] = None,
         name: Optional[str] = None,
     ):
-        super().__init__([input, label], name=name)
+        super().__init__([input, label] + ([weight] if weight is not None else []),
+                         name=name)
+        self.has_weight = weight is not None
         self.num_classes = num_classes
         self.num_neg_samples = num_neg_samples
         self.neg_distribution = (
@@ -174,7 +179,9 @@ class NCECost(Layer):
         self.param_attr = _attr(param_attr)
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
-        x = ins[0].value  # [B, D]
+        x = ins[0].value  # [B, D] (sequence inputs flatten per-timestep,
+        if x.ndim > 2:    # NCELayer consumes the flat Argument stream)
+            x = x.reshape(-1, x.shape[-1])
         label = ins[1].value.astype(jnp.int32).reshape(-1)  # [B]
         bsz, d = x.shape
         w = ctx.param(
@@ -190,11 +197,20 @@ class NCECost(Layer):
             else None
         )
 
+        sample_w = (
+            ins[2].value.reshape(-1) if self.has_weight else None
+        )  # per-sample cost weight (NCELayer weight input)
+
+        def _reduce(per_sample):
+            if sample_w is not None:
+                per_sample = per_sample * sample_w
+            return jnp.mean(per_sample)
+
         if not ctx.train:
             logits = x @ w.T + (b if b is not None else 0.0)
             logp = jax.nn.log_softmax(logits, axis=-1)
             nll = -jnp.take_along_axis(logp, label[:, None], axis=1)[:, 0]
-            return Argument(jnp.mean(nll))
+            return Argument(_reduce(nll))
 
         k = self.num_neg_samples
         rng = ctx.next_rng(self.name)
@@ -229,7 +245,7 @@ class NCECost(Layer):
         )
         # stable sigmoid BCE
         loss = jnp.maximum(s, 0.0) - s * y + jnp.log1p(jnp.exp(-jnp.abs(s)))
-        return Argument(jnp.mean(jnp.sum(loss, axis=1)))
+        return Argument(_reduce(jnp.sum(loss, axis=1)))
 
 
 @LAYERS.register("hsigmoid")
@@ -311,7 +327,13 @@ class LambdaCost(Layer):
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         pred, rel = ins  # both [B, T] or [B, T, 1] sequences
         assert pred.is_seq, "lambda_cost needs sequence inputs"
-        s = pred.value.reshape(pred.value.shape[0], pred.value.shape[1])
+        pv = pred.value
+        if pv.ndim == 3 and pv.shape[-1] != 1:
+            # the reference tolerates a wide feature input at parse time and
+            # scores by the first column at runtime (LambdaCost reads one
+            # score per doc) — keep that contract
+            pv = pv[..., :1]
+        s = pv.reshape(pv.shape[0], pv.shape[1])
         g = rel.value.reshape(s.shape).astype(jnp.float32)
         mask = pred.mask()  # [B, T]
         t = s.shape[1]
@@ -396,7 +418,14 @@ class CrossEntropyOverBeam(Layer):
 
     def forward(self, ctx, ins):
         n_beams = len(self.beams)
-        scores = [ins[3 * i].value for i in range(n_beams)]
+
+        def _flat_scores(v):
+            # accept [B,T], [B,T,1], nested [B,S,T(,1)] — flatten to [B, N]
+            if v.ndim > 2 and v.shape[-1] == 1:
+                v = v[..., 0]
+            return v.reshape(v.shape[0], -1)
+
+        scores = [_flat_scores(ins[3 * i].value) for i in range(n_beams)]
         selected = [ins[3 * i + 1].value.astype(jnp.int32) for i in range(n_beams)]
         gold = [ins[3 * i + 2].value.astype(jnp.int32).reshape(-1) for i in range(n_beams)]
         bsz = scores[0].shape[0]

@@ -104,6 +104,16 @@ def _pool_pads(padding) -> Tuple[Tuple[int, int], Tuple[int, int]]:
     return (ph, ph), (pw, pw)
 
 
+def _pool_pads3d(padding):
+    """3-D analog: int | (pd, ph, pw) | ((lo, hi) x 3)."""
+    if isinstance(padding, (tuple, list)) and padding and isinstance(
+        padding[0], (tuple, list)
+    ):
+        return tuple((int(lo), int(hi)) for lo, hi in padding)
+    pd, ph, pw = _triple(padding)
+    return ((pd, pd), (ph, ph), (pw, pw))
+
+
 def max_pool2d(
     x: Array, window: IntOr2, stride: Optional[IntOr2] = None, padding: IntOr2 = 0
 ) -> Array:
@@ -248,7 +258,7 @@ def max_pool3d(
 ) -> Array:
     wd, wh, ww = _triple(window)
     sd, sh, sw = _triple(stride if stride is not None else window)
-    pd, ph, pw = _triple(padding)
+    dpad, hpad, wpad = _pool_pads3d(padding)
     neg = (
         -jnp.inf
         if jnp.issubdtype(x.dtype, jnp.floating)
@@ -260,7 +270,7 @@ def max_pool3d(
         lax.max,
         window_dimensions=(1, wd, wh, ww, 1),
         window_strides=(1, sd, sh, sw, 1),
-        padding=((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0)),
+        padding=((0, 0), dpad, hpad, wpad, (0, 0)),
     )
 
 
@@ -273,12 +283,12 @@ def avg_pool3d(
 ) -> Array:
     wd, wh, ww = _triple(window)
     sd, sh, sw = _triple(stride if stride is not None else window)
-    pd, ph, pw = _triple(padding)
+    dpad, hpad, wpad = _pool_pads3d(padding)
     dims = (1, wd, wh, ww, 1)
     strides = (1, sd, sh, sw, 1)
-    pads = ((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0))
+    pads = ((0, 0), dpad, hpad, wpad, (0, 0))
     summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
-    if exclusive and (pd or ph or pw):
+    if exclusive and any(lo or hi for lo, hi in (dpad, hpad, wpad)):
         ones = jnp.ones(x.shape[:4] + (1,), x.dtype)
         counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
         return summed / counts

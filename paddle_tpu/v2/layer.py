@@ -293,13 +293,14 @@ def img_conv3d(input, filter_size, num_filters, num_channels=None, stride=1,
 
 
 def img_pool3d(input, pool_size, pool_type=None, stride=None, padding=0,
-               name=None, layer_attr=None, **_compat):
-    """img_pool3d_layer (layers.py:2695)."""
+               ceil_mode=True, name=None, layer_attr=None, **_compat):
+    """img_pool3d_layer (layers.py:2695) — ceil_mode=True is the v1
+    output-size default, like img_pool_layer."""
     from paddle_tpu.nn import layers3d as L3
 
     return _with_drop(
         L3.Pool3D(input, pool_size, _pool(pool_type), stride=stride,
-                  padding=padding, name=name),
+                  padding=padding, ceil_mode=ceil_mode, name=name),
         layer_attr,
     )
 
@@ -348,8 +349,6 @@ def _cos_sim_rowwise(a, b, scale=1.0, name=None):
 
 
 def trans(input, height=None, name=None):
-    if height is None:
-        raise ValueError("trans needs the matrix height (rows) for the 2-D view")
     return L.Trans(input, height, name=name)
 
 
@@ -506,9 +505,20 @@ def selective_fc(input, size, select=None, act=None, param_attr=None,
 
 
 def mixed(size=0, input=None, act=None, bias_attr=False, name=None, layer_attr=None):
+    # MixedLayer adds a bias only when bias_attr is explicitly truthy
+    # (layers.py mixed_layer: default False; None also means no bias)
+    bias = bias_attr is not False and bias_attr is not None
+    if input is None:
+        # context-manager form: `with mixed_layer(size=N) as m: m += proj`
+        return L.Mixed([], size=size, act=_act(act),
+                       bias=bias, bias_attr=bias_attr, name=name)
+    from paddle_tpu.nn.projections import Projection
+
+    if isinstance(input, Projection):
+        input = [input]
     return _with_drop(
         L.Mixed(list(input), size=size, act=_act(act),
-                bias=bias_attr is not False, name=name),
+                bias=bias, bias_attr=bias_attr, name=name),
         layer_attr,
     )
 
@@ -647,20 +657,25 @@ def ctc(input, label, size=None, blank=None, norm_by_times=False, name=None, **_
             raise ValueError("ctc: pass size= (or blank=) — cannot infer the "
                              "alphabet size from this input layer")
         blank = int(inferred) - 1
-    return SC.CTCCost(input, label, blank=blank, norm_by_times=norm_by_times, name=name)
+    return SC.CTCCost(input, label, blank=blank, norm_by_times=norm_by_times,
+                      size=size or blank + 1, name=name)
 
 
 def warp_ctc(input, label, size=None, blank=0, norm_by_times=False, name=None, **_compat):
     """warp_ctc_layer: same loss, XLA-native implementation (no warp-ctc dlopen;
     reference paddle/cuda/src/hl_warpctc_wrap.cc)."""
-    return SC.CTCCost(input, label, blank=blank, norm_by_times=norm_by_times, name=name)
+    node = SC.CTCCost(input, label, blank=blank, norm_by_times=norm_by_times,
+                      size=size, name=name)
+    node.type_name = "warp_ctc"  # same math, distinct wire type
+    return node
 
 
-def nce(input, label, num_classes, num_neg_samples=10, neg_distribution=None,
-        bias_attr=None, param_attr=None, name=None, **_compat):
+def nce(input, label, num_classes, weight=None, num_neg_samples=10,
+        neg_distribution=None, bias_attr=None, param_attr=None, name=None,
+        **_compat):
     return SC.NCECost(input, label, num_classes, num_neg_samples=num_neg_samples,
                       neg_distribution=neg_distribution, bias=bias_attr is not False,
-                      param_attr=param_attr, name=name)
+                      param_attr=param_attr, weight=weight, name=name)
 
 
 def hsigmoid(input, label, num_classes, bias_attr=None, param_attr=None, name=None, **_compat):

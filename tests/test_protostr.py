@@ -26,26 +26,48 @@ pytestmark = pytest.mark.skipif(
 
 # configs whose emitted ModelConfig must structurally match the golden
 GOLDEN_MATCH = [
+    "img_layers",
+    "img_trans_layers",
     "last_first_seq",
     "layer_activations",
+    "math_ops",
+    "shared_fc",
+    "simple_rnn_layers",
     "test_BatchNorm3D",
+    "test_bi_grumemory",
+    "test_bilinear_interp",
     "test_clip_layer",
+    "test_conv3d_layer",
+    "test_cost_layers_with_weight",
+    "test_cross_entropy_over_beam",
+    "test_deconv3d_layer",
     "test_expand_layer",
+    "test_fc",
+    "test_gated_unit_layer",
+    "test_grumemory_layer",
+    "test_hsigmoid",
     "test_kmax_seq_socre_layer",
+    "test_lstmemory_layer",
+    "test_maxout",
     "test_multiplex_layer",
     "test_ntm_layers",
     "test_pad",
+    "test_pooling3D_layer",
     "test_prelu_layer",
     "test_print_layer",
     "test_recursive_topology",
     "test_repeat_layer",
     "test_resize_layer",
+    "test_row_conv",
     "test_row_l2_norm_layer",
     "test_scale_shift_layer",
     "test_seq_concat_reshape",
+    "test_seq_slice_layer",
     "test_sequence_pooling",
     "test_smooth_l1",
     "test_split_datasource",
+    "test_spp_layer",
+    "test_sub_nested_seq_select_layer",
     "unused_layers",
 ]
 
