@@ -44,6 +44,12 @@ _TYPE_ALIAS = {
     "grumemory": "gated_recurrent",
     "block_expand": "blockexpand",
     "square_error": "square_error",
+    "rank_cost": "rank-cost",
+    "huber_regression_cost": "huber_regression",
+    "huber_classification_cost": "huber_classification",
+    "cross_entropy": "multi-class-cross-entropy",
+    "cross_entropy_with_selfnorm": "multi_class_cross_entropy_with_selfnorm",
+    "soft_binary_class_cross_entropy": "soft_binary_class_cross_entropy",
 }
 
 _SKIP_ATTRS = {
@@ -361,6 +367,22 @@ def _emit_dropout(layer, ins, out, lc):
     lc.drop_rate = getattr(layer, "rate", None)
 
 
+@_emitter("embedding")
+def _emit_embedding(layer, ins, out, lc):
+    # the reference's embedding_layer is a mixed + table projection
+    # (layers.py embedding_layer → mixed_layer(table_projection))
+    lc.type = "mixed"
+    vocab = getattr(layer, "vocab_size", None)
+    if not vocab:
+        src = layer.inputs[0]
+        spec = getattr(src, "data_type", None)
+        vocab = int(spec.dim) if spec is not None and spec.dim else 0
+    lc.inputs[0].proj_conf = proto.ProjectionConfig(
+        type="table", name=None, input_size=vocab or 0,
+        output_size=getattr(layer, "size", lc.size),
+    )
+
+
 @_emitter("last_seq", "first_seq")
 def _emit_seq_ins(layer, ins, out, lc):
     lc.select_first = layer.type_name == "first_seq"
@@ -470,37 +492,50 @@ _PROJ_TYPES = {
     "Scaling": "scaling",
     "Table": "table",
     "Context_": "context",
+    "ConvProj": "conv",
 }
 
 
-@_emitter("mixed")
+@_emitter("mixed", "concat2")
 def _emit_mixed(layer, ins, out, lc):
     out_feat = out.value.shape[2:] if out.is_seq else out.value.shape[1:]
     out_size = int(np.prod(out_feat)) if out_feat else 1
-    pos = 0
-    for proj in getattr(layer, "projections", []):
-        n = len(proj.sources)
-        arg = ins[pos]
-        lic = lc.inputs[pos]
-        pos += n
+    slot_lists = getattr(
+        layer, "_arg_slots",
+        None,
+    )
+    if slot_lists is None:  # concat2 keeps plain sequential slots
+        slot_lists, pos = [], 0
+        for proj in getattr(layer, "projections", []):
+            slot_lists.append(list(range(pos, pos + len(proj.sources))))
+            pos += len(proj.sources)
+    for proj, slots in zip(getattr(layer, "projections", []), slot_lists):
+        arg = ins[slots[0]]
+        lic = lc.inputs[slots[0]]
         cls = type(proj).__name__
         ptype = _PROJ_TYPES.get(cls)
+        if ptype == "conv" and getattr(proj, "trans", False):
+            ptype = "convt"
         if ptype is None:
-            if cls == "DotMulOperator":
-                lc.operator_confs.append(
-                    proto.OperatorConfig(
-                        type="dot_mul",
-                        input_indices=list(range(pos - n, pos)),
-                        output_size=out_size,
-                    )
+            if cls in ("DotMulOperator", "ConvOperator"):
+                oc = proto.OperatorConfig(
+                    type="dot_mul" if cls == "DotMulOperator" else "conv",
+                    input_indices=list(slots),
+                    output_size=out_size,
                 )
+                if cls == "ConvOperator":
+                    oc.num_filters = proj.num_filters
+                lc.operator_confs.append(oc)
             continue
         feat = arg.value.shape[2:] if arg.is_seq else arg.value.shape[1:]
         in_size = int(np.prod(feat)) if feat else 1
         if ptype == "table":  # input is ids; input_size is the vocab
             in_size = getattr(proj, "vocab_size", None) or in_size
+        psize = out_size
+        if layer.type_name == "concat2" or ptype == "identity":
+            psize = in_size  # each projection contributes its own width
         pc = proto.ProjectionConfig(
-            type=ptype, name=None, input_size=in_size, output_size=out_size
+            type=ptype, name=None, input_size=in_size, output_size=psize
         )
         if ptype == "context":
             pc.context_start = getattr(proj, "context_start", None)
@@ -557,7 +592,22 @@ def build_model_config(
         by_layer.setdefault(lname, {})[pname] = full
 
     mc = proto.ModelConfig()
+    # ExtraAttr drop_rate chains an explicit "{x}.drop" Dropout node here;
+    # the reference folds it into the wrapped layer's drop_rate field —
+    # merge on emission so configs read like the originals
+    alias: Dict[str, str] = {}
+    lc_by_name: Dict[str, proto.LayerConfig] = {}
     for layer in net.layer_order:
+        if (
+            layer.type_name == "dropout"
+            and layer.name.endswith(".drop")
+            and len(layer.inputs) == 1
+            and layer.inputs[0].name == layer.name[: -len(".drop")]
+            and layer.inputs[0].name in lc_by_name
+        ):
+            lc_by_name[layer.inputs[0].name].drop_rate = getattr(layer, "rate", None)
+            alias[layer.name] = layer.inputs[0].name
+            continue
         arg = values[layer.name]
         shape = tuple(int(d) for d in arg.value.shape)
         if arg.is_seq and arg.sub_lengths is not None and len(shape) > 3:
@@ -574,6 +624,7 @@ def build_model_config(
             size=size,
             active_type=_act_name(layer),
         )
+        lc_by_name[layer.name] = lc
         owned = by_layer.get(layer.name, {})
         for bias_key in ("b", "bias"):  # batch_norm names its beta "bias"
             if bias_key in owned:
@@ -582,7 +633,9 @@ def build_model_config(
         weight_names = sorted(owned.values())
         in_args: List[Argument] = []
         for i, inp in enumerate(layer.inputs):
-            lic = proto.LayerInputConfig(input_layer_name=inp.name)
+            lic = proto.LayerInputConfig(
+                input_layer_name=alias.get(inp.name, inp.name)
+            )
             if i < len(weight_names):
                 lic.input_parameter_name = weight_names[i]
             lc.inputs.append(lic)
@@ -612,7 +665,9 @@ def build_model_config(
                 _, lc.height, lc.width = g2
 
     declared = getattr(topology, "declared_outputs", None)
-    mc.output_layer_names = [l.name for l in (declared or net.outputs)]
+    mc.output_layer_names = [
+        alias.get(l.name, l.name) for l in (declared or net.outputs)
+    ]
     mc.sub_models.append(
         proto.SubModelConfig(
             name="root",

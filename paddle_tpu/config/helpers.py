@@ -204,6 +204,7 @@ from paddle_tpu.config.v1_layers import (  # noqa: E402
     bidirectional_lstm,
     classification_cost,
     concat_layer,
+    conv_operator,
     conv_projection,
     crf_decoding_layer,
     crf_layer,
@@ -216,6 +217,11 @@ from paddle_tpu.config.v1_layers import (  # noqa: E402
     fc_layer,
     first_seq,
     gated_unit_layer,
+    get_output_layer,
+    gru_group,
+    gru_step_layer,
+    gru_step_naive_layer,
+    gru_unit,
     img_cmrnorm_layer,
     img_conv3d_layer,
     img_conv_group,
@@ -226,12 +232,16 @@ from paddle_tpu.config.v1_layers import (  # noqa: E402
     kmax_sequence_score_layer,
     lambda_cost,
     last_seq,
+    lstm_step_layer,
     lstmemory,
+    lstmemory_group,
+    lstmemory_unit,
     grumemory,
     maxid_layer,
     maxout_layer,
     nce_layer,
     pooling_layer,
+    recurrent_group,
     recurrent_layer,
     row_conv_layer,
     spp_layer,
@@ -424,6 +434,10 @@ from paddle_tpu.config import layer_math  # noqa: E402
 
 __all__ = [
     "printer_layer", "kmax_seq_score_layer", "layer_math",
+    "lstmemory_group", "lstmemory_unit", "gru_group", "gru_unit",
+    "lstm_step_layer", "gru_step_layer", "gru_step_naive_layer",
+    "simple_gru2", "gated_unit_layer", "seq_slice_layer",
+    "sub_nested_seq_layer", "seq_reshape_layer",
     "AggregateLevel", "ExpandLevel", "IdentityActivation",
     "SqrtActivation", "ReciprocalActivation",
     # attrs / activations / poolings
@@ -481,7 +495,7 @@ __all__ = [
     # networks
     "simple_img_conv_pool", "img_conv_group", "vgg_16_network",
     "text_conv_pool", "simple_attention", "sequence_conv_pool",
-    "conv_projection",
+    "conv_projection", "conv_operator",
     # evaluators
     "classification_error_evaluator", "auc_evaluator",
     "precision_recall_evaluator", "pnpair_evaluator", "sum_evaluator",

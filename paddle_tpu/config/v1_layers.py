@@ -36,7 +36,8 @@ from paddle_tpu.v2.pooling import resolve as _pool_name
 __all__ = [
     "data_layer", "fc_layer", "embedding_layer", "img_conv_layer",
     "img_pool_layer", "img_cmrnorm_layer", "batch_norm_layer",
-    "dropout_layer", "concat_layer", "conv_projection", "pooling_layer",
+    "dropout_layer", "concat_layer", "conv_projection", "conv_operator",
+    "pooling_layer",
     "maxid_layer", "classification_cost", "cross_entropy",
     "img_conv_group", "simple_img_conv_pool", "sequence_conv_pool",
     "text_conv_pool", "simple_lstm", "simple_gru", "bidirectional_lstm",
@@ -48,7 +49,9 @@ __all__ = [
     "img_pool3d_layer",
     "seq_slice_layer", "kmax_sequence_score_layer", "seq_concat_layer",
     "seq_reshape_layer", "sub_nested_seq_layer", "gated_unit_layer",
-    "simple_gru2",
+    "simple_gru2", "lstm_step_layer", "gru_step_layer",
+    "gru_step_naive_layer", "get_output_layer", "lstmemory_unit",
+    "lstmemory_group", "gru_unit", "gru_group", "recurrent_group",
 ]
 
 
@@ -445,8 +448,27 @@ class _ConvProjSpec:
 def conv_projection(input, filter_size, num_filters, num_channels=None,
                     stride=1, padding=0, groups=1, param_attr=None,
                     trans=False):
-    return _ConvProjSpec(input, filter_size, num_filters, num_channels,
-                         stride, padding, groups, param_attr, trans)
+    from paddle_tpu.nn.projections import ConvProj
+
+    if num_channels is None:
+        geom = getattr(input, "_v1_geom", None)
+        num_channels = geom[0] if geom else None
+    return ConvProj(input, filter_size, num_filters,
+                    num_channels=num_channels, stride=stride, padding=padding,
+                    groups=groups, param_attr=_or_none(param_attr), trans=trans)
+
+
+def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
+                  stride=1, padding=0, filter_size_y=None, stride_y=None,
+                  padding_y=None, trans=False):
+    from paddle_tpu.nn.projections import ConvOperator
+
+    if num_channels is None:
+        geom = getattr(img, "_v1_geom", None)
+        num_channels = geom[0] if geom else None
+    return ConvOperator(img, filter, filter_size, num_filters,
+                        num_channels=num_channels, stride=stride,
+                        padding=padding, trans=trans)
 
 
 def concat_layer(input, act=None, name=None, layer_attr=None, bias_attr=None):
@@ -456,7 +478,18 @@ def concat_layer(input, act=None, name=None, layer_attr=None, bias_attr=None):
     ins = list(input) if isinstance(input, (list, tuple)) else [input]
     built: List[Layer] = []
     for i, item in enumerate(ins):
-        if isinstance(item, _ConvProjSpec):
+        if not isinstance(item, Layer) and not hasattr(item, "build"):
+            # plain projections → ConcatenateLayer2 applying them in place
+            from paddle_tpu.nn.projections import Projection
+
+            assert all(isinstance(x, Projection) for x in ins), (
+                "concat_layer mixes projections and layers"
+            )
+            node = L.Concat2(ins, act=_act(act),
+                             bias=bias_attr not in (None, False),
+                             bias_attr=_or_none(bias_attr), name=name)
+            return _with_drop(node, layer_attr)
+        if hasattr(item, "build") and not isinstance(item, Layer):
             built.append(item.build(f"{name}.proj{i}" if name else None))
         elif _is_flat(item) and getattr(item, "_v1_geom", None) is not None:
             built.append(_ensure_nhwc(item, None)[0])  # channel concat needs NHWC
@@ -975,6 +1008,164 @@ def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
         bias_attr=_or_none(bias_param_attr), name=name,
     )
     return _with_drop(_annotate(node, size=size), lstm_cell_attr)
+
+
+def recurrent_group(step, input, reverse=False, name=None, targetInlink=None,
+                    **_compat):
+    """layers.py recurrent_group: marks iterated data roots as (nested)
+    sequences before delegating to the scan-based group."""
+    from paddle_tpu.nn import recurrent_group as rg
+
+    items = input if isinstance(input, (list, tuple)) else [input]
+    for item in items:
+        if isinstance(item, (rg.SubsequenceInput,)):
+            _mark_seq_root(item.input, nested=True)
+        elif isinstance(item, Layer):
+            _mark_seq_root(item)
+    return _v2.recurrent_group(step, input, reverse=reverse, name=name)
+
+
+def lstm_step_layer(input, state, size=None, act=None, gate_act=None,
+                    state_act=None, bias_attr=None, name=None, layer_attr=None):
+    """layers.py lstm_step_layer — one LSTM cell step inside a group."""
+    if size is None:
+        size = (_size_of(input) or 0) // 4
+    node = R.LstmStep(
+        input, state, size, act=_act(act), gate_act=_act(gate_act),
+        state_act=_act(state_act), bias=bias_attr is not False,
+        bias_attr=_or_none(bias_attr), name=name,
+    )
+    return _with_drop(_annotate(node, size=size), layer_attr)
+
+
+def gru_step_layer(input, output_mem, size=None, act=None, gate_act=None,
+                   bias_attr=None, param_attr=None, name=None,
+                   layer_attr=None):
+    if size is None:
+        size = (_size_of(input) or 0) // 3
+    node = R.GruStep(
+        input, output_mem, size, act=_act(act), gate_act=_act(gate_act),
+        bias=bias_attr is not False, bias_attr=_or_none(bias_attr),
+        param_attr=_or_none(param_attr), name=name,
+    )
+    return _with_drop(_annotate(node, size=size), layer_attr)
+
+
+gru_step_naive_layer = gru_step_layer
+
+
+def get_output_layer(input, arg_name, name=None, layer_attr=None):
+    """Dual-role get_output_layer: inside a step net it reads a layer's
+    auxiliary output arg (GetOutputLayer); on a finished recurrent_group it
+    fetches another step output sequence."""
+    from paddle_tpu.nn import recurrent_group as rg
+
+    if hasattr(input, "_group_core") or hasattr(input, "core"):
+        return rg.get_output_layer(input, arg_name, name=name)
+    return R.StepArgOutput(input, arg_name, name=name)
+
+
+def lstmemory_unit(input, out_memory=None, name=None, size=None,
+                   param_attr=None, act=None, gate_act=None, state_act=None,
+                   input_proj_bias_attr=None, input_proj_layer_attr=None,
+                   lstm_bias_attr=None, lstm_layer_attr=None):
+    """networks.py lstmemory_unit: the in-group LSTM step — input+recurrent
+    mixed projection, lstm_step, state published for the state memory."""
+    if size is None:
+        size = (_size_of(input) or 0) // 4
+    if out_memory is None:
+        out_mem = _v2.memory(name=name, size=size)
+    else:
+        out_mem = out_memory
+    state_mem = _v2.memory(name=f"{name}_state", size=size)
+    m = _v2.mixed(
+        size=size * 4,
+        name=f"{name}_input_recurrent",
+        bias_attr=input_proj_bias_attr,
+        layer_attr=input_proj_layer_attr,
+        act="linear",
+        input=[
+            _v2.identity_projection(input=input),
+            _v2.full_matrix_projection(input=out_mem, param_attr=_or_none(param_attr)),
+        ],
+    )
+    _annotate(m, size=size * 4)
+    lstm_out = lstm_step_layer(
+        name=name, input=m, state=state_mem, size=size,
+        bias_attr=lstm_bias_attr, act=act, gate_act=gate_act,
+        state_act=state_act, layer_attr=lstm_layer_attr,
+    )
+    get_output_layer(name=f"{name}_state", input=lstm_out, arg_name="state")
+    return lstm_out
+
+
+def lstmemory_group(input, size=None, name=None, out_memory=None,
+                    reverse=False, param_attr=None, act=None, gate_act=None,
+                    state_act=None, input_proj_bias_attr=None,
+                    input_proj_layer_attr=None, lstm_bias_attr=None,
+                    lstm_layer_attr=None):
+    """networks.py lstmemory_group: lstmemory_unit unrolled by
+    recurrent_group (the layer-composed LSTM, vs the fused lstmemory)."""
+    _mark_seq_root(input)
+    if size is None:
+        size = (_size_of(input) or 0) // 4
+
+    def __lstm_step__(ipt):
+        return lstmemory_unit(
+            input=ipt, name=name, size=size, act=act, gate_act=gate_act,
+            state_act=state_act, out_memory=out_memory,
+            input_proj_bias_attr=input_proj_bias_attr,
+            input_proj_layer_attr=input_proj_layer_attr,
+            param_attr=param_attr, lstm_layer_attr=lstm_layer_attr,
+            lstm_bias_attr=lstm_bias_attr,
+        )
+
+    node = _v2.recurrent_group(
+        name=f"{name}_recurrent_group", step=__lstm_step__, reverse=reverse,
+        input=input,
+    )
+    if size is None:
+        size = (_size_of(input) or 0) // 4
+    return _annotate(node, size=size)
+
+
+def gru_unit(input, memory_boot=None, size=None, name=None, gru_bias_attr=None,
+             gru_param_attr=None, act=None, gate_act=None, gru_layer_attr=None,
+             naive=False):
+    """networks.py gru_unit: in-group GRU step with its output memory."""
+    if size is None:
+        size = (_size_of(input) or 0) // 3
+    out_mem = _v2.memory(name=name, size=size, boot_layer=memory_boot)
+    return gru_step_layer(
+        name=name, input=input, output_mem=out_mem, size=size,
+        bias_attr=gru_bias_attr, param_attr=gru_param_attr, act=act,
+        gate_act=gate_act, layer_attr=gru_layer_attr,
+    )
+
+
+def gru_group(input, memory_boot=None, size=None, name=None, reverse=False,
+              gru_bias_attr=None, gru_param_attr=None, act=None,
+              gate_act=None, gru_layer_attr=None, naive=False):
+    """networks.py gru_group: gru_unit unrolled by recurrent_group."""
+    _mark_seq_root(input)
+    if size is None:
+        size = (_size_of(input) or 0) // 3
+
+    def __gru_step__(ipt):
+        return gru_unit(
+            input=ipt, memory_boot=memory_boot, name=name, size=size,
+            gru_bias_attr=gru_bias_attr, gru_param_attr=gru_param_attr,
+            act=act, gate_act=gate_act, gru_layer_attr=gru_layer_attr,
+            naive=naive,
+        )
+
+    node = _v2.recurrent_group(
+        name=f"{name}_recurrent_group", step=__gru_step__, reverse=reverse,
+        input=input,
+    )
+    if size is None:
+        size = (_size_of(input) or 0) // 3
+    return _annotate(node, size=size)
 
 
 def gated_unit_layer(input, size, act=None, name=None, gate_attr=None,

@@ -77,6 +77,7 @@ class Fc(Layer):
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         total = None
+        any_seq = any(a.is_seq for a in ins)
         for i, arg in enumerate(ins):
             x = arg.value
             if not arg.is_seq and x.ndim > 2:
@@ -92,6 +93,10 @@ class Fc(Layer):
                 self, "w" + suffix, (d, self.size), init_mod.smart_normal, pa
             )
             y = linalg.matmul(x, w, ctx.policy)
+            if any_seq and y.ndim == 2:
+                # flat input mixed with sequence inputs: broadcast over time
+                # (the reference adds the non-seq row to every token)
+                y = y[:, None]
             total = y if total is None else total + y
         if self.bias:
             b = ctx.param(self, "b", (self.size,), init_mod.zeros, self.bias_attr)
@@ -596,17 +601,33 @@ class Mixed(Layer):
         from paddle_tpu.nn.projections import Projection
 
         self.projections = []
-        srcs: List[Layer] = []
         for p in input:
             if not isinstance(p, Projection):
                 raise TypeError("mixed layer inputs must be Projections")
             self.projections.append(p)
-            srcs.extend(p.sources)
-        super().__init__(srcs, name=name)
+        super().__init__([], name=name)
+        self._relayout()
         self.size = size
         self.act = act
         self.bias = bias
         self.bias_attr = _attr(bias_attr)
+
+    def _relayout(self):
+        """Input-slot layout matching the reference's MixedLayer config:
+        each projection/operator claims one slot in declaration order for its
+        FIRST source; operators' extra sources append at the end (that is how
+        the golden protostrs index operator_confs.input_indices)."""
+        slots: List[Layer] = []
+        arg_slots: List[List[int]] = []
+        for p in self.projections:
+            arg_slots.append([len(slots)])
+            slots.append(p.sources[0])
+        for i, p in enumerate(self.projections):
+            for extra in p.sources[1:]:
+                arg_slots[i].append(len(slots))
+                slots.append(extra)
+        self.inputs = slots
+        self._arg_slots = arg_slots
 
     # -- incremental construction (trainer_config_helpers MixedLayerType:
     #    `with mixed_layer(size=N) as m: m += full_matrix_projection(x)`) ----
@@ -616,7 +637,7 @@ class Mixed(Layer):
         if not isinstance(proj, Projection):
             raise TypeError("mixed layer inputs must be Projections")
         self.projections.append(proj)
-        self.inputs.extend(proj.sources)
+        self._relayout()
         return self
 
     def __enter__(self):
@@ -629,12 +650,9 @@ class Mixed(Layer):
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         out = None
-        pos = 0
         first_arg = None
-        for proj in self.projections:
-            n = len(proj.sources)
-            args = ins[pos : pos + n]
-            pos += n
+        for proj, slots in zip(self.projections, self._arg_slots):
+            args = [ins[j] for j in slots]
             if first_arg is None:
                 first_arg = args[0]
             y = proj.apply(ctx, self, args, self.size)
@@ -644,6 +662,47 @@ class Mixed(Layer):
             out = out + b
         out = act_mod.apply(self.act, out)
         return first_arg.with_value(out)
+
+
+@LAYERS.register("concat2")
+class Concat2(Layer):
+    """ConcatenateLayer2: apply a projection per input, concatenate results
+    feature-wise (the projection-input form of concat_layer)."""
+
+    type_name = "concat2"
+
+    def __init__(self, input, act: Any = None, bias: bool = False,
+                 bias_attr: Any = None, name: Optional[str] = None):
+        from paddle_tpu.nn.projections import Projection
+
+        self.projections = []
+        srcs: List[Layer] = []
+        for p in input:
+            if not isinstance(p, Projection):
+                raise TypeError("concat2 inputs must be Projections")
+            self.projections.append(p)
+            srcs.extend(p.sources)
+        super().__init__(srcs, name=name)
+        self.act = act
+        self.bias = bias
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx, ins):
+        outs = []
+        pos = 0
+        first_arg = None
+        for proj in self.projections:
+            n = len(proj.sources)
+            args = ins[pos : pos + n]
+            pos += n
+            if first_arg is None:
+                first_arg = args[0]
+            outs.append(proj.apply(ctx, self, args, None))
+        out = jnp.concatenate(outs, axis=-1)
+        if self.bias:
+            b = ctx.param(self, "b", (out.shape[-1],), init_mod.zeros, self.bias_attr)
+            out = out + b
+        return first_arg.with_value(act_mod.apply(self.act, out))
 
 
 @LAYERS.register("trans")

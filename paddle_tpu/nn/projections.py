@@ -14,11 +14,16 @@ import jax.numpy as jnp
 
 from paddle_tpu.nn import init as init_mod
 from paddle_tpu.nn.graph import Argument, Context, Layer, ParamAttr
+import jax
+
+from paddle_tpu.ops import conv as conv_ops
 from paddle_tpu.ops import linalg
 from paddle_tpu.ops import sequence as seq_ops
 
 
 class Projection:
+    is_operator = False  # operators (Operator.h) append after projections
+
     def __init__(self, sources: Sequence[Layer], param_attr: Any = None):
         self.sources: List[Layer] = list(sources)
         self.param_attr = (
@@ -35,17 +40,21 @@ class Projection:
 
 
 class FullMatrix(Projection):
-    """FullMatrixProjection: x @ W."""
+    """FullMatrixProjection: x @ W. `size` may come from the projection
+    itself (full_matrix_projection(size=N) inside a size-0 mixed) or the
+    enclosing mixed layer."""
 
-    def __init__(self, input: Layer, param_attr: Any = None):
+    def __init__(self, input: Layer, param_attr: Any = None, size: int = 0):
         super().__init__([input], param_attr)
+        self.size = size
 
     def apply(self, ctx, owner, args, size):
         x = args[0].value
+        out = self.size or size
         w = ctx.param(
             owner,
             self._pname(owner, "w"),
-            (x.shape[-1], size),
+            (x.shape[-1], out),
             init_mod.smart_normal,
             self.param_attr,
         )
@@ -121,16 +130,25 @@ class Scaling(Projection):
 class Table(Projection):
     """TableProjection: embedding lookup from int-id input."""
 
-    def __init__(self, input: Layer, vocab_size: int, param_attr: Any = None):
+    def __init__(self, input: Layer, vocab_size: Optional[int] = None,
+                 param_attr: Any = None):
         super().__init__([input], param_attr)
         self.vocab_size = vocab_size
 
     def apply(self, ctx, owner, args, size):
-        ids = args[0].value.astype(jnp.int32)
+        v = args[0].value
+        vocab = self.vocab_size
+        if not vocab:
+            # no id slot declared: the reference sizes the table by the
+            # input layer's width (config_parser TableProjection)
+            vocab = int(v.shape[-1]) if v.ndim > 1 else 2
+        if v.ndim > 1 and not jnp.issubdtype(v.dtype, jnp.integer):
+            v = v[..., 0]  # dense slot reused as ids: first column at trace
+        ids = jnp.clip(v.astype(jnp.int32), 0, vocab - 1)
         table = ctx.param(
             owner,
             self._pname(owner, "w"),
-            (self.vocab_size, size),
+            (vocab, size),
             init_mod.smart_normal,
             self.param_attr,
         )
@@ -146,24 +164,76 @@ class Context_(Projection):
         input: Layer,
         context_start: int,
         context_len: int,
-        trainable_padding: bool = False,
+        trainable_padding: bool = True,
         param_attr: Any = None,
     ):
         super().__init__([input], param_attr)
         self.context_start = context_start
         self.context_len = context_len
-        self.trainable_padding = trainable_padding
+        # boundary rows needing padding (ContextProjection.cpp beginPad_/endPad_)
+        self.left_pad = max(0, -context_start)
+        self.right_pad = max(0, context_start + context_len - 1)
+        self.trainable_padding = trainable_padding and (
+            self.left_pad + self.right_pad > 0
+        )
 
     def apply(self, ctx, owner, args, size):
         arg = args[0]
-        assert arg.is_seq, "context projection needs a sequence input"
-        return seq_ops.context_projection(
+        if not arg.is_seq:  # tolerate a non-seq slot: length-1 sequence
+            v = arg.value[:, None]
+            lengths = jnp.ones((v.shape[0],), jnp.int32)
+            base = seq_ops.context_projection(
+                v, lengths, self.context_start, self.context_len
+            )
+            if self.trainable_padding:
+                base = base + self._pad_correction(ctx, owner, v, lengths)
+            return base[:, 0]
+        base = seq_ops.context_projection(
             arg.value, arg.lengths, self.context_start, self.context_len
         )
+        if self.trainable_padding:
+            base = base + self._pad_correction(
+                ctx, owner, arg.value, arg.lengths
+            )
+        return base
+
+    def _pad_correction(self, ctx, owner, x, lengths):
+        """Learned boundary rows where the context window runs off either end
+        (replacing the zero padding of the base projection)."""
+        b, t, d = x.shape
+        lp, rp = self.left_pad, self.right_pad
+        w = ctx.param(
+            owner,
+            self._pname(owner, "w"),
+            (lp + rp, d),
+            init_mod.zeros,
+            self.param_attr,
+        )
+        cols = []
+        pos = jnp.arange(t)
+        zero = jnp.zeros((b, t, d), x.dtype)
+        for o in range(self.context_start, self.context_start + self.context_len):
+            src = pos + o
+            if o < 0 and lp:
+                row = jnp.clip(src + lp, 0, lp - 1)
+                corr = jnp.where(
+                    (src < 0)[None, :, None], w[row][None], zero
+                )
+            elif o > 0 and rp:
+                over = src[None, :] >= lengths[:, None]
+                row = jnp.clip(lp + src[None, :] - lengths[:, None], lp,
+                               lp + rp - 1)
+                corr = jnp.where(over[:, :, None], w[row], zero)
+            else:
+                corr = zero
+            cols.append(corr)
+        return jnp.concatenate(cols, axis=-1)
 
 
 class DotMulOperator(Projection):
     """DotMulOperator: elementwise product of two inputs (no params)."""
+
+    is_operator = True
 
     def __init__(self, input1: Layer, input2: Layer, scale: float = 1.0):
         super().__init__([input1, input2])
@@ -171,3 +241,115 @@ class DotMulOperator(Projection):
 
     def apply(self, ctx, owner, args, size):
         return self.scale * args[0].value * args[1].value
+
+
+class ConvProj(Projection):
+    """ConvProjection (math/ConvProjection.cpp): a parameterized conv applied
+    inside mixed/concat. Flat [B, c*h*w] CHW inputs are viewed as NHWC;
+    output flattens back to the reference's flat layout."""
+
+    def __init__(self, input: Layer, filter_size, num_filters: int,
+                 num_channels=None, stride=1, padding=0, groups: int = 1,
+                 param_attr: Any = None, trans: bool = False):
+        super().__init__([input], param_attr)
+        self.filter_size = filter_size
+        self.num_filters = num_filters
+        self.num_channels = num_channels
+        self.stride = stride
+        self.padding = padding
+        self.groups = groups
+        self.trans = trans
+
+    def _as_nhwc(self, x):
+        if x.ndim == 4:
+            return x
+        import math as _math
+
+        c = self.num_channels
+        side = _math.isqrt(x.shape[-1] // c)
+        return x.reshape(x.shape[0], c, side, side).transpose(0, 2, 3, 1)
+
+    def apply(self, ctx, owner, args, size):
+        x = self._as_nhwc(args[0].value)
+        kh, kw = conv_ops._pair(self.filter_size)
+        cin = x.shape[-1]
+        shape = (
+            (kh, kw, self.num_filters, cin)  # forward conv's HWIO (deconv)
+            if self.trans
+            else (kh, kw, cin // self.groups, self.num_filters)
+        )
+        w = ctx.param(
+            owner,
+            self._pname(owner, "w"),
+            shape,
+            init_mod.he_normal,
+            self.param_attr,
+        )
+        if self.trans:
+            y = conv_ops.conv2d_transpose(
+                x, w, self.stride, self.padding, policy=ctx.policy
+            )
+        else:
+            y = conv_ops.conv2d(
+                x, w, self.stride, self.padding, 1, self.groups, ctx.policy
+            )
+        return y.reshape(y.shape[0], -1)
+
+    def build(self, name: str) -> Layer:
+        """Materialize as an img_conv layer (the concat_layer /
+        inception-tower path, ConcatenateLayer2 with conv projections)."""
+        from paddle_tpu.config.v1_layers import img_conv_layer
+
+        return img_conv_layer(
+            self.sources[0], self.filter_size, self.num_filters, name=name,
+            num_channels=self.num_channels, act="linear", groups=self.groups,
+            stride=self.stride, padding=self.padding, bias_attr=False,
+            param_attr=self.param_attr, trans=self.trans,
+        )
+
+
+class ConvOperator(Projection):
+    """ConvOperator (gserver ConvOperator.cpp): convolution whose filter is
+    ANOTHER LAYER's output — per-sample dynamic filters, vmapped conv."""
+
+    is_operator = True
+
+    def __init__(self, img: Layer, filt: Layer, filter_size, num_filters: int,
+                 num_channels=None, stride=1, padding=0,
+                 trans: bool = False):
+        super().__init__([img, filt], None)
+        self.filter_size = filter_size
+        self.num_filters = num_filters
+        self.num_channels = num_channels
+        self.stride = stride
+        self.padding = padding
+        self.trans = trans
+
+    def apply(self, ctx, owner, args, size):
+        import math as _math
+
+        x = args[0].value
+        if x.ndim != 4:
+            c = self.num_channels
+            side = _math.isqrt(x.shape[-1] // c)
+            x = x.reshape(x.shape[0], c, side, side).transpose(0, 2, 3, 1)
+        kh, kw = conv_ops._pair(self.filter_size)
+        cin = x.shape[-1]
+        if self.trans:  # filter of the equivalent forward conv (HWIO)
+            w = args[1].value.reshape(-1, kh, kw, self.num_filters, cin)
+        else:
+            w = args[1].value.reshape(-1, kh, kw, cin, self.num_filters)
+        if w.shape[0] == 1:
+            w = jnp.broadcast_to(w, (x.shape[0],) + w.shape[1:])
+
+        def one(xi, wi):
+            if self.trans:
+                return conv_ops.conv2d_transpose(
+                    xi[None], wi, self.stride, self.padding, policy=ctx.policy
+                )[0]
+            return conv_ops.conv2d(
+                xi[None], wi, self.stride, self.padding, 1, 1, ctx.policy
+            )[0]
+
+        y = jax.vmap(one)(x, w)
+        return y.reshape(y.shape[0], -1)

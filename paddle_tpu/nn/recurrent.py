@@ -16,6 +16,7 @@ from paddle_tpu.core.registry import LAYERS
 from paddle_tpu.nn import init as init_mod
 from paddle_tpu.nn.graph import Argument, Context, Layer
 from paddle_tpu.nn.layers import Fc, _attr
+from paddle_tpu.nn import activations as act_mod
 from paddle_tpu.ops import rnn as rnn_ops
 
 
@@ -258,3 +259,111 @@ class MDLstm(Layer):
         )
         out = md_ops.mdlstm_2d(proj, p, self.directions)
         return ins[0].with_value(out)
+
+
+@LAYERS.register("lstm_step")
+class LstmStep(Layer):
+    """LstmStepLayer.cpp: one LSTM cell step for recurrent groups. Inputs:
+    (projected [B, 4H] = Wx + Uh already mixed by the caller, cell state
+    memory [B, H]). Output: h; the new cell state is published under
+    `{name}::state` for StepArgOutput (the reference's two-arg output +
+    get_output_layer(arg_name='state'))."""
+
+    type_name = "lstm_step"
+
+    def __init__(self, input: Layer, state: Layer, size: int,
+                 act: Any = "tanh", gate_act: Any = "sigmoid",
+                 state_act: Any = "tanh", bias: bool = True,
+                 bias_attr: Any = None, name=None):
+        super().__init__([input, state], name=name)
+        self.size = size
+        self.act = act or "tanh"
+        self.gate_act = gate_act or "sigmoid"
+        self.state_act = state_act or "tanh"
+        self.bias = bias
+        self.bias_attr = _attr(bias_attr)
+
+    def forward(self, ctx, ins):
+        m, c_prev = ins[0].value, ins[1].value
+        hid = self.size
+        assert m.shape[-1] == 4 * hid, (
+            f"{self.name}: lstm_step input width {m.shape[-1]} != 4*size"
+        )
+        if self.bias:
+            b = ctx.param(self, "b", (4 * hid,), init_mod.zeros, self.bias_attr)
+            m = m + b
+        gi = act_mod.apply(self.gate_act, m[..., :hid])
+        gf = act_mod.apply(self.gate_act, m[..., hid : 2 * hid])
+        gc = act_mod.apply(self.act, m[..., 2 * hid : 3 * hid])
+        go = act_mod.apply(self.gate_act, m[..., 3 * hid :])
+        c = gf * c_prev + gi * gc
+        h = go * act_mod.apply(self.state_act, c)
+        ctx.cache[f"{self.name}::state"] = Argument(c)
+        return Argument(h)
+
+
+@LAYERS.register("gru_step", "gru_step_naive")
+class GruStep(Layer):
+    """GruStepLayer.cpp: one GRU step. Inputs: (projected [B, 3H] = Wx,
+    previous output memory [B, H])."""
+
+    type_name = "gru_step"
+
+    def __init__(self, input: Layer, output_mem: Layer, size: int,
+                 act: Any = "tanh", gate_act: Any = "sigmoid",
+                 bias: bool = True, bias_attr: Any = None,
+                 param_attr: Any = None, name=None):
+        super().__init__([input, output_mem], name=name)
+        self.size = size
+        self.act = act or "tanh"
+        self.gate_act = gate_act or "sigmoid"
+        self.bias = bias
+        self.bias_attr = _attr(bias_attr)
+        self.param_attr = _attr(param_attr)
+
+    def forward(self, ctx, ins):
+        m, h_prev = ins[0].value, ins[1].value
+        hid = self.size
+        assert m.shape[-1] == 3 * hid, (
+            f"{self.name}: gru_step input width {m.shape[-1]} != 3*size"
+        )
+        # recurrent weights (GruStepLayer holds U_{z,r} and U_c)
+        w_hzr = ctx.param(
+            self, "w_hzr", (hid, 2 * hid), init_mod.smart_normal, self.param_attr
+        )
+        c_attr = self.param_attr
+        if c_attr is not None and c_attr.name:
+            import dataclasses as _dc
+
+            c_attr = _dc.replace(c_attr, name=c_attr.name + ".c")
+        w_hc = ctx.param(self, "w_hc", (hid, hid), init_mod.smart_normal, c_attr)
+        if self.bias:
+            b = ctx.param(self, "b", (3 * hid,), init_mod.zeros, self.bias_attr)
+            m = m + b
+        zr = m[..., : 2 * hid] + h_prev @ w_hzr
+        z = act_mod.apply(self.gate_act, zr[..., :hid])
+        r = act_mod.apply(self.gate_act, zr[..., hid:])
+        c = act_mod.apply(self.act, m[..., 2 * hid :] + (r * h_prev) @ w_hc)
+        return Argument((1.0 - z) * h_prev + z * c)
+
+
+@LAYERS.register("step_arg_output")
+class StepArgOutput(Layer):
+    """In-step get_output_layer: reads a named auxiliary output another step
+    layer published (GetOutputLayer over Argument args, gserver
+    GetOutputLayer.cpp)."""
+
+    type_name = "step_arg_output"
+
+    def __init__(self, input: Layer, arg_name: str, name=None):
+        super().__init__(input, name=name)
+        self.arg_name = arg_name
+
+    def forward(self, ctx, ins):
+        key = f"{self.inputs[0].name}::{self.arg_name}"
+        if key not in ctx.cache:
+            raise ValueError(
+                f"{self.name}: {self.inputs[0].name} published no "
+                f"{self.arg_name!r} output"
+            )
+        return ctx.cache[key]

@@ -76,6 +76,11 @@ class MemoryLayer(_Placeholder):
         self.boot_layer = boot_layer
         self.boot_bias = boot_bias
 
+    def set_input(self, layer: Layer) -> None:
+        """Deferred link (layers.py memory().set_input idiom): point this
+        memory at a step layer chosen after construction."""
+        self.link_name = layer.name
+
 
 class StaticInput:
     """Wrapper marking an outer-graph layer fed unchanged to every timestep
@@ -381,8 +386,16 @@ class RecurrentGroup(Layer):
             valid = (t < lengths)  # [B]
             new_carry = {}
             for m in core.memories:
-                new = values[core.links[m.name].name].value
+                link_arg = values[core.links[m.name].name]
+                new = link_arg.value
                 old = carry[m.name]
+                if new.ndim == old.ndim + 1 and link_arg.is_seq:
+                    # non-seq memory of a sequence-valued step layer carries
+                    # its last valid instance (RecurrentGradientMachine's
+                    # scatter of the frame's last agent state)
+                    from paddle_tpu.ops import sequence as _seq_ops
+
+                    new = _seq_ops.seq_last(new, link_arg.lengths)
                 mask = valid.reshape((-1,) + (1,) * (new.ndim - 1))
                 new_carry[m.name] = jnp.where(mask, new, old)
             return new_carry, tuple(values[n].value for n in out_names)
@@ -474,8 +487,16 @@ class RecurrentGroup(Layer):
             valid = (s < outer_len)  # [B]
             new_carry = {}
             for m in core.memories:
-                new = values[core.links[m.name].name].value
+                link_arg = values[core.links[m.name].name]
+                new = link_arg.value
                 old = carry[m.name]
+                if new.ndim == old.ndim + 1 and link_arg.is_seq:
+                    # non-seq memory of a sequence-valued step layer carries
+                    # its last valid instance (RecurrentGradientMachine's
+                    # scatter of the frame's last agent state)
+                    from paddle_tpu.ops import sequence as _seq_ops
+
+                    new = _seq_ops.seq_last(new, link_arg.lengths)
                 mask = valid.reshape((-1,) + (1,) * (new.ndim - 1))
                 new_carry[m.name] = jnp.where(mask, new, old)
             return new_carry, tuple(values[n].value for n in out_names)

@@ -524,8 +524,8 @@ def mixed(size=0, input=None, act=None, bias_attr=False, name=None, layer_attr=N
 
 
 def full_matrix_projection(input, size=0, param_attr=None):
-    # `size` comes from the enclosing mixed() at apply time
-    return P.FullMatrix(input, param_attr=param_attr)
+    # size may be given here or by the enclosing mixed() at apply time
+    return P.FullMatrix(input, param_attr=param_attr, size=size)
 
 
 def trans_full_matrix_projection(input, size=0, param_attr=None):
@@ -552,8 +552,13 @@ def table_projection(input, size=0, param_attr=None, vocab_size=None):
 def context_projection(input, context_len, context_start=None,
                        padding_attr=False, **_compat):
     start = -(context_len // 2) if context_start is None else context_start
+    # the reference's wrap_param_attr_default turns the False default into a
+    # ParamAttr, so boundary padding is trainable unless padding_attr=None
+    # (its goldens record trainable_padding: true for plain calls; the
+    # zero-initialized rows start out identical to zero padding)
     return P.Context_(input, start, context_len,
-                      trainable_padding=padding_attr is not False and padding_attr is not None)
+                      trainable_padding=padding_attr is not None,
+                      param_attr=padding_attr if not isinstance(padding_attr, bool) else None)
 
 
 def scaling_projection(input, param_attr=None):

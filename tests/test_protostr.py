@@ -31,6 +31,7 @@ GOLDEN_MATCH = [
     "last_first_seq",
     "layer_activations",
     "math_ops",
+    "projections",
     "shared_fc",
     "simple_rnn_layers",
     "test_BatchNorm3D",
@@ -38,6 +39,7 @@ GOLDEN_MATCH = [
     "test_bilinear_interp",
     "test_clip_layer",
     "test_conv3d_layer",
+    "test_cost_layers",
     "test_cost_layers_with_weight",
     "test_cross_entropy_over_beam",
     "test_deconv3d_layer",
@@ -69,6 +71,7 @@ GOLDEN_MATCH = [
     "test_spp_layer",
     "test_sub_nested_seq_select_layer",
     "unused_layers",
+    "util_layers",
 ]
 
 
