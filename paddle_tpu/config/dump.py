@@ -50,6 +50,7 @@ _TYPE_ALIAS = {
     "cross_entropy": "multi-class-cross-entropy",
     "cross_entropy_with_selfnorm": "multi_class_cross_entropy_with_selfnorm",
     "soft_binary_class_cross_entropy": "soft_binary_class_cross_entropy",
+    "step_arg_output": "get_output",
 }
 
 _SKIP_ATTRS = {
@@ -362,6 +363,32 @@ def _emit_block_expand(layer, ins, out, lc):
     lc.inputs[0].block_expand_conf = bc
 
 
+@_emitter("multibox_loss")
+def _emit_multibox(layer, ins, out, lc):
+    lc.size = 1
+    lc.inputs[0].multibox_loss_conf = proto.MultiBoxLossConfig(
+        num_classes=layer.num_classes,
+        overlap_threshold=layer.overlap_threshold,
+        neg_pos_ratio=layer.neg_pos_ratio,
+        neg_overlap=getattr(layer, "neg_overlap", 0.5),
+        background_id=layer.background_id,
+        input_num=layer.n_heads,
+    )
+
+
+@_emitter("detection_output")
+def _emit_detection_output(layer, ins, out, lc):
+    lc.inputs[0].detection_output_conf = proto.DetectionOutputConfig(
+        num_classes=layer.num_classes,
+        nms_threshold=layer.nms_threshold,
+        nms_top_k=layer.nms_top_k,
+        background_id=layer.background_id,
+        input_num=layer.n_heads,
+        keep_top_k=layer.keep_top_k,
+        confidence_threshold=layer.confidence_threshold,
+    )
+
+
 @_emitter("dropout")
 def _emit_dropout(layer, ins, out, lc):
     lc.drop_rate = getattr(layer, "rate", None)
@@ -570,6 +597,149 @@ def _layer_attrs(layer: Layer, consumed: set) -> Dict[str, object]:
     return out
 
 
+def _v1_size_of(layer: Layer) -> int:
+    s = getattr(layer, "_v1_size", None)
+    if s:
+        return int(s)
+    shape = getattr(layer, "shape", None)
+    if shape:
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n
+    sz = getattr(layer, "size", None)
+    if isinstance(sz, int):
+        return sz
+    return 0
+
+
+def _emit_recurrent_group(layer, mc, by_layer, alias, seen_cores) -> None:
+    """Expand a RecurrentGroup node the way config_parser's
+    RecurrentLayerGroup{Begin,End} do: a `recurrent_layer_group` marker, one
+    scatter_agent per in-link, one `+delay1` agent per memory, the step net's
+    layers suffixed `@{group}`, and a gather_agent per step output exposed
+    under the step layer's own name. The group node itself aliases to its
+    output's gather agent, so downstream inputs read like the reference."""
+    from paddle_tpu.nn.recurrent_group import MemoryLayer, _Placeholder
+
+    core = layer.core
+    group = None
+    # the group marker carries the *group* name; our nodes carry it directly
+    group = layer.name
+    out_layer = core.out_layers[layer.out_index]
+    alias[layer.name] = out_layer.name
+    if id(core) in seen_cores:
+        return
+    seen_cores[id(core)] = group
+
+    mc.layers.append(
+        proto.LayerConfig(name=group, type="recurrent_layer_group")
+    )
+    sub = proto.SubModelConfig(
+        name=group, is_recurrent_layer_group=True,
+        reversed=bool(core.reverse),
+    )
+    sub.layer_names.append(group)
+
+    def in_group(n: str) -> str:
+        return f"{n}@{group}"
+
+    ph_names: Dict[str, str] = {}
+    for ph in core.placeholders:
+        src = getattr(ph, "src_layer", None)
+        if src is None:
+            continue
+        agent = in_group(src.name)
+        ph_names[ph.name] = agent
+        mc.layers.append(
+            proto.LayerConfig(
+                name=agent, type="scatter_agent", size=_v1_size_of(ph)
+            )
+        )
+        sub.layer_names.append(agent)
+        sub.in_links.append(
+            proto.LinkConfig(layer_name=agent, link_name=src.name)
+        )
+    for m in core.memories:
+        link = core.links[m.name]
+        # named memories surface as "{name}+delay1"; anonymous ones keep
+        # their auto "__memory_N__" name (config_parser Memory naming)
+        if getattr(m, "user_named", True):
+            agent = f"{link.name}+delay1@{group}"
+        else:
+            agent = in_group(m.name)
+        ph_names[m.name] = agent
+        mc.layers.append(
+            proto.LayerConfig(name=agent, type="agent", size=m.size or 0)
+        )
+        sub.layer_names.append(agent)
+        memc = proto.MemoryConfig(
+            link_name=in_group(link.name), layer_name=agent
+        )
+        if m.boot_layer is not None:
+            memc.boot_layer_name = m.boot_layer.name
+        sub.memories.append(memc)
+
+    for step_l in core.order:
+        if isinstance(step_l, (_Placeholder, MemoryLayer)):
+            continue
+        lc = proto.LayerConfig(
+            name=in_group(step_l.name),
+            type=_TYPE_ALIAS.get(step_l.type_name, step_l.type_name),
+            size=_v1_size_of(step_l),
+            active_type=_act_name(step_l),
+        )
+        owned = dict(by_layer.get(step_l.name, {}))
+        for bias_key in ("b", "bias"):
+            if bias_key in owned:
+                lc.bias_parameter_name = owned.pop(bias_key)
+                break
+        weight_names = sorted(owned.values())
+        for i, inp in enumerate(step_l.inputs):
+            lic = proto.LayerInputConfig(
+                input_layer_name=ph_names.get(inp.name, in_group(inp.name))
+            )
+            if i < len(weight_names):
+                lic.input_parameter_name = weight_names[i]
+            lc.inputs.append(lic)
+        # typed sub-confs from annotations (no traced values in-group)
+        if step_l.type_name in ("mixed", "concat2"):
+            _emit_ingroup_mixed(step_l, lc, ph_names, group)
+        mc.layers.append(lc)
+        sub.layer_names.append(in_group(step_l.name))
+
+    for out_l in core.out_layers:
+        mc.layers.append(
+            proto.LayerConfig(
+                name=out_l.name, type="gather_agent", size=_v1_size_of(out_l)
+            )
+        )
+        sub.layer_names.append(out_l.name)
+        sub.out_links.append(
+            proto.LinkConfig(layer_name=in_group(out_l.name), link_name=out_l.name)
+        )
+    mc.sub_models.append(sub)
+
+
+def _emit_ingroup_mixed(step_l, lc, ph_names, group) -> None:
+    slot_lists = getattr(step_l, "_arg_slots", [])
+    out_size = _v1_size_of(step_l)
+    for proj, slots in zip(getattr(step_l, "projections", []), slot_lists):
+        cls = type(proj).__name__
+        ptype = _PROJ_TYPES.get(cls)
+        if ptype is None:
+            continue
+        src = proj.sources[0]
+        in_size = _v1_size_of(src)
+        if ptype == "identity" and not in_size:
+            in_size = out_size
+        lc.inputs[slots[0]].proj_conf = proto.ProjectionConfig(
+            type=ptype, name=None,
+            input_size=in_size,
+            output_size=in_size if ptype == "identity" else out_size,
+        )
+
+
 def build_model_config(
     topology: Union[Topology, Layer, Sequence[Layer]],
     batch_size: int = 2,
@@ -597,7 +767,11 @@ def build_model_config(
     # merge on emission so configs read like the originals
     alias: Dict[str, str] = {}
     lc_by_name: Dict[str, proto.LayerConfig] = {}
+    seen_cores: Dict[int, str] = {}
     for layer in net.layer_order:
+        if hasattr(layer, "core") and layer.type_name == "recurrent_layer_group":
+            _emit_recurrent_group(layer, mc, by_layer, alias, seen_cores)
+            continue
         if (
             layer.type_name == "dropout"
             and layer.name.endswith(".drop")

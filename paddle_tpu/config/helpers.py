@@ -211,6 +211,7 @@ from paddle_tpu.config.v1_layers import (  # noqa: E402
     cross_entropy,
     ctc_layer,
     data_layer,
+    detection_output_layer,
     dropout_layer,
     embedding_layer,
     expand_layer,
@@ -239,6 +240,7 @@ from paddle_tpu.config.v1_layers import (  # noqa: E402
     grumemory,
     maxid_layer,
     maxout_layer,
+    multibox_loss_layer,
     nce_layer,
     pooling_layer,
     recurrent_group,

@@ -208,6 +208,9 @@ _REF_PARAM = re.compile(r"^_(?P<layer>.+)\.(?:w(?P<idx>\d+)|(?P<bias>wbias)|(?P<
 
 
 def normalize_ref_param(name: str) -> str:
+    # in-group parameters carry the "@<group>" suffix on the owning layer
+    # (RecurrentLayerGroup name mangling); our params use the plain step name
+    name = re.sub(r"@[^.]+", "", name)
     m = _REF_PARAM.match(name)
     if m is None:
         return name
@@ -225,6 +228,12 @@ def normalize_our_param(name: str) -> str:
     if m is not None:  # mixed-layer projection params ({owner}.projN.w)
         base = name[: m.start()]
         return f"{base}.w.{m.group(1)}" if m.group(2) == "w" else f"{base}.b"
+    if name.endswith(".w_hzr"):  # GRU recurrent weight, z/r block
+        return name[: -len(".w_hzr")] + ".w.0"
+    if name.endswith(".w_hc"):  # GRU candidate block (fused into w0 in ref)
+        return name[: -len(".w_hc")] + ".w.0.c"
+    if name.endswith(".w_hh"):  # LSTM recurrent weight
+        return name[: -len(".w_hh")] + ".w.0"
     if name.endswith(".w"):
         return name + ".0"
     if name.endswith(".scale"):
@@ -379,6 +388,10 @@ def diff(
             errs.append(f"parameter missing: {pname} (ref dims {rdims})")
             continue
         rn, on = _count(rdims), _count(odims)
+        if rn != on and f"{pname}.c" in our_params:
+            # shared GRU weights split [H,2H]+[H,H] here vs one fused [H,3H]
+            # (nn/recurrent.py GruStep derives a ".c" sharing key)
+            on += _count(our_params[f"{pname}.c"])
         if rn != on:
             errs.append(f"parameter {pname}: {on} elements != ref {rn} ({odims} vs {rdims})")
     # ref input names must all be declared here; extras on our side are fine

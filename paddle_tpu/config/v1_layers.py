@@ -52,6 +52,7 @@ __all__ = [
     "simple_gru2", "lstm_step_layer", "gru_step_layer",
     "gru_step_naive_layer", "get_output_layer", "lstmemory_unit",
     "lstmemory_group", "gru_unit", "gru_group", "recurrent_group",
+    "multibox_loss_layer", "detection_output_layer",
 ]
 
 
@@ -691,6 +692,40 @@ def block_expand_layer(input, block_x=0, block_y=0, stride_x=0, stride_y=0,
     return _with_drop(node, layer_attr)
 
 
+def multibox_loss_layer(input_loc, input_conf, priorbox, label, num_classes,
+                        overlap_threshold=0.5, neg_pos_ratio=3.0,
+                        neg_overlap=0.5, background_id=0, name=None):
+    """layers.py multibox_loss_layer: packed v1 slots (priorbox rows of 8,
+    label rows of 6) in the reference input order."""
+    from paddle_tpu.nn.detection_layers import MultiBoxLossV1
+
+    locs = input_loc if isinstance(input_loc, (list, tuple)) else [input_loc]
+    confs = input_conf if isinstance(input_conf, (list, tuple)) else [input_conf]
+    node = MultiBoxLossV1(
+        list(locs), list(confs), priorbox, label, num_classes,
+        overlap_threshold=overlap_threshold, neg_pos_ratio=neg_pos_ratio,
+        neg_overlap=neg_overlap, background_id=background_id, name=name,
+    )
+    return _annotate(node, size=1)
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                           confidence_threshold=0.01, background_id=0,
+                           name=None):
+    from paddle_tpu.nn.detection_layers import DetectionOutputV1
+
+    locs = input_loc if isinstance(input_loc, (list, tuple)) else [input_loc]
+    confs = input_conf if isinstance(input_conf, (list, tuple)) else [input_conf]
+    node = DetectionOutputV1(
+        list(locs), list(confs), priorbox, num_classes,
+        nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, confidence_threshold=confidence_threshold,
+        background_id=background_id, name=name,
+    )
+    return _annotate(node, size=keep_top_k * 7)
+
+
 def lambda_cost(input, score, name=None, NDCG_num=5, max_sort_size=-1,
                 layer_attr=None):
     """LambdaRank works on score sequences (LambdaCost.cpp)."""
@@ -1215,9 +1250,10 @@ def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
     routes through gru_group; the fused grumemory computes the same math)."""
     m = _gru_transform(input, size, name, mixed_param_attr,
                        mixed_bias_param_attr, mixed_layer_attr)
-    return grumemory(m, name=name, size=size, reverse=reverse, act=act,
-                     gate_act=gate_act, bias_attr=gru_bias_attr,
-                     param_attr=gru_param_attr, layer_attr=gru_layer_attr)
+    return gru_group(input=m, size=size, name=name, reverse=reverse,
+                     gru_bias_attr=gru_bias_attr, gru_param_attr=gru_param_attr,
+                     act=act, gate_act=gate_act, gru_layer_attr=gru_layer_attr,
+                     naive=naive)
 
 
 def simple_gru2(input, size, name=None, reverse=False, mixed_param_attr=None,

@@ -205,3 +205,141 @@ class DetectionOutput(Layer):
             confidence_threshold=self.confidence_threshold,
         )
         return Argument(out)
+
+
+@LAYERS.register("multibox_loss_v1")
+class MultiBoxLossV1(Layer):
+    """The v1 config-surface MultiBoxLoss (multibox_loss_layer): inputs in
+    the reference slot order [priorbox, label, loc..., conf...] with the
+    PACKED v1 encodings — priorbox rows of 8 (4 coords + 4 variances),
+    label rows of 6 (class, x1, y1, x2, y2, difficult) — unpacked here and
+    routed through the same det_ops.multibox_loss as the v2 layer."""
+
+    type_name = "multibox_loss"
+
+    def __init__(
+        self,
+        input_loc: Sequence[Layer],
+        input_conf: Sequence[Layer],
+        priorbox: Layer,
+        label: Layer,
+        num_classes: int,
+        overlap_threshold: float = 0.5,
+        neg_pos_ratio: float = 3.0,
+        neg_overlap: float = 0.5,
+        background_id: int = 0,
+        name: Optional[str] = None,
+    ):
+        locs, confs = list(input_loc), list(input_conf)
+        super().__init__([priorbox, label] + locs + confs, name=name)
+        self.n_heads = len(locs)
+        self.num_classes = num_classes
+        self.overlap_threshold = overlap_threshold
+        self.neg_pos_ratio = neg_pos_ratio
+        self.neg_overlap = neg_overlap
+        self.background_id = background_id
+
+    def _unpack(self, ins):
+        n = self.n_heads
+        packed = ins[0].value.reshape(ins[0].value.shape[0], -1, 8)[0]
+        priors, variances = packed[:, :4], packed[:, 4:]
+        lab = ins[1].value
+        lab = lab.reshape(lab.shape[0], -1, 6)
+        gtl = lab[:, :, 0].astype(jnp.int32)
+        gtb = lab[:, :, 1:5]
+        valid = jnp.any(lab != 0, axis=-1)
+        locs = [
+            ins[2 + i].value.reshape(ins[2 + i].value.shape[0], -1, 4)
+            for i in range(n)
+        ]
+        p_total = sum(l.shape[1] for l in locs)
+        confs = []
+        for i in range(n):
+            c = ins[2 + n + i].value
+            confs.append(c.reshape(c.shape[0], locs[i].shape[1], -1))
+        loc = jnp.concatenate(locs, axis=1)
+        conf = jnp.concatenate(confs, axis=1)
+        # the parse-level conf width may be anything; clamp/pad to num_classes
+        if conf.shape[-1] < self.num_classes:
+            conf = jnp.pad(
+                conf, ((0, 0), (0, 0), (0, self.num_classes - conf.shape[-1]))
+            )
+        priors = priors[:p_total]
+        variances = variances[:p_total]
+        return loc, conf, priors, variances, gtb, gtl, valid
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        loc, conf, priors, variances, gtb, gtl, valid = self._unpack(ins)
+        cost = det_ops.multibox_loss(
+            loc, conf, priors, variances, gtb, gtl, valid,
+            overlap_threshold=self.overlap_threshold,
+            neg_pos_ratio=self.neg_pos_ratio,
+            background_id=self.background_id,
+        )
+        return Argument(jnp.mean(cost))
+
+
+@LAYERS.register("detection_output_v1")
+class DetectionOutputV1(Layer):
+    """v1 config-surface DetectionOutput: [priorbox, loc..., conf...] packed
+    slots; output rows are 7 wide (image_id + label, score, box) like
+    DetectionOutputLayer.cpp's getDetectionOutput."""
+
+    type_name = "detection_output"
+
+    def __init__(
+        self,
+        input_loc: Sequence[Layer],
+        input_conf: Sequence[Layer],
+        priorbox: Layer,
+        num_classes: int,
+        nms_threshold: float = 0.45,
+        nms_top_k: int = 400,
+        keep_top_k: int = 200,
+        confidence_threshold: float = 0.01,
+        background_id: int = 0,
+        name: Optional[str] = None,
+    ):
+        locs, confs = list(input_loc), list(input_conf)
+        super().__init__([priorbox] + locs + confs, name=name)
+        self.n_heads = len(locs)
+        self.num_classes = num_classes
+        self.nms_threshold = nms_threshold
+        self.nms_top_k = nms_top_k
+        self.keep_top_k = keep_top_k
+        self.confidence_threshold = confidence_threshold
+        self.background_id = background_id
+
+    def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
+        n = self.n_heads
+        packed = ins[0].value.reshape(ins[0].value.shape[0], -1, 8)[0]
+        locs = [
+            ins[1 + i].value.reshape(ins[1 + i].value.shape[0], -1, 4)
+            for i in range(n)
+        ]
+        p_total = sum(l.shape[1] for l in locs)
+        confs = []
+        for i in range(n):
+            c = ins[1 + n + i].value
+            confs.append(c.reshape(c.shape[0], locs[i].shape[1], -1))
+        loc = jnp.concatenate(locs, axis=1)
+        conf = jnp.concatenate(confs, axis=1)
+        if conf.shape[-1] < self.num_classes:
+            conf = jnp.pad(
+                conf, ((0, 0), (0, 0), (0, self.num_classes - conf.shape[-1]))
+            )
+        out = det_ops.detection_output(
+            loc, conf, packed[:p_total, :4], packed[:p_total, 4:],
+            num_classes=self.num_classes,
+            background_id=self.background_id,
+            nms_threshold=self.nms_threshold,
+            nms_top_k=self.nms_top_k,
+            keep_top_k=self.keep_top_k,
+            confidence_threshold=self.confidence_threshold,
+        )  # [B, keep_top_k, 6]
+        b = out.shape[0]
+        img_id = jnp.broadcast_to(
+            jnp.arange(b, dtype=out.dtype)[:, None, None],
+            (b, out.shape[1], 1),
+        )
+        return Argument(jnp.concatenate([img_id, out], axis=-1))

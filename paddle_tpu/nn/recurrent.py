@@ -289,14 +289,18 @@ class LstmStep(Layer):
         assert m.shape[-1] == 4 * hid, (
             f"{self.name}: lstm_step input width {m.shape[-1]} != 4*size"
         )
+        ci = cf = co = 0.0
         if self.bias:
-            b = ctx.param(self, "b", (4 * hid,), init_mod.zeros, self.bias_attr)
-            m = m + b
-        gi = act_mod.apply(self.gate_act, m[..., :hid])
-        gf = act_mod.apply(self.gate_act, m[..., hid : 2 * hid])
+            # the step layer's own parameter is the [3H] peephole block
+            # (checkI/checkF/checkO — LstmStepLayer's bias in the reference;
+            # the additive 4H gate bias lives in the input projection)
+            b = ctx.param(self, "b", (3 * hid,), init_mod.zeros, self.bias_attr)
+            ci, cf, co = b[:hid], b[hid : 2 * hid], b[2 * hid :]
+        gi = act_mod.apply(self.gate_act, m[..., :hid] + ci * c_prev)
+        gf = act_mod.apply(self.gate_act, m[..., hid : 2 * hid] + cf * c_prev)
         gc = act_mod.apply(self.act, m[..., 2 * hid : 3 * hid])
-        go = act_mod.apply(self.gate_act, m[..., 3 * hid :])
         c = gf * c_prev + gi * gc
+        go = act_mod.apply(self.gate_act, m[..., 3 * hid :] + co * c)
         h = go * act_mod.apply(self.state_act, c)
         ctx.cache[f"{self.name}::state"] = Argument(c)
         return Argument(h)

@@ -156,6 +156,7 @@ def memory(
     if bctx is None:
         raise RuntimeError("memory() must be called inside a recurrent_group step")
     m = MemoryLayer(name, size, boot_layer, boot_bias, is_seq)
+    m.user_named = name is not None
     bctx.memories.append(m)
     return m
 
@@ -203,6 +204,8 @@ class _GroupCore:
                 if isinstance(item, StaticInput):
                     ph = _Placeholder(None)
                     ph.static = item
+                    ph._v1_size = getattr(item.input, "_v1_size", None)
+                    ph.src_layer = item.input
                     self.static_inputs.append(item)
                     self.placeholders.append(ph)
                     step_args.append(ph)
@@ -216,6 +219,8 @@ class _GroupCore:
                 elif isinstance(item, SubsequenceInput):
                     ph = _Placeholder(None)
                     ph.static = None
+                    ph._v1_size = getattr(item.input, "_v1_size", None)
+                    ph.src_layer = item.input
                     self.seq_inputs.append(item.input)
                     self.sub_seq_flags.append(True)
                     self.placeholders.append(ph)
@@ -223,6 +228,8 @@ class _GroupCore:
                 elif isinstance(item, Layer):
                     ph = _Placeholder(None)
                     ph.static = None
+                    ph._v1_size = getattr(item, "_v1_size", None)
+                    ph.src_layer = item
                     self.seq_inputs.append(item)
                     self.sub_seq_flags.append(False)
                     self.placeholders.append(ph)

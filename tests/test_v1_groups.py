@@ -76,15 +76,17 @@ def test_lstmemory_group_matches_numpy_oracle():
     got = np.asarray(outs[out.name].value)
 
     w_rec = np.asarray(params["lg_input_recurrent.proj1.w"])  # [H, 4H]
-    bias = np.asarray(params["lg.b"])
+    peep = np.asarray(params["lg.b"])  # [3H] checkI/checkF/checkO
     for i in range(b):
         hprev = np.zeros(h, np.float32)
         cprev = np.zeros(h, np.float32)
         for s in range(int(batch["proj.lengths"][i])):
-            m = proj_np[i, s] + hprev @ w_rec + bias
-            gi, gf = _sigmoid(m[:h]), _sigmoid(m[h : 2 * h])
-            gc, go = np.tanh(m[2 * h : 3 * h]), _sigmoid(m[3 * h :])
+            m = proj_np[i, s] + hprev @ w_rec
+            gi = _sigmoid(m[:h] + peep[:h] * cprev)
+            gf = _sigmoid(m[h : 2 * h] + peep[h : 2 * h] * cprev)
+            gc = np.tanh(m[2 * h : 3 * h])
             cprev = gf * cprev + gi * gc
+            go = _sigmoid(m[3 * h :] + peep[2 * h :] * cprev)
             hprev = go * np.tanh(cprev)
             np.testing.assert_allclose(got[i, s], hprev, rtol=2e-5, atol=2e-5)
 
