@@ -225,16 +225,50 @@ def run_bench(cpu_fallback: bool) -> dict:
 
     mesh = make_mesh({"data": n_dev})
     dp = DataParallel(mesh)
-    trainer = SGDTrainer(cost, SGD(learning_rate=0.1, momentum=0.9), parallel=dp)
 
     rs = np.random.RandomState(0)
     batch = {
         "image": rs.randn(batch_size, image_size, image_size, 3).astype(np.float32),
         "label": rs.randint(0, 1000, batch_size),
     }
-    trainer.init_state(dp.shard_batch(batch))
 
     from paddle_tpu.core.benchmark import time_multi_steps, time_train_steps
+
+    # Rematerialization lever (PROFILE_r03 "After" table: the residual/BN
+    # epilogue bytes): conv_only keeps conv/matmul outputs and recomputes
+    # elementwise epilogues in backward — a bytes lever on a bytes-bound
+    # model. BENCH_REMAT=none|conv_only|full|auto; auto quick-times both on
+    # the real chip and keeps the winner.
+    remat_env = os.environ.get("BENCH_REMAT", "auto" if not cpu_fallback else "none")
+    chosen_remat = None if remat_env in ("none", "") else remat_env
+    tune_info = {}
+    if remat_env == "auto":
+        variants = [None, "conv_only"]
+        timings = {}
+        for variant in variants:
+            t = SGDTrainer(
+                cost, SGD(learning_rate=0.1, momentum=0.9), parallel=dp,
+                remat=variant,
+            )
+            t.init_state(dp.shard_batch(batch))
+            stp = t._make_step()
+            sec, _ = time_train_steps(
+                stp, t.state, dp.shard_batch(batch), steps=3, warmup=1
+            )
+            timings[str(variant)] = round(sec * 1000, 2)
+        chosen_remat = (
+            "conv_only"
+            if timings["conv_only"] < timings["None"]
+            else None
+        )
+        tune_info = {"remat_tune_ms": timings}
+        sys.stderr.write(f"[bench] remat auto-tune: {timings} -> {chosen_remat}\n")
+
+    trainer = SGDTrainer(
+        cost, SGD(learning_rate=0.1, momentum=0.9), parallel=dp,
+        remat=chosen_remat,
+    )
+    trainer.init_state(dp.shard_batch(batch))
 
     if scan_k > 1:
         # K distinct stacked batches per dispatch, scanned inside one
@@ -288,6 +322,8 @@ def run_bench(cpu_fallback: bool) -> dict:
         "image_size": image_size,
         "ms_per_step": round(1000 * dt / steps, 2),
         "scan_k": scan_k,
+        "remat": chosen_remat or "none",
+        **tune_info,
     }
     try:
         out["metrics"] = [

@@ -13,6 +13,7 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from paddle_tpu.core import dtypes
 
@@ -59,7 +60,10 @@ def conv2d(
         preferred_element_type=p.accum_dtype,
         precision=p.precision,
     )
-    return out
+    # residency tag for the conv-only rematerialization policy
+    # (SGDTrainer(remat="conv_only")): under jax.checkpoint these outputs
+    # are stored while everything else recomputes; a no-op otherwise
+    return checkpoint_name(out, "conv_out")
 
 
 def conv2d_transpose(

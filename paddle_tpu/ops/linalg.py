@@ -24,7 +24,10 @@ def matmul(a: Array, b: Array, policy: Optional[dtypes.Policy] = None) -> Array:
     out = jnp.matmul(
         a, b, preferred_element_type=p.accum_dtype, precision=p.precision
     )
-    return out
+    from jax.ad_checkpoint import checkpoint_name
+
+    # see ops/conv.py: stored under SGDTrainer(remat="conv_only")
+    return checkpoint_name(out, "conv_out")
 
 
 def linear(x: Array, w: Array, b: Optional[Array] = None, policy=None) -> Array:

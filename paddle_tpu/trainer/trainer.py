@@ -49,12 +49,14 @@ class SGDTrainer:
         parallel: Optional[Any] = None,  # parallel.DataParallel or None
         updater: Optional[Any] = None,  # parallel.ParameterUpdater
         seed: int = 0,
+        remat: Optional[str] = None,  # None | "conv_only" | "full"
     ):
         costs = [cost] if isinstance(cost, Layer) else list(cost)
         self.cost_names = [c.name for c in costs]
         self.extra_names = [e.name for e in extra_outputs]
         self.network = Network(costs + list(extra_outputs))
         self.optimizer = optimizer
+        self.remat = remat
         # The ParameterUpdater protocol (ParameterUpdater.h:38) is the seam
         # where parallelism plugs into the trainer: the optimizer application
         # inside the compiled step goes through updater.apply, and host-side
@@ -124,6 +126,20 @@ class SGDTrainer:
                 )
                 total = sum(outs[c].value for c in cost_names)
                 return total, (outs, new_states)
+
+            if self.remat == "conv_only":
+                # bytes lever for bandwidth-bound convnets: keep conv/matmul
+                # outputs (tagged "conv_out" in ops/conv.py and ops/linalg.py),
+                # recompute the cheap BN/relu/add epilogues in the backward
+                # pass instead of round-tripping them through HBM
+                loss_fn = jax.checkpoint(
+                    loss_fn,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        "conv_out"
+                    ),
+                )
+            elif self.remat == "full":
+                loss_fn = jax.checkpoint(loss_fn)
 
             (cost, (outs, new_states)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
