@@ -168,6 +168,53 @@ class Program:
     def parameters(self) -> List[Variable]:
         return [v for v in self.global_block().vars.values() if v.persistable]
 
+    def prune(self, targets: Sequence[Any]) -> "Program":
+        """Inference-program extraction (framework/prune.cc Prune): keep only
+        the ops whose outputs (transitively) feed `targets` — variable names
+        or Variables — walking each block backwards; sub-blocks referenced by
+        surviving control-flow ops survive whole."""
+        want = {
+            t.name if isinstance(t, Variable) else str(t) for t in targets
+        }
+        keep_blocks: Dict[int, List] = {}
+        needed_by_block: Dict[int, set] = {0: set(want)}
+
+        def prune_block(idx: int, needed: set) -> None:
+            block = self.blocks[idx]
+            kept = []
+            for op in reversed(block.desc.ops):
+                outs = {n for ns in op.outputs.values() for n in ns}
+                if outs & needed or op.type in ("feed", "print"):
+                    kept.append(op)
+                    for ns in op.inputs.values():
+                        needed.update(ns)
+                    subs = [op.attrs.get(k) for k in
+                            ("sub_block", "true_block", "false_block")]
+                    for sb in subs:
+                        bidx = getattr(sb, "idx", sb)
+                        if isinstance(bidx, int) and bidx not in keep_blocks:
+                            inner_needed = {
+                                n for ns in op.inputs.values() for n in ns
+                            } | needed
+                            prune_block(bidx, set(inner_needed))
+            kept.reverse()
+            keep_blocks[idx] = kept
+
+        prune_block(0, needed_by_block[0])
+
+        pruned = Program.__new__(Program)
+        pruned.blocks = []
+        pruned._counter = self._counter
+        pruned._current = 0
+        for b in self.blocks:
+            desc = BlockDesc(idx=b.idx, parent_idx=b.desc.parent_idx)
+            desc.vars = dict(b.desc.vars)
+            desc.ops = list(keep_blocks.get(b.idx, b.desc.ops))
+            nb = Block(pruned, desc)
+            nb.vars = dict(b.vars)
+            pruned.blocks.append(nb)
+        return pruned
+
     def to_string(self) -> str:
         lines = []
         for b in self.blocks:

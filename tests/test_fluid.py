@@ -199,3 +199,31 @@ def test_slot_like_param_names_still_train():
     for _ in range(20):
         (l1,) = exe.run(prog, feed={"x": feat, "y": lbl}, fetch_list=[loss], scope=scope)
     assert float(l1) < float(l0) / 2, (float(l0), float(l1))
+
+
+def test_program_prune_extracts_inference_subgraph():
+    """framework/prune.cc parity: prune to a fetch target drops the loss/
+    metric branch and the pruned program still computes the same values."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    x, y, out, loss, acc = _build_mlp()
+    prog = fluid.default_main_program()
+    full_types = [op.type for op in prog.global_block().desc.ops]
+    assert "cross_entropy" in full_types and "accuracy" in full_types
+
+    pruned = prog.prune([out])
+    pruned_types = [op.type for op in pruned.global_block().desc.ops]
+    assert "cross_entropy" not in pruned_types
+    assert "accuracy" not in pruned_types
+    assert pruned_types.count("mul") == 2  # both fc matmuls survive
+    # the source program is untouched
+    assert [op.type for op in prog.global_block().desc.ops] == full_types
+
+    feat, lbl = _toy_classification(n=8)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    want = exe.run(prog, feed={"x": feat, "y": lbl}, fetch_list=[out],
+                   scope=scope)[0]
+    got = exe.run(pruned, feed={"x": feat}, fetch_list=[out], scope=scope)[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
