@@ -125,32 +125,60 @@ def run_seq2seq(cpu_fallback: bool, peak: float, n_dev: int) -> dict:
     from paddle_tpu.core.benchmark import time_train_steps
 
     if cpu_fallback:
-        vocab, dim, bs, src_len, trg_len = 1000, 64, 8, 12, 12
+        vocab, dim, bs_spec, src_len, trg_len = 1000, 64, "8", 12, 12
         steps, warmup = 2, 1
     else:
         vocab = int(os.environ.get("BENCH_S2S_VOCAB", "30000"))
         dim = int(os.environ.get("BENCH_S2S_DIM", "512"))
-        bs = int(os.environ.get("BENCH_S2S_BATCH", "128"))  # best measured (sweep r3)
+        # "auto": quick-sweep candidate batch sizes on the chip and keep the
+        # best tokens/s (r3's optimum was 128; the r4 decoder hoist + fused
+        # xent shift the balance toward larger batches — measure, don't guess)
+        bs_spec = os.environ.get("BENCH_S2S_BATCH", "auto")
         src_len = trg_len = int(os.environ.get("BENCH_S2S_LEN", "50"))
         steps = max(1, int(os.environ.get("BENCH_S2S_STEPS", "16")))
         warmup = 2
 
     dtypes.set_policy(dtypes.bf16_policy())
-    reset_name_scope()
-    model = Seq2SeqModel(vocab, vocab, embed_dim=dim, hidden_dim=dim)
-    trainer = SGDTrainer(model.cost, Adam(learning_rate=1e-3))
-    rs = np.random.RandomState(0)
-    batch = {
-        "source_ids": rs.randint(2, vocab, (bs, src_len)).astype(np.int32),
-        "source_ids.lengths": np.full(bs, src_len, np.int32),
-        "target_ids": rs.randint(2, vocab, (bs, trg_len)).astype(np.int32),
-        "target_ids.lengths": np.full(bs, trg_len, np.int32),
-        "label_ids": rs.randint(2, vocab, (bs, trg_len)).astype(np.int32),
-        "label_ids.lengths": np.full(bs, trg_len, np.int32),
-    }
-    batch = jax.device_put(batch)
-    trainer.init_state(batch)
-    step = trainer._make_step()
+
+    def make_step_for(bs: int):
+        reset_name_scope()
+        model = Seq2SeqModel(vocab, vocab, embed_dim=dim, hidden_dim=dim)
+        trainer = SGDTrainer(model.cost, Adam(learning_rate=1e-3))
+        rs = np.random.RandomState(0)
+        batch = {
+            "source_ids": rs.randint(2, vocab, (bs, src_len)).astype(np.int32),
+            "source_ids.lengths": np.full(bs, src_len, np.int32),
+            "target_ids": rs.randint(2, vocab, (bs, trg_len)).astype(np.int32),
+            "target_ids.lengths": np.full(bs, trg_len, np.int32),
+            "label_ids": rs.randint(2, vocab, (bs, trg_len)).astype(np.int32),
+            "label_ids.lengths": np.full(bs, trg_len, np.int32),
+        }
+        batch = jax.device_put(batch)
+        trainer.init_state(batch)
+        return trainer, trainer._make_step(), batch
+
+    sweep_info = {}
+    if bs_spec == "auto":
+        candidates = [128, 256, 512]
+        rates = {}
+        for cand in candidates:
+            try:
+                tr, stp, bt = make_step_for(cand)
+                sec, _ = time_train_steps(stp, tr.state, bt, steps=3, warmup=1)
+                rates[cand] = cand * trg_len / sec
+            except Exception as exc:  # noqa: BLE001 — OOM etc: skip candidate
+                sys.stderr.write(f"[bench] s2s bs={cand} failed: {exc!r}\n")
+        bs = max(rates, key=rates.get) if rates else 128
+        sweep_info = {
+            "batch_sweep_tokens_per_sec": {
+                str(k): round(v, 0) for k, v in rates.items()
+            }
+        }
+        sys.stderr.write(f"[bench] s2s batch sweep: {rates} -> {bs}\n")
+    else:
+        bs = int(bs_spec)
+
+    trainer, step, batch = make_step_for(bs)
     sec_per_step, _ = time_train_steps(
         step, trainer.state, batch, steps=steps, warmup=warmup
     )
@@ -178,6 +206,7 @@ def run_seq2seq(cpu_fallback: bool, peak: float, n_dev: int) -> dict:
         "vocab": vocab,
         "hidden": dim,
         "ms_per_step": round(sec_per_step * 1000, 2),
+        **sweep_info,
     }
 
 
