@@ -210,3 +210,67 @@ def test_detection_map_evaluator():
     ev.update(detections=dets, gt_boxes=gtb, gt_labels=gtl, gt_lengths=np.array([2]))
     # class 1: AP = 1.0 (first det TP, recall 1 at precision 1); class 2: AP = 1.0
     np.testing.assert_allclose(ev.finish(), 1.0)
+
+
+def test_v1_packed_detection_layers():
+    """MultiBoxLossV1 / DetectionOutputV1: the packed v1 slot encodings
+    (priorbox rows of 8, label rows of 6) produce finite losses with flowing
+    gradients and id-prefixed detection rows."""
+    import jax
+
+    from paddle_tpu.nn.detection_layers import DetectionOutputV1, MultiBoxLossV1
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn.graph import Network, reset_name_scope
+
+    reset_name_scope()
+    b, p = 2, 4
+    rs = np.random.RandomState(0)
+    loc = L.Data("loc", shape=(p * 4,))
+    conf = L.Data("conf", shape=(p * 21,))
+    prior = L.Data("prior", shape=(p * 8,))
+    label = L.Data("label", shape=(2 * 6,))
+
+    priors = np.zeros((p, 8), np.float32)
+    priors[:, 0] = np.linspace(0.0, 0.6, p)
+    priors[:, 1] = 0.1
+    priors[:, 2] = priors[:, 0] + 0.3
+    priors[:, 3] = 0.5
+    priors[:, 4:] = 0.1
+    gt = np.zeros((b, 2, 6), np.float32)
+    gt[:, 0] = [3, 0.05, 0.1, 0.35, 0.5, 0]  # one real box, class 3
+
+    batch = {
+        "loc": rs.randn(b, p * 4).astype(np.float32) * 0.05,
+        "conf": rs.randn(b, p * 21).astype(np.float32),
+        "prior": np.tile(priors.reshape(1, -1), (b, 1)),
+        "label": gt.reshape(b, -1),
+    }
+
+    mb = MultiBoxLossV1([loc], [conf], prior, label, num_classes=21,
+                        name="mb")
+    net = Network([mb])
+    params, states = net.init(jax.random.PRNGKey(0), batch)
+    outs, _ = net.apply(params, states, batch)
+    cost = float(outs["mb"].value)
+    assert np.isfinite(cost) and cost > 0
+
+    def loss(x):
+        o, _ = net.apply(params, states, {**batch, "loc": x})
+        return o["mb"].value
+
+    g = jax.grad(loss)(jnp.asarray(batch["loc"]))
+    assert float(jnp.abs(g).sum()) > 0
+
+    reset_name_scope()
+    loc2 = L.Data("loc", shape=(p * 4,))
+    conf2 = L.Data("conf", shape=(p * 21,))
+    prior2 = L.Data("prior", shape=(p * 8,))
+    det = DetectionOutputV1([loc2], [conf2], prior2, num_classes=21,
+                            keep_top_k=5, name="det")
+    net2 = Network([det])
+    params2, states2 = net2.init(jax.random.PRNGKey(0), batch)
+    outs2, _ = net2.apply(params2, states2, batch)
+    rows = np.asarray(outs2["det"].value)
+    assert rows.shape == (b, 5, 7)
+    np.testing.assert_array_equal(rows[0, :, 0], 0)  # image-id column
+    np.testing.assert_array_equal(rows[1, :, 0], 1)
