@@ -776,10 +776,21 @@ def build_model_config(
             layer.type_name == "dropout"
             and layer.name.endswith(".drop")
             and len(layer.inputs) == 1
-            and layer.inputs[0].name == layer.name[: -len(".drop")]
+            and alias.get(layer.inputs[0].name, layer.inputs[0].name) in lc_by_name
+        ):
+            parent = alias.get(layer.inputs[0].name, layer.inputs[0].name)
+            lc_by_name[parent].drop_rate = getattr(layer, "rate", None)
+            alias[layer.name] = parent
+            continue
+        if (
+            layer.type_name == "error_clip"
+            and layer.name.endswith(".eclip")
+            and len(layer.inputs) == 1
             and layer.inputs[0].name in lc_by_name
         ):
-            lc_by_name[layer.inputs[0].name].drop_rate = getattr(layer, "rate", None)
+            lc_by_name[layer.inputs[0].name].error_clipping_threshold = (
+                layer.threshold
+            )
             alias[layer.name] = layer.inputs[0].name
             continue
         arg = values[layer.name]

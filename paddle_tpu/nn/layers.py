@@ -1032,6 +1032,38 @@ class Resize(Layer):
         return Argument(x.reshape(-1, self.size))
 
 
+@jax.custom_vjp
+def _clip_grad(x, t):
+    return x
+
+
+def _clip_grad_fwd(x, t):
+    return x, t
+
+
+def _clip_grad_bwd(t, g):
+    return jnp.clip(g, -t, t), None
+
+
+_clip_grad.defvjp(_clip_grad_fwd, _clip_grad_bwd)
+
+
+@LAYERS.register("error_clip")
+class ErrorClip(Layer):
+    """ExtraLayerAttribute.error_clipping_threshold: identity forward, the
+    backpropagated error clipped to ±t (Layer.cpp backwardActivation's
+    errorClipping). Chained by the layer_attr seam like dropout."""
+
+    type_name = "error_clip"
+
+    def __init__(self, input: Layer, threshold: float, name=None):
+        super().__init__(input, name=name)
+        self.threshold = float(threshold)
+
+    def forward(self, ctx, ins):
+        return ins[0].with_value(_clip_grad(ins[0].value, self.threshold))
+
+
 @LAYERS.register("clip")
 class Clip(Layer):
     """Elementwise clip (ClipLayer.cpp)."""

@@ -738,8 +738,15 @@ def _or_none(attr):
 
 
 def _with_drop(node: Layer, layer_attr) -> Layer:
-    """Apply ExtraAttr.drop_rate by chaining a Dropout node (the reference
-    applies dropout inside Layer::forward when drop_rate is set)."""
+    """Apply ExtraAttr knobs by chaining nodes (the reference applies both
+    inside Layer::forward/backwardActivation when set): drop_rate → Dropout,
+    error_clipping_threshold → identity-forward/clipped-backward."""
+    if layer_attr is not None and getattr(
+        layer_attr, "error_clipping_threshold", None
+    ):
+        node = L.ErrorClip(
+            node, layer_attr.error_clipping_threshold, name=node.name + ".eclip"
+        )
     if layer_attr is not None and getattr(layer_attr, "drop_rate", None):
         return L.Dropout(node, layer_attr.drop_rate, name=node.name + ".drop")
     return node
