@@ -267,8 +267,17 @@ from paddle_tpu.config.v1_layers import (  # noqa: E402
 def _declare_evaluator(etype: str, *input_layers, name: Optional[str] = None, **kw):
     from paddle_tpu.config import config_parser as cp
 
+    if name is None:
+        # config_parser names evaluators "{type}_evaluator" (uniquified)
+        base = f"{etype}_evaluator"
+        taken = {e.name for e in cp.g_context().evaluators}
+        name = base
+        i = 0
+        while name in taken:
+            i += 1
+            name = f"{base}_{i}"
     cfg = proto.EvaluatorConfig(
-        name=name or f"__{etype}_{len(cp.g_context().evaluators)}__",
+        name=name,
         type=etype,
         input_layers=[l.name for l in input_layers if l is not None],
     )
@@ -279,8 +288,10 @@ def _declare_evaluator(etype: str, *input_layers, name: Optional[str] = None, **
     return cfg
 
 
-def classification_error_evaluator(input=None, label=None, name=None, **kw):
-    return _declare_evaluator("classification_error", input, label, name=name, **kw)
+def classification_error_evaluator(input=None, label=None, weight=None,
+                                   name=None, **kw):
+    return _declare_evaluator("classification_error", input, label, weight,
+                              name=name, **kw)
 
 
 def auc_evaluator(input=None, label=None, name=None, **kw):

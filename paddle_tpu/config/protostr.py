@@ -128,6 +128,7 @@ class ModelSummary:
     parameters: Dict[str, List[int]]  # name -> dims
     input_layer_names: List[str]
     output_layer_names: List[str]
+    evaluators: List[Tuple[str, str, Tuple[str, ...]]] = field(default_factory=list)
 
 
 _SCALAR_FIELDS = (
@@ -184,12 +185,21 @@ def summarize(mc: Dict[str, Any]) -> ModelSummary:
         if not dims and _one(p, "size") is not None:
             dims = [int(_one(p, "size"))]  # older goldens omit dims
         params[_one(p, "name", "")] = dims
+    evals = [
+        (
+            _one(e, "name", ""),
+            _one(e, "type", ""),
+            tuple(e.get("input_layers", [])),
+        )
+        for e in mc.get("evaluators", [])
+    ]
     return ModelSummary(
         layers=layers,
         layer_order=order,
         parameters=params,
         input_layer_names=list(mc.get("input_layer_names", [])),
         output_layer_names=list(mc.get("output_layer_names", [])),
+        evaluators=evals,
     )
 
 
@@ -408,6 +418,9 @@ def diff(
             f"output_layer_names {sorted(ours.output_layer_names)} != "
             f"ref {sorted(ref.output_layer_names)}"
         )
+    for ev in ref.evaluators:
+        if ev not in ours.evaluators:
+            errs.append(f"evaluator missing: {ev}")
     return errs
 
 

@@ -907,9 +907,11 @@ def classification_cost(input, label, weight=None, name=None,
     )
     try:  # the default evaluator declaration (reference default arg)
         if evaluator is None:
-            _h.classification_error_evaluator(input=input, label=label)
+            _h.classification_error_evaluator(
+                input=input, label=label, weight=weight
+            )
         elif callable(evaluator):
-            evaluator(input=input, label=label)
+            evaluator(input=input, label=label, weight=weight)
     except Exception:
         pass  # declaring an evaluator must never fail the parse
     return _with_drop(node, layer_attr)

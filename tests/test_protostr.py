@@ -79,13 +79,15 @@ GOLDEN_MATCH = [
 
 
 def _diff(name):
+    from paddle_tpu import proto
     from paddle_tpu.config import protostr
     from paddle_tpu.config.config_parser import parse_config
-    from paddle_tpu.config.dump import dump_config
 
     pc = parse_config(os.path.join(CFG_DIR, name + ".py"))
     golden = os.path.join(CFG_DIR, "protostr", name + ".protostr")
-    return protostr.diff_files(golden, dump_config(pc.topology))
+    # the full parsed ModelConfig (build_model_config output + declared
+    # evaluators), the same artifact dump_config serializes
+    return protostr.diff_files(golden, proto.to_text(pc.model_config))
 
 
 @pytest.mark.parametrize("name", GOLDEN_MATCH)
