@@ -37,6 +37,8 @@ from paddle_tpu.v2.pooling import Avg as AvgPooling
 from paddle_tpu.v2.pooling import Max as MaxPooling
 from paddle_tpu.v2.pooling import SquareRootN as SquareRootNPooling
 from paddle_tpu.v2.pooling import Sum as SumPooling
+from paddle_tpu.v2.pooling import CudnnAvg as CudnnAvgPooling
+from paddle_tpu.v2.pooling import CudnnMax as CudnnMaxPooling
 from paddle_tpu.config.optimizers import (
     AdaDeltaOptimizer,
     AdaGradOptimizer,
@@ -151,6 +153,7 @@ dotmul_projection = _v2.dotmul_projection
 table_projection = _v2.table_projection
 context_projection = _v2.context_projection
 scaling_projection = _v2.scaling_projection
+slice_projection = _v2.slice_projection
 dotmul_operator = _v2.dotmul_operator
 
 # costs
@@ -447,6 +450,7 @@ from paddle_tpu.config import layer_math  # noqa: E402
 
 __all__ = [
     "printer_layer", "kmax_seq_score_layer", "layer_math",
+    "slice_projection", "CudnnMaxPooling", "CudnnAvgPooling",
     "lstmemory_group", "lstmemory_unit", "gru_group", "gru_unit",
     "lstm_step_layer", "gru_step_layer", "gru_step_naive_layer",
     "simple_gru2", "gated_unit_layer", "seq_slice_layer",

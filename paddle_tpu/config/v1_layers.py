@@ -448,8 +448,16 @@ class _ConvProjSpec:
 
 def conv_projection(input, filter_size, num_filters, num_channels=None,
                     stride=1, padding=0, groups=1, param_attr=None,
-                    trans=False):
+                    trans=False, filter_size_y=None, stride_y=None,
+                    padding_y=None):
     from paddle_tpu.nn.projections import ConvProj
+
+    if filter_size_y is not None:
+        filter_size = (filter_size_y, filter_size)
+    if stride_y is not None:
+        stride = (stride_y, stride)
+    if padding_y is not None:
+        padding = (padding_y, padding)
 
     if num_channels is None:
         geom = getattr(input, "_v1_geom", None)

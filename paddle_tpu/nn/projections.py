@@ -131,11 +131,13 @@ class Table(Projection):
     """TableProjection: embedding lookup from int-id input."""
 
     def __init__(self, input: Layer, vocab_size: Optional[int] = None,
-                 param_attr: Any = None):
+                 param_attr: Any = None, size: int = 0):
         super().__init__([input], param_attr)
         self.vocab_size = vocab_size
+        self.size = size
 
     def apply(self, ctx, owner, args, size):
+        size = self.size or size
         v = args[0].value
         vocab = self.vocab_size
         if not vocab:
@@ -153,6 +155,24 @@ class Table(Projection):
             self.param_attr,
         )
         return jnp.take(table, ids, axis=0)
+
+
+class SliceProj(Projection):
+    """SliceProjection (SliceProjection.cpp): channel ranges of an image
+    input (or feature ranges of a flat one), flattened and concatenated by
+    the owning mixed/concat2."""
+
+    def __init__(self, input: Layer, slices):
+        super().__init__([input])
+        self.slices = [tuple(s) for s in slices]
+
+    def apply(self, ctx, owner, args, size):
+        x = args[0].value
+        parts = [x[..., s:e] for s, e in self.slices]
+        out = jnp.concatenate(parts, axis=-1)
+        if out.ndim > 2:
+            out = out.reshape(out.shape[0], -1)
+        return out
 
 
 class Context_(Projection):

@@ -540,13 +540,28 @@ def dotmul_projection(input, param_attr=None):
     return P.DotMul(input, param_attr=param_attr)
 
 
+def slice_projection(input, slices, **_compat):
+    return P.SliceProj(input, slices)
+
+
 def table_projection(input, size=0, param_attr=None, vocab_size=None):
+    if vocab_size is None:
+        spec = getattr(input, "data_type", None)
+        if spec is not None and spec.kind.startswith("index"):
+            vocab_size = int(spec.dim) or None
+        elif getattr(input, "_v1_size", None):
+            vocab_size = int(input._v1_size)
+        elif getattr(input, "shape", None):
+            n = 1
+            for d in input.shape:
+                n *= int(d)
+            vocab_size = n or None
     """vocab_size: the id range (the reference infers it from the data layer's
     dim; explicit here because data layers carry shapes, not ranges)."""
     if vocab_size is None:
         spec = getattr(input, "data_type", None)
         vocab_size = int(spec.dim) if spec is not None else 0
-    return P.Table(input, vocab_size, param_attr=param_attr)
+    return P.Table(input, vocab_size=vocab_size, param_attr=param_attr, size=size)
 
 
 def context_projection(input, context_len, context_start=None,
