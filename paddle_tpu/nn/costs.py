@@ -46,6 +46,16 @@ class CostLayer(Layer):
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         pred_arg, label_arg = ins[0], ins[1]
+        if pred_arg.lengths is not None and label_arg.lengths is None:
+            # sequence predictions against one label per sequence: the label
+            # applies to every (valid) step, as the reference's provider
+            # binding does when a non-seq label slot meets a seq cost input
+            t = pred_arg.value.shape[1]
+            lv = label_arg.value.reshape(label_arg.value.shape[0], -1)
+            label_arg = Argument(
+                jnp.broadcast_to(lv[:, :1], (lv.shape[0], t)),
+                pred_arg.lengths,
+            )
         pred, pmask = _flatten_seq(pred_arg.value, pred_arg.lengths)
         label, _ = _flatten_seq(label_arg.value, label_arg.lengths)
         cost = self.per_example(ctx, pred, label)

@@ -175,6 +175,22 @@ class Expand(Layer):
     def forward(self, ctx, ins):
         x, ref = ins[0], ins[1]
         assert ref.is_seq
+        if ref.sub_lengths is not None and ref.value.ndim > 2:
+            s_max, t_max = ref.value.shape[1], ref.value.shape[2]
+            if self.expand_level == "seq" and x.value.ndim == 3:
+                # FROM_SEQUENCE onto a nested target: one value per
+                # subsequence broadcast across that subsequence's tokens
+                out = jnp.broadcast_to(
+                    x.value[:, :, None],
+                    x.value.shape[:2] + (t_max,) + x.value.shape[2:],
+                )
+                return Argument(out, ref.lengths, ref.sub_lengths)
+            # FROM_NO_SEQUENCE onto nested: broadcast over both levels
+            out = jnp.broadcast_to(
+                x.value[:, None, None],
+                (x.value.shape[0], s_max, t_max) + x.value.shape[1:],
+            )
+            return Argument(out, ref.lengths, ref.sub_lengths)
         out = seq_ops.expand_to_seq(x.value, ref.lengths, ref.max_len)
         return Argument(out, ref.lengths)
 

@@ -279,3 +279,26 @@ def test_reference_gserver_ab_pairs_equivalent(pair):
             va.reshape(va.shape[0], -1), vb.reshape(vb.shape[0], -1),
             rtol=2e-5, atol=2e-5,
         )
+
+
+def test_reference_sequence_layer_group_confs_parse_and_trace():
+    """gserver/tests/sequence_layer_group.conf and its nested twin: the
+    lstmemory_group-inside-recurrent_group stack (plus TO_SEQUENCE pooling,
+    FROM_SEQUENCE expand onto a nested target, per-sequence labels) parses
+    and traces on the reference's own unmodified files."""
+    import os
+
+    conf_dir = "/root/reference/paddle/gserver/tests"
+    if not os.path.isdir(conf_dir):
+        pytest.skip("reference tree not available")
+    from paddle_tpu.config.config_parser import parse_config
+
+    cwd = os.getcwd()
+    os.chdir("/root/reference/paddle")  # the confs open dict files by relpath
+    try:
+        for conf in ("sequence_layer_group.conf", "sequence_nest_layer_group.conf"):
+            reset_name_scope()
+            pc = parse_config(os.path.join(conf_dir, conf))
+            assert len(pc.topology.network.layer_order) >= 8
+    finally:
+        os.chdir(cwd)
