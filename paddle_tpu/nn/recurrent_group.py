@@ -245,6 +245,7 @@ class _GroupCore:
                 "inputs; all iterated inputs must share one nesting level "
                 "(RecurrentGradientMachine requires equal sequence structure)"
             )
+        self.multi_out = not isinstance(outs, Layer)
         self.out_layers: List[Layer] = [outs] if isinstance(outs, Layer) else list(outs)
 
         # resolve memory links: the step layer whose output feeds t+1. The
@@ -540,13 +541,22 @@ def recurrent_group(
     name: Optional[str] = None,
     **_compat,
 ) -> Layer:
-    """Build the group; returns the node for the step's first output. Extra
-    step outputs are reachable via get_output_layer."""
+    """Build the group. A step returning one layer yields one node; a step
+    returning a tuple/list yields a tuple of nodes (the reference's
+    multi-output recurrent_group contract — `a, b = recurrent_group(...)`).
+    Extra outputs also remain reachable via get_output_layer."""
     core = _GroupCore(step, input, reverse=reverse)
     if core.generated is not None:
         raise ValueError("GeneratedInput is only valid under beam_search")
     node = RecurrentGroup(core, 0, name=name)
     node._group_core = core
+    if core.multi_out:
+        extra = []
+        for i in range(1, len(core.out_layers)):
+            n = RecurrentGroup(core, i, name=f"{node.name}.out{i}")
+            n._group_core = core
+            extra.append(n)
+        return tuple([node] + extra)
     return node
 
 

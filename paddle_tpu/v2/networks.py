@@ -82,7 +82,10 @@ def text_conv_pool(input, context_len=5, hidden_size=128, act=None, **_compat):
 sequence_conv_pool = text_conv_pool
 
 
-def simple_attention(encoded_sequence, encoded_proj, decoder_state, **_compat):
+def simple_attention(encoded_sequence, encoded_proj=None, decoder_state=None,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     weight_act=None, name=None, **_compat):
     """simple_attention (networks.py:1304) — additive attention composed from
-    the same primitive ops the reference uses."""
-    return SimpleAttention([encoded_sequence, encoded_proj, decoder_state])
+    the same primitive ops the reference uses (the encoded_proj transform is
+    computed internally from encoded_sequence)."""
+    return SimpleAttention(encoded_sequence, decoder_state, name=name)

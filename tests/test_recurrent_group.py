@@ -121,9 +121,12 @@ def test_get_output_layer_second_output():
         o = vl.fc(input=h, size=4, act=Softmax(), name="o")
         return [o, h]
 
-    g = vl.recurrent_group(step, seq)
+    # multi-output steps return a tuple (the reference's contract); the
+    # second output is also reachable via get_output_layer on the first
+    g, h_tuple = vl.recurrent_group(step, seq)
     h_out = vl.get_output_layer(g, "h")
     net = Network([g, h_out])
+    assert h_tuple.core is h_out.core
     batch = _seq_batch()
     params, states = net.init(jax.random.PRNGKey(0), batch)
     outs, _ = net.apply(params, states, batch)

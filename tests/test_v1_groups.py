@@ -302,3 +302,66 @@ def test_reference_sequence_layer_group_confs_parse_and_trace():
             assert len(pc.topology.network.layer_order) >= 8
     finally:
         os.chdir(cwd)
+
+
+def test_reference_multi_input_group_conf_equivalence():
+    """sequence_nest_rnn_multi_input.conf vs sequence_rnn_multi_input.conf:
+    a group iterating BOTH an embedding sequence and the raw id sequence
+    (in-step embedding), hierarchical vs flat, on the reference's own files."""
+    import os
+
+    conf_dir = "/root/reference/paddle/gserver/tests"
+    if not os.path.isdir(conf_dir):
+        pytest.skip("reference tree not available")
+    from paddle_tpu.config.config_parser import parse_config
+
+    nest = parse_config(os.path.join(conf_dir, "sequence_nest_rnn_multi_input.conf"))
+    reset_name_scope()
+    flat = parse_config(os.path.join(conf_dir, "sequence_rnn_multi_input.conf"))
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 10, (2, 2, 3)).astype(np.int32)
+    nest_batch = {
+        "word": ids,
+        "word.lengths": np.array([2, 2], np.int32),
+        "word.sub_lengths": np.full((2, 2), 3, np.int32),
+        "label": np.array([1, 2], np.int32),
+    }
+    flat_batch = {
+        "word": ids.reshape(2, 6),
+        "word.lengths": np.array([6, 6], np.int32),
+        "label": np.array([1, 2], np.int32),
+    }
+    net_n = Network(nest.outputs)
+    net_f = Network(flat.outputs)
+    pf, sf = net_f.init(jax.random.PRNGKey(3), flat_batch)
+    pn, sn = net_n.init(jax.random.PRNGKey(4), nest_batch)
+    mapped = {}
+    for k, v in pn.items():
+        src = k.replace("inner_rnn_state", "rnn_state")
+        mapped[k] = pf[src] if src in pf else v
+    out_n, _ = net_n.apply(mapped, sn, nest_batch)
+    out_f, _ = net_f.apply(pf, sf, flat_batch)
+    cost_n = float(out_n[nest.outputs[0].name].value)
+    cost_f = float(out_f[flat.outputs[0].name].value)
+    assert cost_n == pytest.approx(cost_f, rel=2e-5)
+
+
+def test_reference_unequalength_multi_output_group_confs_parse():
+    """sequence_(nest_)rnn_multi_unequalength_inputs.py: two iterated inputs
+    with different lengths and a MULTI-OUTPUT step (`a, b =
+    recurrent_group(...)`) — parse + trace on the reference's files."""
+    import os
+
+    conf_dir = "/root/reference/paddle/gserver/tests"
+    if not os.path.isdir(conf_dir):
+        pytest.skip("reference tree not available")
+    from paddle_tpu.config.config_parser import parse_config
+
+    for conf in (
+        "sequence_rnn_multi_unequalength_inputs.py",
+        "sequence_nest_rnn_multi_unequalength_inputs.py",
+    ):
+        reset_name_scope()
+        pc = parse_config(os.path.join(conf_dir, conf))
+        assert len(pc.topology.network.layer_order) >= 10
