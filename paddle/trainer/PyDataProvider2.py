@@ -15,8 +15,10 @@ from paddle_tpu.data.feeder import (  # noqa: F401
     dense_array,
     dense_vector,
     dense_vector_sequence,
+    dense_vector_sub_sequence,
     integer_value,
     integer_value_sequence,
+    integer_value_sub_sequence,
     sparse_binary_vector,
     sparse_value_slot,
 )
@@ -36,6 +38,8 @@ __all__ = [
     "dense_vector_sequence",
     "integer_value",
     "integer_value_sequence",
+    "integer_value_sub_sequence",
+    "dense_vector_sub_sequence",
     "integer_sequence",
     "sparse_binary_vector",
     "sparse_binary_vector_sequence",

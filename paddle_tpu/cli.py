@@ -117,9 +117,11 @@ def bind_provider_types(topology, dc: proto.DataConfig):
     layers = list(topology.data_layers().values())
 
     def apply_spec(layer, spec):
+        from paddle_tpu.nn.graph import record_layers
         from paddle_tpu.v2.layer import data as _v2_data
 
-        tmpl = _v2_data(layer.name + ".__tmpl__", spec)
+        with record_layers([]):  # shape probe only — keep out of the graph
+            tmpl = _v2_data(layer.name + ".__tmpl__", spec)
         layer.data_type = spec
         layer.shape = tmpl.shape
         layer.is_seq = tmpl.is_seq

@@ -211,6 +211,19 @@ def parse_config(
             for dc in (ctx.data_config, ctx.test_data_config):
                 if dc is not None and not dc.config_dir:
                     dc.config_dir = cfg_dir
+        # bind the provider's declared input_types to the data layers before
+        # tracing — the reference's runtime slot binding (PyDataProvider2);
+        # this is where sub-sequence nesting comes from when the config
+        # doesn't wrap inputs in SubsequenceInput (gserver's
+        # sequence_rnn_mixed_inputs idiom). Best-effort: test configs often
+        # reference providers that don't exist at parse time.
+        if ctx.data_config is not None and ctx.data_config.load_data_module:
+            try:
+                from paddle_tpu.cli import bind_provider_types
+
+                bind_provider_types(Topology(ctx.outputs), ctx.data_config)
+            except Exception:
+                pass
         # layers created by the script but unreachable from outputs() stay in
         # the config, as the reference's do (unused_layers.py golden; print
         # layers have no consumers by design) — carried as extra_layers

@@ -238,13 +238,12 @@ class _GroupCore:
                     raise TypeError(f"bad recurrent_group input: {item!r}")
             outs = step(*step_args)
         self.memories: List[MemoryLayer] = bctx.memories
+        # SubsequenceInput forces nesting; a nested-sequence Argument at
+        # runtime also triggers it (the reference reads nesting from the
+        # provider's slot types, not the config wrapper) — mixing nested,
+        # flat-sequence and non-sequence iterated inputs is allowed, matching
+        # RecurrentGradientMachine's per-input sequence matching
         self.is_nested = any(self.sub_seq_flags)
-        if self.is_nested and not all(self.sub_seq_flags):
-            raise ValueError(
-                "recurrent_group mixes SubsequenceInput with flat sequence "
-                "inputs; all iterated inputs must share one nesting level "
-                "(RecurrentGradientMachine requires equal sequence structure)"
-            )
         self.multi_out = not isinstance(outs, Layer)
         self.out_layers: List[Layer] = [outs] if isinstance(outs, Layer) else list(outs)
 
@@ -297,7 +296,12 @@ class _GroupCore:
         for ph in self.placeholders:
             if getattr(ph, "static", None) is not None:
                 arg = static_vals[si]
-                seeded[ph.name] = arg if ph.static.is_seq else arg.as_non_seq()
+                # a StaticInput of a sequence layer keeps its sequence
+                # structure even without is_seq=True (the reference passes
+                # the Argument through whole; is_seq only governs per-step
+                # expansion of packed values)
+                keep_seq = ph.static.is_seq or arg.lengths is not None
+                seeded[ph.name] = arg if keep_seq else arg.as_non_seq()
                 si += 1
 
     def init_carry(
@@ -344,12 +348,15 @@ class RecurrentGroup(Layer):
         seq, static, boot_map = core.split_outer(ins)
         if not seq:
             raise ValueError("recurrent_group needs at least one sequence input")
-        lengths = seq[0].lengths
-        if lengths is None:
-            raise ValueError("recurrent_group inputs must be sequences")
-        if core.is_nested:
+        if core.is_nested or any(
+            a.sub_lengths is not None and a.value.ndim > 2 for a in seq
+        ):
             return self._run_nested(ctx, seq, static, boot_map)
-        batch, t_max = seq[0].value.shape[:2]
+        anchor = next((a for a in seq if a.lengths is not None), None)
+        if anchor is None:
+            raise ValueError("recurrent_group inputs must be sequences")
+        lengths = anchor.lengths
+        batch, t_max = anchor.value.shape[:2]
 
         seeded_static: Dict[str, Argument] = {}
         core.seed_static(seeded_static, static)
@@ -361,6 +368,11 @@ class RecurrentGroup(Layer):
             if getattr(ph, "static", None) is None
         ]
 
+        def slice_t(a: Argument, t):
+            # non-seq iterated inputs repeat every step (the reference
+            # broadcasts NO_SEQUENCE args across the unroll)
+            return a.value if a.lengths is None else a.value[:, t]
+
         def seed_t(xs_t: List[Array]) -> Dict[str, Argument]:
             seeded = dict(seeded_static)
             for ph, x in zip(seq_phs, xs_t):
@@ -371,7 +383,7 @@ class RecurrentGroup(Layer):
 
         if ctx.mode == "init":
             # one eager step creates all params; tile the result over time
-            seeded = seed_t([s.value[:, 0] for s in seq])
+            seeded = seed_t([slice_t(s, 0) for s in seq])
             for m in core.memories:
                 seeded[m.name] = Argument(carry0[m.name])
             values = _eval_subnet(core.order, ctx, seeded)
@@ -387,7 +399,7 @@ class RecurrentGroup(Layer):
         keys0 = set(ctx.state_updates)
 
         def body(carry: Dict[str, Array], t: Array):
-            seeded = seed_t([s.value[:, t] for s in seq])
+            seeded = seed_t([slice_t(s, t) for s in seq])
             for m in core.memories:
                 seeded[m.name] = Argument(carry[m.name])
             values = _eval_subnet(core.order, ctx, seeded)
@@ -438,15 +450,19 @@ class RecurrentGroup(Layer):
         nested frame expansion (sequence_nest_rnn.conf idiom) as two stacked
         lax.scans over static shapes."""
         core = self.core
-        for a in seq:
-            if a.sub_lengths is None or a.value.ndim < 3:
-                raise ValueError(
-                    f"{self.name}: SubsequenceInput needs a nested [B, S, T, ...] "
-                    "Argument with sub_lengths [B, S]"
-                )
-        outer_len = seq[0].lengths  # [B] valid subsequence counts
-        sub_lengths = seq[0].sub_lengths  # [B, S]
-        batch, s_max = seq[0].value.shape[:2]
+
+        def is_nested_arg(a: Argument) -> bool:
+            return a.sub_lengths is not None and a.value.ndim > 2
+
+        anchor = next((a for a in seq if is_nested_arg(a)), None)
+        if anchor is None:
+            raise ValueError(
+                f"{self.name}: SubsequenceInput needs a nested [B, S, T, ...] "
+                "Argument with sub_lengths [B, S]"
+            )
+        outer_len = anchor.lengths  # [B] valid subsequence counts
+        sub_lengths = anchor.sub_lengths  # [B, S]
+        batch, s_max = anchor.value.shape[:2]
 
         seeded_static: Dict[str, Argument] = {}
         core.seed_static(seeded_static, static)
@@ -456,14 +472,24 @@ class RecurrentGroup(Layer):
         ]
         out_names = [l.name for l in core.out_layers]
 
-        def seed_s(sub_vals: List[Array], sub_len: Array) -> Dict[str, Argument]:
+        def slice_s(a: Argument, s) -> Argument:
+            # per-input sequence matching (RecurrentGradientMachine): nested
+            # args yield their s-th subsequence as a level-1 sequence, flat
+            # sequences their s-th token, non-seq args repeat every step
+            if is_nested_arg(a):
+                return Argument(a.value[:, s], a.sub_lengths[:, s])
+            if a.lengths is not None:
+                return Argument(a.value[:, s])
+            return a
+
+        def seed_s(s) -> Dict[str, Argument]:
             seeded = dict(seeded_static)
-            for ph, x in zip(seq_phs, sub_vals):
-                seeded[ph.name] = Argument(x, sub_len)
+            for ph, a in zip(seq_phs, seq):
+                seeded[ph.name] = slice_s(a, s)
             return seeded
 
         if ctx.mode == "init":
-            seeded = seed_s([a.value[:, 0] for a in seq], sub_lengths[:, 0])
+            seeded = seed_s(0)
             for m in core.memories:
                 seeded[m.name] = Argument(carry0[m.name])
             values = _eval_subnet(core.order, ctx, seeded)
@@ -483,10 +509,7 @@ class RecurrentGroup(Layer):
         out_is_seq: Dict[str, bool] = {}
 
         def body(carry: Dict[str, Array], s: Array):
-            seeded = seed_s(
-                [a.value[:, s] for a in seq],
-                sub_lengths[:, s],
-            )
+            seeded = seed_s(s)
             for m in core.memories:
                 seeded[m.name] = Argument(carry[m.name])
             values = _eval_subnet(core.order, ctx, seeded)

@@ -83,7 +83,10 @@ class Topology:
                     batch[name] = np.zeros((batch_size,), np.int32)
                 _ = hi
             elif spec is not None and spec.kind in ("dense_subseq", "index_subseq"):
-                s_max = max(seq_len // 2, 1)
+                # subsequence count == seq_len so per-subsequence outputs
+                # align with level-1 sequence slots in the same synthetic
+                # batch (a seq label per subsequence is the common pairing)
+                s_max = max(seq_len, 1)
                 if spec.kind == "dense_subseq":
                     batch[name] = np.zeros(
                         (batch_size, s_max, seq_len) + shape, np.float32

@@ -365,3 +365,27 @@ def test_reference_unequalength_multi_output_group_confs_parse():
         reset_name_scope()
         pc = parse_config(os.path.join(conf_dir, conf))
         assert len(pc.topology.network.layer_order) >= 10
+
+
+def test_reference_provider_inferred_nesting_confs_parse():
+    """sequence_rnn_mixed_inputs.py / sequence_rnn_matched_inputs.py: nesting
+    comes from the PROVIDER's slot types (integer_value_sub_sequence), not a
+    SubsequenceInput wrapper — parse_config binds the provider's input_types
+    before tracing, and the group machinery mixes nested / flat-seq / non-seq
+    iterated inputs at runtime."""
+    import os
+
+    conf_dir = "/root/reference/paddle/gserver/tests"
+    if not os.path.isdir(conf_dir):
+        pytest.skip("reference tree not available")
+    from paddle_tpu.config.config_parser import parse_config
+
+    cwd = os.getcwd()
+    os.chdir("/root/reference/paddle")
+    try:
+        for conf in ("sequence_rnn_mixed_inputs.py", "sequence_rnn_matched_inputs.py"):
+            reset_name_scope()
+            pc = parse_config(os.path.join(conf_dir, conf))
+            assert len(pc.topology.network.layer_order) >= 8
+    finally:
+        os.chdir(cwd)
