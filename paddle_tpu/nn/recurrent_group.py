@@ -291,6 +291,55 @@ class _GroupCore:
                 bi += 1
         return seq, static, boot_map
 
+    def seq_deps(self) -> Dict[str, set]:
+        """layer name → indices of the iterated (seq) inputs in its step-net
+        ancestry, memories included through their links (fixpoint). Drives
+        per-input sequence matching when iterated inputs have different
+        lengths (RecurrentGradientMachine's unequal-length contract)."""
+        if getattr(self, "_seq_deps", None) is not None:
+            return self._seq_deps
+        seq_phs = [
+            ph for ph in self.placeholders if getattr(ph, "static", None) is None
+        ]
+        ph_idx = {ph.name: i for i, ph in enumerate(seq_phs)}
+        dep: Dict[str, set] = {}
+
+        def of(layer) -> set:
+            n = layer.name
+            if n in dep:
+                return dep[n]
+            if n in ph_idx:
+                dep[n] = {ph_idx[n]}
+            elif isinstance(layer, MemoryLayer):
+                dep[n] = set()  # filled by the fixpoint below
+            else:
+                dep[n] = set()
+                for inp in getattr(layer, "inputs", []) or []:
+                    dep[n] = dep[n] | of(inp)
+            return dep[n]
+
+        for l in self.order:
+            of(l)
+        for _ in range(len(self.memories) + 1):  # fixpoint over memory links
+            changed = False
+            for m in self.memories:
+                link = self.links.get(m.name)
+                if link is None:
+                    continue
+                add = dep.get(link.name, set()) - dep.get(m.name, set())
+                if add:
+                    dep[m.name] = dep.get(m.name, set()) | add
+                    changed = True
+                    for l in self.order:  # propagate downstream
+                        for inp in getattr(l, "inputs", []) or []:
+                            miss = dep.get(inp.name, set()) - dep.get(l.name, set())
+                            if miss:
+                                dep[l.name] = dep.get(l.name, set()) | miss
+            if not changed:
+                break
+        self._seq_deps = dep
+        return dep
+
     def seed_static(self, seeded: Dict[str, Argument], static_vals: List[Argument]):
         si = 0
         for ph in self.placeholders:
@@ -356,7 +405,25 @@ class RecurrentGroup(Layer):
         if anchor is None:
             raise ValueError("recurrent_group inputs must be sequences")
         lengths = anchor.lengths
-        batch, t_max = anchor.value.shape[:2]
+        batch = anchor.value.shape[0]
+        # iterated inputs may have different lengths; the unroll covers the
+        # longest, each memory/output masked by its own inputs' lengths
+        t_max = max(
+            a.value.shape[1] for a in seq if a.lengths is not None
+        )
+        deps = core.seq_deps()
+
+        def dep_lengths(name: str):
+            idxs = [
+                i for i in deps.get(name, set())
+                if seq[i].lengths is not None
+            ]
+            if not idxs:
+                return lengths
+            out = seq[idxs[0]].lengths
+            for i in idxs[1:]:
+                out = jnp.maximum(out, seq[i].lengths)
+            return out
 
         seeded_static: Dict[str, Argument] = {}
         core.seed_static(seeded_static, static)
@@ -370,8 +437,12 @@ class RecurrentGroup(Layer):
 
         def slice_t(a: Argument, t):
             # non-seq iterated inputs repeat every step (the reference
-            # broadcasts NO_SEQUENCE args across the unroll)
-            return a.value if a.lengths is None else a.value[:, t]
+            # broadcasts NO_SEQUENCE args across the unroll); shorter inputs
+            # clamp to their last step (masking freezes dependent state)
+            if a.lengths is None:
+                return a.value
+            tt = jnp.minimum(t, a.value.shape[1] - 1)
+            return a.value[:, tt]
 
         def seed_t(xs_t: List[Array]) -> Dict[str, Argument]:
             seeded = dict(seeded_static)
@@ -403,7 +474,6 @@ class RecurrentGroup(Layer):
             for m in core.memories:
                 seeded[m.name] = Argument(carry[m.name])
             values = _eval_subnet(core.order, ctx, seeded)
-            valid = (t < lengths)  # [B]
             new_carry = {}
             for m in core.memories:
                 link_arg = values[core.links[m.name].name]
@@ -416,6 +486,7 @@ class RecurrentGroup(Layer):
                     from paddle_tpu.ops import sequence as _seq_ops
 
                     new = _seq_ops.seq_last(new, link_arg.lengths)
+                valid = (t < dep_lengths(m.name))  # [B], per-memory lengths
                 mask = valid.reshape((-1,) + (1,) * (new.ndim - 1))
                 new_carry[m.name] = jnp.where(mask, new, old)
             return new_carry, tuple(values[n].value for n in out_names)
@@ -433,7 +504,7 @@ class RecurrentGroup(Layer):
             ys = jnp.swapaxes(ys, 0, 1)  # [B, T, ...]
             if core.reverse:
                 ys = jnp.flip(ys, axis=1)
-            outs[n] = Argument(ys, lengths)
+            outs[n] = Argument(ys, dep_lengths(n))
         return outs
 
     def _run_nested(
@@ -542,6 +613,19 @@ class RecurrentGroup(Layer):
             if k not in keys0_cache:
                 del ctx.cache[k]
 
+        deps = core.seq_deps()
+
+        def dep_sub_lengths(name: str):
+            # inner lengths follow the nested inputs in the output's
+            # ancestry (unequal-length multi-input groups); anchor otherwise
+            idxs = [i for i in deps.get(name, set()) if is_nested_arg(seq[i])]
+            if not idxs:
+                return sub_lengths
+            out = seq[idxs[0]].sub_lengths
+            for i in idxs[1:]:
+                out = jnp.maximum(out, seq[i].sub_lengths)
+            return out
+
         outs = {}
         for n, ys in zip(out_names, stacked):
             ys = jnp.swapaxes(ys, 0, 1)  # [B, S, ...]
@@ -550,7 +634,7 @@ class RecurrentGroup(Layer):
             if out_is_seq[n]:
                 # sequence-valued step output (e.g. an inner group's full
                 # unroll): stacks to a nested [B, S, T, ...] Argument
-                outs[n] = Argument(ys, outer_len, sub_lengths)
+                outs[n] = Argument(ys, outer_len, dep_sub_lengths(n))
             else:
                 # flat [B, D] step output → level-1 sequence over s
                 outs[n] = Argument(ys, outer_len)

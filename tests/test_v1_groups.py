@@ -389,3 +389,53 @@ def test_reference_provider_inferred_nesting_confs_parse():
             assert len(pc.topology.network.layer_order) >= 8
     finally:
         os.chdir(cwd)
+
+
+def test_reference_unequalength_pair_numeric_equivalence():
+    """sequence_nest_rnn_multi_unequalength_inputs.py vs its flat twin: two
+    iterated inputs of DIFFERENT lengths, two inner groups chained through
+    outer memories, in-step expand, multi-output steps — with shared weights
+    the costs must match exactly (per-input sequence matching: each memory
+    and output follows its own inputs' lengths)."""
+    import os
+
+    conf_dir = "/root/reference/paddle/gserver/tests"
+    if not os.path.isdir(conf_dir):
+        pytest.skip("reference tree not available")
+    from paddle_tpu.config.config_parser import parse_config
+
+    pn = parse_config(
+        os.path.join(conf_dir, "sequence_nest_rnn_multi_unequalength_inputs.py")
+    )
+    reset_name_scope()
+    pf = parse_config(
+        os.path.join(conf_dir, "sequence_rnn_multi_unequalength_inputs.py")
+    )
+
+    rs = np.random.RandomState(0)
+    ids1 = rs.randint(0, 10, (2, 2, 3)).astype(np.int32)
+    ids2 = rs.randint(0, 10, (2, 2, 4)).astype(np.int32)
+    nb = {
+        "word1": ids1, "word1.lengths": np.array([2, 2], np.int32),
+        "word1.sub_lengths": np.full((2, 2), 3, np.int32),
+        "word2": ids2, "word2.lengths": np.array([2, 2], np.int32),
+        "word2.sub_lengths": np.full((2, 2), 4, np.int32),
+        "label": np.array([1, 0], np.int32),
+    }
+    fb = {
+        "word1": ids1.reshape(2, 6), "word1.lengths": np.array([6, 6], np.int32),
+        "word2": ids2.reshape(2, 8), "word2.lengths": np.array([8, 8], np.int32),
+        "label": np.array([1, 0], np.int32),
+    }
+    net_n, net_f = Network(pn.outputs), Network(pf.outputs)
+    par_n, st_n = net_n.init(jax.random.PRNGKey(0), nb)
+    par_f, st_f = net_f.init(jax.random.PRNGKey(1), fb)
+    assert [tuple(np.shape(v)) for v in par_n.values()] == [
+        tuple(np.shape(v)) for v in par_f.values()
+    ]
+    shared = dict(zip(par_n.keys(), par_f.values()))
+    on, _ = net_n.apply(shared, st_n, nb)
+    of, _ = net_f.apply(par_f, st_f, fb)
+    cn = float(on[pn.outputs[0].name].value)
+    cf = float(of[pf.outputs[0].name].value)
+    assert cn == pytest.approx(cf, rel=1e-6)
