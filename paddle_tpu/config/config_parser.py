@@ -33,6 +33,9 @@ from paddle_tpu.v2.topology import Topology
 class ParseContext:
     outputs: List[Layer] = dataclasses.field(default_factory=list)
     inputs: List[Layer] = dataclasses.field(default_factory=list)
+    pending_output_names: List[str] = dataclasses.field(default_factory=list)
+    pending_input_names: List[str] = dataclasses.field(default_factory=list)
+    model_type: str = "nn"
     opt_config: Optional[proto.OptimizationConfig] = None
     data_config: Optional[proto.DataConfig] = None
     test_data_config: Optional[proto.DataConfig] = None
@@ -75,6 +78,97 @@ def outputs(*layers: Union[Layer, Sequence[Layer]]) -> None:
         else:
             flat.extend(l)
     g_context().outputs.extend(flat)
+
+
+def Outputs(*names: str) -> None:
+    """Legacy raw-config output declaration by layer NAME
+    (config_parser.py Outputs) — resolved after the script runs."""
+    g_context().pending_output_names.extend(names)
+
+
+def Inputs(*names: str) -> None:
+    """Legacy raw-config input declaration by name (config_parser.py
+    Inputs); input slots are derived from the data layers here, so this
+    records intent only."""
+    g_context().pending_input_names.extend(names)
+
+
+def TrainData(spec, async_load_data: bool = False) -> None:
+    """Legacy TrainData(ProtoData(...)/SimpleData(...)/PyData(...))."""
+    if isinstance(spec, proto.DataConfig):
+        spec.async_load_data = bool(async_load_data)
+        g_context().data_config = spec
+
+
+def TestData(spec, async_load_data: bool = False) -> None:
+    if isinstance(spec, proto.DataConfig):
+        g_context().test_data_config = spec
+
+
+def ProtoData(files: str = "", type: str = "proto", **kw) -> proto.DataConfig:  # noqa: A002
+    return proto.DataConfig(type=type, files=files)
+
+
+def SimpleData(files: str = "", feat_dim: int = 0, **kw) -> proto.DataConfig:
+    return proto.DataConfig(type="simple", files=files)
+
+
+def PyData(files: str = "", load_data_module=None, load_data_object=None,
+           load_data_args: str = "", **kw) -> proto.DataConfig:
+    return proto.DataConfig(
+        type="py", files=files, load_data_module=load_data_module,
+        load_data_object=load_data_object, load_data_args=load_data_args,
+    )
+
+
+def Settings(**kw) -> None:
+    """Legacy Settings(...) — maps onto the helpers' settings() keys where
+    they exist."""
+    from paddle_tpu.config.optimizers import settings as _settings
+
+    known = {}
+    for k in ("batch_size", "learning_rate", "learning_method",
+              "learning_rate_decay_a", "learning_rate_decay_b",
+              "learning_rate_schedule", "l2_weight", "l1_weight",
+              "average_window", "max_average_window"):
+        if k in kw:
+            known[k] = kw[k]
+    if known:
+        try:
+            _settings(**known)
+        except TypeError:
+            pass
+
+
+def model_type(name: str) -> None:
+    """Legacy model_type('recurrent_nn'/'nn') declaration."""
+    g_context().model_type = str(name)
+
+
+def default_initial_std(v: float) -> None:
+    """Legacy global param-init default (config_parser.py) — consumed by
+    Context.param when a parameter has no explicit initial_std."""
+    from paddle_tpu.nn import graph as _g
+
+    _g._param_default["initial_std"] = float(v)
+
+
+def default_initial_mean(v: float) -> None:
+    from paddle_tpu.nn import graph as _g
+
+    _g._param_default["initial_mean"] = float(v)
+
+
+def default_decay_rate(v: float) -> None:
+    g_context().config_args.setdefault("_default_decay_rate", str(v))
+
+
+def default_device(v: int) -> None:  # device placement is a sharding concern
+    return None
+
+
+def default_num_batches_regularization(v: int) -> None:
+    return None
 
 
 def inputs(*layers: Layer) -> None:
@@ -150,6 +244,15 @@ def _dsl_namespace() -> Dict[str, Any]:
         inputs=inputs,
         get_config_arg=get_config_arg,
         define_py_data_sources2=define_py_data_sources2,
+        # legacy raw-config primitives (config_parser.py)
+        Inputs=Inputs, Outputs=Outputs, TrainData=TrainData, TestData=TestData,
+        ProtoData=ProtoData, SimpleData=SimpleData, PyData=PyData,
+        Settings=Settings, model_type=model_type,
+        xrange=range, unicode=str,  # the reference's configs are python-2 era
+        default_initial_std=default_initial_std,
+        default_initial_mean=default_initial_mean,
+        default_decay_rate=default_decay_rate, default_device=default_device,
+        default_num_batches_regularization=default_num_batches_regularization,
     )
     return ns
 
@@ -200,6 +303,20 @@ def parse_config(
             with open(config) as f:
                 code = compile(f.read(), config, "exec")
             exec(code, ns)
+        if ctx.pending_output_names:
+            by_name = {l.name: l for l in created}
+            for n in ctx.pending_output_names:
+                node = by_name.get(n)
+                if node is None and n == "__beam_search_predict__":
+                    # the reference's default beam_search output name; our
+                    # generation node carries the user's group name instead
+                    node = next(
+                        (l for l in created
+                         if getattr(l, "type_name", "") == "beam_search"),
+                        None,
+                    )
+                if node is not None and node not in ctx.outputs:
+                    ctx.outputs.append(node)
         if not ctx.outputs:
             raise ValueError(
                 f"config {config!r} declared no outputs(); call outputs(cost)"
@@ -245,6 +362,7 @@ def parse_config(
 
             tc.model_config = build_model_config(topology)
             tc.model_config.evaluators = list(ctx.evaluators)
+            tc.model_config.type = ctx.model_type
         return ParsedConfig(tc, topology, list(ctx.outputs), ctx)
 
 

@@ -183,6 +183,7 @@ detection_output_layer = _v2.detection_output
 
 # recurrent groups (nn/recurrent_group): the v1 dynamic-unroll API
 from paddle_tpu.v2.layer import (  # noqa: E402
+    GeneratedInput,
     recurrent_group,
     memory,
     StaticInput,
@@ -327,6 +328,16 @@ def chunk_evaluator(input=None, label=None, chunk_scheme="IOB",
     )
 
 
+def seqtext_printer_evaluator(input=None, result_file=None, id_input=None,
+                              dict_file=None, delimited=None, name=None, **kw):
+    """evaluators.py seqtext_printer_evaluator: dump generated sequences to
+    result_file (SequenceTextPrinter) — consumed by the generation CLI."""
+    return _declare_evaluator(
+        "seq_text_printer", input, id_input, name=name,
+        result_file=result_file or "", dict_file=dict_file or "",
+        delimited=bool(delimited) if delimited is not None else True, **kw)
+
+
 def value_printer_evaluator(input=None, name=None, **kw):
     """utils evaluator (Evaluator.h ValuePrinter): print layer outputs."""
     ins = input if isinstance(input, (list, tuple)) else [input]
@@ -451,6 +462,7 @@ from paddle_tpu.config import layer_math  # noqa: E402
 __all__ = [
     "printer_layer", "kmax_seq_score_layer", "layer_math",
     "slice_projection", "CudnnMaxPooling", "CudnnAvgPooling",
+    "GeneratedInput",
     "lstmemory_group", "lstmemory_unit", "gru_group", "gru_unit",
     "lstm_step_layer", "gru_step_layer", "gru_step_naive_layer",
     "simple_gru2", "gated_unit_layer", "seq_slice_layer",
@@ -514,7 +526,7 @@ __all__ = [
     "text_conv_pool", "simple_attention", "sequence_conv_pool",
     "conv_projection", "conv_operator",
     # evaluators
-    "classification_error_evaluator", "auc_evaluator",
+    "seqtext_printer_evaluator", "classification_error_evaluator", "auc_evaluator",
     "precision_recall_evaluator", "pnpair_evaluator", "sum_evaluator",
     "column_sum_evaluator", "chunk_evaluator", "ctc_error_evaluator",
     "detection_map_evaluator",

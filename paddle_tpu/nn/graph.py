@@ -196,6 +196,13 @@ class Context:
                     initializer = (
                         lambda k, s, d: mean + std * jax.random.normal(k, s, d)
                     )
+                elif attr.initializer is None and _param_default:
+                    std = _param_default.get("initial_std")
+                    mean = _param_default.get("initial_mean", 0.0)
+                    if std is not None:
+                        initializer = (
+                            lambda k, s, d: mean + std * jax.random.normal(k, s, d)
+                        )
                 value = initializer(
                     self.next_rng(full), tuple(shape), self.policy.param_dtype
                 )
@@ -242,6 +249,10 @@ def _stable_hash(s: str) -> int:
 _name_lock = threading.Lock()
 _name_counters: Dict[str, int] = {}
 
+# legacy config default init policy (config_parser default_initial_std/mean);
+# consumed by Context.param when a parameter has no explicit init
+_param_default: Dict[str, float] = {}
+
 
 def _auto_name(type_name: str) -> str:
     with _name_lock:
@@ -252,6 +263,7 @@ def _auto_name(type_name: str) -> str:
 
 def reset_name_scope() -> None:
     """Reset auto-name counters (call between independently-built graphs)."""
+    _param_default.clear()
     with _name_lock:
         _name_counters.clear()
 

@@ -268,14 +268,19 @@ class HierarchicalSigmoid(Layer):
         param_attr: Any = None,
         name: Optional[str] = None,
     ):
-        super().__init__([input, label], name=name)
+        # multiple feature inputs (the reference sums per-input projections;
+        # concatenating features with one wide weight is the same map)
+        ins = list(input) if isinstance(input, (list, tuple)) else [input]
+        super().__init__(ins + [label], name=name)
+        self.n_feats = len(ins)
         self.num_classes = num_classes
         self.bias = bias
         self.param_attr = _attr(param_attr)
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
-        x = ins[0].value  # [B, D]
-        label = ins[1].value.astype(jnp.int32).reshape(-1)
+        n = getattr(self, "n_feats", 1)
+        x = jnp.concatenate([a.value for a in ins[:n]], axis=-1)  # [B, D]
+        label = ins[n].value.astype(jnp.int32).reshape(-1)
         bsz, d = x.shape
         c = self.num_classes
         w = ctx.param(

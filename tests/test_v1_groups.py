@@ -439,3 +439,28 @@ def test_reference_unequalength_pair_numeric_equivalence():
     cn = float(on[pn.outputs[0].name].value)
     cf = float(of[pf.outputs[0].name].value)
     assert cn == pytest.approx(cf, rel=1e-6)
+
+
+def test_reference_trainer_sample_configs_parse():
+    """paddle/trainer/tests sample configs using the legacy raw-config
+    primitives (Settings/TrainData/ProtoData/Inputs/Outputs/default_*,
+    py2-era builtins) plus the beam-generation conf with GeneratedInput and
+    Outputs('__beam_search_predict__')."""
+    import os
+
+    conf_dir = "/root/reference/paddle/trainer/tests"
+    if not os.path.isdir(conf_dir):
+        pytest.skip("reference tree not available")
+    from paddle_tpu.config.config_parser import parse_config
+
+    for conf in (
+        "sample_trainer_config.conf",
+        "sample_trainer_config_hsigmoid.conf",
+        "sample_trainer_config_opt_a.conf",
+        "sample_trainer_config_opt_b.conf",
+        "sample_trainer_config_parallel.conf",
+        "sample_trainer_rnn_gen.conf",
+    ):
+        reset_name_scope()
+        pc = parse_config(os.path.join(conf_dir, conf))
+        assert pc.outputs
