@@ -103,13 +103,17 @@ def _infer_geom(input: Layer, num_channels: Optional[int]):
             f"cannot infer image size of layer {getattr(input, 'name', input)!r}"
         )
     hw = size // num_channels
-    side = int(math.isqrt(hw))
-    if side * side != hw:
+    # parse_image's rule (config_parser.py get_img_size): width = floor-sqrt
+    # of the pixel count, height = pixels / width — square when possible,
+    # rectangular otherwise, rejected when indivisible
+    w = int(math.isqrt(hw))
+    if w == 0 or hw % w:
         raise ValueError(
-            f"input size {size} with {num_channels} channels is not a square "
-            f"image (parse_image would reject this too)"
+            f"input size {size} with {num_channels} channels has no "
+            f"integer {{w}}x{{h}} factorization from width floor-sqrt "
+            f"(parse_image would reject this too)"
         )
-    return (num_channels, side, side)
+    return (num_channels, hw // w, w)
 
 
 def _is_flat(node: Layer) -> bool:

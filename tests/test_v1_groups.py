@@ -460,6 +460,7 @@ def test_reference_trainer_sample_configs_parse():
         "sample_trainer_config_opt_b.conf",
         "sample_trainer_config_parallel.conf",
         "sample_trainer_rnn_gen.conf",
+        "test_config.conf",
     ):
         reset_name_scope()
         pc = parse_config(os.path.join(conf_dir, conf))
