@@ -315,6 +315,9 @@ def cmd_train(args: argparse.Namespace) -> int:
                 None if ec.positive_label in (-1, None) else ec.positive_label))
         elif ec.type == "max_id_printer":
             kw = dict(num_results=ec.num_results)
+        elif ec.type == "seq_text_printer":
+            kw = dict(result_file=ec.result_file or "generated_sequences.txt",
+                      dict_file=ec.dict_file, delimited=ec.delimited)
         return EVALUATORS.get(ec.type)(**kw)
 
     active = [

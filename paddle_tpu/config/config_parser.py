@@ -309,12 +309,20 @@ def parse_config(
                 node = by_name.get(n)
                 if node is None and n == "__beam_search_predict__":
                     # the reference's default beam_search output name; our
-                    # generation node carries the user's group name instead
-                    node = next(
-                        (l for l in created
-                         if getattr(l, "type_name", "") == "beam_search"),
-                        None,
-                    )
+                    # generation node carries the user's group name instead.
+                    # An outer recurrent_group whose step generates (the
+                    # nested-generation idiom, sample_trainer_nest_rnn_gen)
+                    # counts too — its output concatenates the inner beams.
+                    def _generates(l) -> bool:
+                        if getattr(l, "type_name", "") == "beam_search":
+                            return True
+                        core = getattr(l, "_group_core", None)
+                        return core is not None and any(
+                            getattr(o, "type_name", "") == "beam_search"
+                            for o in core.out_layers
+                        )
+
+                    node = next((l for l in created if _generates(l)), None)
                 if node is not None and node not in ctx.outputs:
                     ctx.outputs.append(node)
         if not ctx.outputs:

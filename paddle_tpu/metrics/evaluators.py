@@ -558,3 +558,91 @@ class ClassificationErrorPrinter(ValuePrinter):
         lab = np.asarray(label).reshape(-1)
         err = (pred != lab[: len(pred)]).astype(np.int32)
         self._write(f"[classification_error_printer] err={err[:32].tolist()}")
+
+
+@EVALUATORS.register("seq_text_printer")
+class SequenceTextPrinter(Evaluator):
+    """seqtext_printer_evaluator → SequenceTextPrinter (Evaluator.cpp:1192):
+    dump generated sequences to `result_file`, byte-compatible with the
+    reference's three output shapes — plain per-sample lines, beam blocks
+    (`sample\\n rank\\tscore\\t toks...` per result, Evaluator.cpp:1303 beam
+    print), and nested per-subsequence lines (Evaluator.cpp:1286)."""
+
+    def __init__(self, result_file: str, dict_file: str = "",
+                 delimited: bool = True, **_kw):
+        self.result_file = result_file
+        self.delimited = delimited
+        self.dict: list = []
+        if dict_file:
+            with open(dict_file) as f:
+                self.dict = [line.rstrip("\n") for line in f]
+        self._fh = None
+
+    def start(self):
+        self._fh = open(self.result_file, "w")
+
+    def _toks(self, ids) -> str:
+        sep = " " if self.delimited else ""
+        return "".join(
+            sep + (self.dict[int(i)] if self.dict else str(int(i)))
+            for i in ids
+        )
+
+    def _fmt_score(self, v: float) -> str:
+        # C++ default ostream float formatting (6 significant digits)
+        return f"{float(v):g}"
+
+    def update(self, output=None, sample_ids=None, beam=None, lengths=None,
+               sub_lengths=None, **_kw):
+        """output: [B, L] best-beam ids (or [B, S, L] nested); lengths [B]
+        (valid subsequence count when nested); sub_lengths [B, S] per-subseq
+        token counts; beam: the generation payload cached by BeamSearchLayer
+        {history [B,K,L], scores [B,K], lengths [B,K], num_results}."""
+        out = self._fh
+        values = None if output is None else np.asarray(output)
+        beam_mode = beam is not None and int(beam.get("num_results", 1)) > 1
+        if values is None and beam is not None and not beam_mode:
+            # best-beam fallback when the caller hands only the payload
+            values = np.asarray(beam["history"])[:, 0]
+            lengths = np.asarray(beam["lengths"])[:, 0]
+        nested = values is not None and values.ndim == 3
+        if beam_mode:
+            all_hist = np.asarray(beam["history"])
+            all_scores = np.asarray(beam["scores"])
+            all_lens = np.asarray(beam["lengths"])
+            n = len(all_hist)
+        else:
+            n = len(values)
+        ids_flat = (
+            None if sample_ids is None else np.asarray(sample_ids).reshape(-1)
+        )
+        lengths = None if lengths is None else np.asarray(lengths)
+        sub_lengths = None if sub_lengths is None else np.asarray(sub_lengths)
+        for i in range(n):
+            sid = i if ids_flat is None else int(ids_flat[i])
+            out.write(str(sid))
+            # each sample ends with the evalImp loop's final endl; in plain
+            # mode it terminates the line, in beam/nested modes (whose inner
+            # lines carry their own endl) it yields the blank separator line
+            if beam_mode:
+                hist, scores, lens = all_hist[i], all_scores[i], all_lens[i]
+                out.write("\n")
+                for j in range(int(beam["num_results"])):
+                    out.write(f"{j}\t{self._fmt_score(scores[j])}\t")
+                    out.write(self._toks(hist[j, : lens[j]]) + "\n")
+            elif nested:
+                n_sub = int(lengths[i]) if lengths is not None else values.shape[1]
+                sl = sub_lengths[i] if sub_lengths is not None else None
+                for s in range(n_sub):
+                    t = int(sl[s]) if sl is not None else values.shape[2]
+                    out.write("\t" + self._toks(values[i, s, :t]) + "\n")
+            else:
+                t = int(lengths[i]) if lengths is not None else values.shape[1]
+                out.write("\t" + self._toks(values[i, :t]))
+            out.write("\n")
+
+    def finish(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        return 0.0

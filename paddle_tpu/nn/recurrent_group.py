@@ -601,9 +601,18 @@ class RecurrentGroup(Layer):
                     new = _seq_ops.seq_last(new, link_arg.lengths)
                 mask = valid.reshape((-1,) + (1,) * (new.ndim - 1))
                 new_carry[m.name] = jnp.where(mask, new, old)
-            return new_carry, tuple(values[n].value for n in out_names)
+            # sequence-valued outputs whose lengths are *computed by the step*
+            # (beam generation) stack their per-step lengths into the nested
+            # sub_lengths; input-derived outputs keep dep_sub_lengths below
+            lens = tuple(
+                values[n].lengths
+                if values[n].is_seq
+                else jnp.zeros((batch,), jnp.int32)
+                for n in out_names
+            )
+            return new_carry, (tuple(values[n].value for n in out_names), lens)
 
-        _, stacked = lax.scan(body, carry0, ss)
+        _, (stacked, stacked_lens) = lax.scan(body, carry0, ss)
         # inner groups cache their per-trace results and state updates under
         # ctx while the body traces; those hold scan tracers — drop them
         for k in list(ctx.state_updates):
@@ -627,14 +636,26 @@ class RecurrentGroup(Layer):
             return out
 
         outs = {}
-        for n, ys in zip(out_names, stacked):
+        gen_outs = {
+            l.name for l in core.out_layers if isinstance(l, BeamSearchLayer)
+        }
+        for n, ys, ls in zip(out_names, stacked, stacked_lens):
             ys = jnp.swapaxes(ys, 0, 1)  # [B, S, ...]
             if core.reverse:
                 ys = jnp.flip(ys, axis=1)
             if out_is_seq[n]:
                 # sequence-valued step output (e.g. an inner group's full
-                # unroll): stacks to a nested [B, S, T, ...] Argument
-                outs[n] = Argument(ys, outer_len, dep_sub_lengths(n))
+                # unroll): stacks to a nested [B, S, T, ...] Argument. A
+                # generating step (beam_search) computes its own lengths —
+                # those stack into sub_lengths (the reference concatenates the
+                # generated inner results, RecurrentGradientMachine.cpp:536).
+                if n in gen_outs:
+                    sl = jnp.swapaxes(ls, 0, 1)
+                    if core.reverse:
+                        sl = jnp.flip(sl, axis=1)
+                else:
+                    sl = dep_sub_lengths(n)
+                outs[n] = Argument(ys, outer_len, sl)
             else:
                 # flat [B, D] step output → level-1 sequence over s
                 outs[n] = Argument(ys, outer_len)
@@ -702,6 +723,7 @@ class BeamSearchLayer(Layer):
         eos_id: int,
         beam_size: int,
         max_length: int,
+        num_results_per_sample: int = 1,
         name: Optional[str] = None,
     ):
         super().__init__(core.outer_inputs(), name=name)
@@ -710,6 +732,7 @@ class BeamSearchLayer(Layer):
         self.eos_id = eos_id
         self.beam_size = beam_size
         self.max_length = max_length
+        self.num_results_per_sample = min(num_results_per_sample, beam_size)
 
     def _embed(self, ctx: Context, tokens: Array) -> Array:
         gen = self.core.generated
@@ -802,6 +825,16 @@ class BeamSearchLayer(Layer):
         ids = res.history[:, 0]
         lengths = res.lengths[:, 0]
         ctx.cache[(id(core), "beam_scores")] = res.scores
+        # full result for the generation runner / seq_text_printer
+        # (fillGenOutputs packs [len, ids..., -1] per beam + a probs matrix,
+        # RecurrentGradientMachine.cpp:1301-1345; we keep the arrays)
+        ctx.cache[("beam", self.name)] = {
+            "history": res.history,
+            "scores": res.scores,
+            "lengths": res.lengths,
+            "num_results": self.num_results_per_sample,
+            "eos_id": self.eos_id,
+        }
         return Argument(ids, lengths)
 
 
@@ -812,10 +845,14 @@ def beam_search(
     eos_id: int,
     beam_size: int = 4,
     max_length: int = 50,
+    num_results_per_sample: int = 1,
     name: Optional[str] = None,
     **_compat,
 ) -> Layer:
     core = _GroupCore(step, input)
-    node = BeamSearchLayer(core, bos_id, eos_id, beam_size, max_length, name=name)
+    node = BeamSearchLayer(
+        core, bos_id, eos_id, beam_size, max_length,
+        num_results_per_sample=num_results_per_sample, name=name,
+    )
     node._group_core = core
     return node

@@ -1,0 +1,92 @@
+"""Config-driven generation: the reference Trainer's generation job.
+
+Replicates what test_recurrent_machine_generation.cpp:59-88 drives by hand:
+build a GradientMachine from a parsed config, loadParameters(modelDir),
+forward one batch in PASS_TEST, then run the declared evaluators — the
+seqtext printer writes the generated sequences to its result_file.
+
+The reference resolves the config's relative dict_file/result_file paths
+against its working directory; `base_dir` plays that role here, and
+`result_file` overrides the config's destination (tests write to a tmpdir,
+never next to the read-only reference tree).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from paddle_tpu.nn.graph import Context, Network
+
+
+def _resolve(path: str, base_dir: Optional[str]) -> str:
+    if base_dir is not None and path and not os.path.isabs(path):
+        return os.path.join(base_dir, path)
+    return path
+
+
+def run_generation(
+    pc,
+    batch: Dict[str, Any],
+    model_dir: Optional[str] = None,
+    base_dir: Optional[str] = None,
+    result_file: Optional[str] = None,
+    rng: Optional[jax.Array] = None,
+) -> Dict[str, str]:
+    """Generate with a ParsedConfig and write the printer outputs.
+
+    Returns {evaluator name: result file written}. The generated node is the
+    config's output (`__beam_search_predict__` resolution); its cached beam
+    payload (scores/all-beam histories) feeds the beam-mode print.
+    """
+    from paddle_tpu.metrics.evaluators import EVALUATORS
+    from paddle_tpu.trainer.checkpoint import load_pass
+
+    net = Network(pc.outputs)
+    params, states = net.init(
+        rng if rng is not None else jax.random.PRNGKey(0), batch, train=False
+    )
+    if model_dir is not None:
+        import jax.numpy as jnp
+
+        loaded, _, _, _ = load_pass(model_dir, params_template=params)
+        params = {k: jnp.asarray(v) for k, v in loaded.items()}
+
+    ctx = Context("apply", params, states, None, False)
+    values = net._run(ctx, batch)
+
+    written: Dict[str, str] = {}
+    for ec in pc.context.evaluators:
+        if ec.type != "seq_text_printer":
+            continue
+        out_name = ec.input_layers[0] if ec.input_layers else pc.outputs[0].name
+        arg = values.get(out_name)
+        if arg is None:
+            continue
+        dest = result_file or _resolve(ec.result_file, base_dir)
+        printer = EVALUATORS.get("seq_text_printer")(
+            result_file=dest,
+            dict_file=_resolve(ec.dict_file, base_dir),
+            delimited=ec.delimited,
+        )
+        sample_ids = None
+        if len(ec.input_layers) > 1:
+            id_name = ec.input_layers[1]
+            if id_name in batch:
+                sample_ids = np.asarray(batch[id_name])
+        printer.start()
+        printer.update(
+            output=np.asarray(arg.value),
+            sample_ids=sample_ids,
+            beam=ctx.cache.get(("beam", out_name)),
+            lengths=None if arg.lengths is None else np.asarray(arg.lengths),
+            sub_lengths=(
+                None if arg.sub_lengths is None else np.asarray(arg.sub_lengths)
+            ),
+        )
+        printer.finish()
+        written[ec.name or "seq_text_printer"] = dest
+    return written
