@@ -80,7 +80,28 @@ def _load_provider_module(name: str, config_dir: str = ""):
 
 def _load_provider(dc: proto.DataConfig):
     """DataConfig → (provider, file_list, args) — the PyDataProvider2 load
-    path (gserver/dataproviders/PyDataProvider2.cpp:195 loads module.obj)."""
+    path (gserver/dataproviders/PyDataProvider2.cpp:195 loads module.obj),
+    or the builtin ProtoData provider for binary shards
+    (REGISTER_DATA_PROVIDER proto/proto_sequence, ProtoDataProvider.cpp:31)."""
+    if (dc.type or "").startswith("proto"):
+        from paddle_tpu.data.proto_data import (
+            make_proto_provider, resolve_data_path,
+        )
+
+        # one provider per DataConfig: bind_provider_types and _make_reader
+        # both land here, and the provider caches all decoded shards
+        provider = getattr(dc, "_builtin_provider", None)
+        if provider is None:
+            provider = make_proto_provider(dc)
+            dc._builtin_provider = provider
+        files: List[str] = []
+        flist = resolve_data_path(dc.files, dc.config_dir or "") or dc.files
+        if flist and os.path.exists(flist):
+            with open(flist) as f:
+                files = [ln.strip() for ln in f if ln.strip()]
+        elif flist:
+            files = [flist]
+        return provider, files, None
     mod = _load_provider_module(dc.load_data_module, dc.config_dir)
     provider = getattr(mod, dc.load_data_object)
     files: List[str] = []
@@ -115,6 +136,15 @@ def bind_provider_types(topology, dc: proto.DataConfig):
     if types is None:
         return None
     layers = list(topology.data_layers().values())
+    # Inputs("a", "b", ...) in the config pins the slot order (the reference
+    # feeds inArgs in Inputs order, not graph order — chunking.conf's label
+    # slot is last by Inputs but an early cost dependency topologically)
+    declared = getattr(topology, "declared_inputs", None)
+    if declared:
+        by_name = {l.name: l for l in layers}
+        picked = [by_name[n] for n in declared if n in by_name]
+        if len(picked) == len(layers):
+            layers = picked
 
     def apply_spec(layer, spec):
         from paddle_tpu.nn.graph import record_layers
@@ -235,8 +265,17 @@ def cmd_train(args: argparse.Namespace) -> int:
 
         parallel = DataParallel(make_mesh({"data": args.trainer_count}))
 
+    # Outputs() may mix training costs with plain fetch layers
+    # (sample_trainer_config_qb_rnn.conf: Outputs("cost", "qb_rnnlast_left"));
+    # only cost layers join the objective, the rest ride as extra outputs
+    cost_outputs = [l for l in pc.outputs if getattr(l, "is_cost", False)]
+    fetch_outputs = [l for l in pc.outputs if not getattr(l, "is_cost", False)]
+    if not cost_outputs:
+        cost_outputs, fetch_outputs = pc.outputs, []
+
     # evaluator outputs must be network outputs so the step returns them
-    extra_layers, seen = [], {l.name for l in pc.outputs}
+    extra_layers, seen = list(fetch_outputs), {l.name for l in cost_outputs}
+    seen |= {l.name for l in fetch_outputs}
     eval_objs = []
     net_layers = pc.topology.network.layers_by_name
     for ec in pc.context.evaluators:
@@ -248,7 +287,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         eval_objs.append((ec, [l.name for l in ins]))
 
     trainer = SGDTrainer(
-        pc.outputs,
+        cost_outputs,
         bundle.optimizer,
         extra_outputs=extra_layers,
         schedule=bundle.schedule,

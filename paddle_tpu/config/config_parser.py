@@ -254,6 +254,10 @@ def _dsl_namespace() -> Dict[str, Any]:
         default_decay_rate=default_decay_rate, default_device=default_device,
         default_num_batches_regularization=default_num_batches_regularization,
     )
+    # the rawest Layer()/Memory()/RecurrentLayerGroupBegin name-registry DSL
+    from paddle_tpu.config.raw_api import RAW_API
+
+    ns.update(RAW_API)
     return ns
 
 
@@ -360,6 +364,9 @@ def parse_config(
             if l.name not in reachable and l.name not in {d.name for d in dangling}:
                 dangling.append(l)
         topology = Topology(ctx.outputs, extra_layers=dangling)
+        # Inputs(...) fixes the provider slot order (config_parser Inputs);
+        # without it the data layers' topological order stands in
+        topology.declared_inputs = list(ctx.pending_input_names)
         tc = proto.TrainerConfig(
             opt_config=ctx.opt_config or proto.OptimizationConfig(),
             data_config=ctx.data_config,

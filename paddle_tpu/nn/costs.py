@@ -35,6 +35,8 @@ def _flatten_seq(value: Array, lengths: Optional[Array]):
 class CostLayer(Layer):
     """Base for costs: handles sequence flattening + per-example weighting."""
 
+    is_cost = True
+
     def __init__(self, input: Layer, label: Layer, weight: Optional[Layer] = None, name=None, coeff: float = 1.0):
         srcs = [input, label] + ([weight] if weight is not None else [])
         super().__init__(srcs, name=name)
@@ -182,6 +184,7 @@ class RankCost(Layer):
     scores + label in [0,1] preference."""
 
     type_name = "rank_cost"
+    is_cost = True
 
     def __init__(self, left: Layer, right: Layer, label: Layer, weight=None, name=None, coeff=1.0):
         srcs = [left, right, label] + ([weight] if weight is not None else [])
@@ -213,6 +216,7 @@ class MultiBinaryLabelCrossEntropy(CostLayer):
 
 @LAYERS.register("sum_cost")
 class SumCost(Layer):
+    is_cost = True
     """SumCostLayer: cost = sum of input activations."""
 
     type_name = "sum_cost"

@@ -292,6 +292,10 @@ class Layer:
     """
 
     type_name: str = "layer"
+    # cost layers (scalar training objectives) mark themselves so the trainer
+    # can split a config's Outputs() into costs vs plain fetches (the
+    # reference's Outputs may mix both, sample_trainer_config_qb_rnn.conf)
+    is_cost: bool = False
 
     def __init__(
         self,

@@ -150,6 +150,7 @@ class SimpleRnn(Layer):
         reverse: bool = False,
         bias: bool = True,
         param_attr: Any = None,
+        bias_attr: Any = None,
         name: Optional[str] = None,
     ):
         super().__init__(input, name=name)
@@ -157,6 +158,7 @@ class SimpleRnn(Layer):
         self.reverse = reverse
         self.bias = bias
         self.param_attr = _attr(param_attr)
+        self.bias_attr = _attr(bias_attr)
 
     def forward(self, ctx: Context, ins: List[Argument]) -> Argument:
         arg = ins[0]
@@ -166,7 +168,11 @@ class SimpleRnn(Layer):
         w_hh = ctx.param(
             self, "w_hh", (hdim, hdim), init_mod.smart_normal, self.param_attr
         )
-        b = ctx.param(self, "b", (hdim,), init_mod.zeros, None) if self.bias else None
+        b = (
+            ctx.param(self, "b", (hdim,), init_mod.zeros, self.bias_attr)
+            if self.bias
+            else None
+        )
         hs, _ = rnn_ops.simple_rnn_scan(
             proj, arg.mask(proj.dtype), w_hh, b, self.act, reverse=self.reverse
         )

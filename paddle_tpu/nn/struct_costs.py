@@ -42,6 +42,8 @@ class CTCCost(Layer):
     Both carry lengths. blank fixed at 0 to match CTCLayer.cpp.
     """
 
+    is_cost = True
+
     type_name = "ctc"
 
     def __init__(
@@ -78,6 +80,8 @@ class CTCCost(Layer):
 class CRFCost(Layer):
     """Linear-chain CRF NLL (CRFLayer.cpp). Parameter is the reference's packed
     (C+2, C) weight: row0 start, row1 end, rows 2.. transitions."""
+
+    is_cost = True
 
     type_name = "crf"
 
@@ -152,6 +156,8 @@ class NCECost(Layer):
     applies logistic loss with the log(k·q) correction. At eval time (no
     sampling) it computes the full softmax cross-entropy, matching the
     reference's test-time path."""
+
+    is_cost = True
 
     type_name = "nce"
 
@@ -257,6 +263,8 @@ class HierarchicalSigmoid(Layer):
     along the root→leaf path — O(log C) rows touched per example, all gathered
     in one static-depth vectorized pass."""
 
+    is_cost = True
+
     type_name = "hsigmoid"
 
     def __init__(
@@ -314,6 +322,8 @@ class LambdaCost(Layer):
     pairwise logistic losses weighted by |ΔNDCG| truncated at `max_sort_size`.
     The reference emits lambda gradients directly; here the loss whose gradient
     is those lambdas is materialized so jax.grad recovers them."""
+
+    is_cost = True
 
     type_name = "lambda_cost"
 
@@ -411,6 +421,8 @@ class CrossEntropyOverBeam(Layer):
     over the beam at the expansion where gold falls off (or the last one),
     with the gold path appended as an extra candidate when it fell off —
     `-log softmax(paths)[gold]` exactly as CostForOneSequence::forward."""
+
+    is_cost = True
 
     type_name = "cross_entropy_over_beam"
 

@@ -204,7 +204,9 @@ def grumemory(input, size=None, reverse=False, act=None, gate_act=None,
 
 def recurrent(input, act=None, reverse=False, bias_attr=None, param_attr=None, name=None):
     return R.SimpleRnn(input, act=_act(act) or "tanh", reverse=reverse,
-                       bias=bias_attr is not False, param_attr=param_attr, name=name)
+                       bias=bias_attr is not False, param_attr=param_attr,
+                       bias_attr=None if bias_attr in (None, True, False) else bias_attr,
+                       name=name)
 
 
 simple_lstm = R.simple_lstm

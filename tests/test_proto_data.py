@@ -1,0 +1,296 @@
+"""DataFormat binary shards end-to-end: reader/writer round-trip, the
+reference's in-tree shards feeding real training through unmodified configs
+(ProtoDataProvider.cpp / test_TrainerOnePass.cpp idioms), the raw Layer()
+config surface, and the chunking pipeline on generated CoNLL shards."""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+REF_TESTS = "/root/reference/paddle/trainer/tests"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF_TESTS), reason="reference tree not available"
+)
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+
+def test_shard_write_read_roundtrip(tmp_path):
+    from paddle_tpu.data.proto_data import (
+        INDEX, VECTOR_DENSE, VECTOR_SPARSE_NON_VALUE,
+        DataSample, SlotDef, SubseqSlot, VectorSlot, read_shard, write_shard,
+    )
+
+    slot_defs = [
+        SlotDef(VECTOR_DENSE, 3),
+        SlotDef(VECTOR_SPARSE_NON_VALUE, 100),
+        SlotDef(INDEX, 7),
+    ]
+    samples = [
+        DataSample(
+            is_beginning=(i % 2 == 0),
+            vector_slots=[
+                VectorSlot(values=np.arange(3, dtype=np.float32) + i),
+                VectorSlot(ids=[i, i + 1, 99]),
+            ],
+            id_slots=[i % 7],
+            subseq_slots=[SubseqSlot(slot_id=1, lens=[2, 1])] if i == 0 else [],
+        )
+        for i in range(5)
+    ]
+    path = str(tmp_path / "shard.bin")
+    write_shard(path, slot_defs, samples)
+    header, got = read_shard(path)
+    assert [(sd.type, sd.dim) for sd in header] == [
+        (sd.type, sd.dim) for sd in slot_defs
+    ]
+    assert len(got) == 5
+    for a, b in zip(samples, got):
+        assert a.is_beginning == b.is_beginning
+        np.testing.assert_allclose(a.vector_slots[0].values, b.vector_slots[0].values)
+        assert a.vector_slots[1].ids == b.vector_slots[1].ids
+        assert a.id_slots == b.id_slots
+    assert got[0].subseq_slots[0].slot_id == 1
+    assert got[0].subseq_slots[0].lens == [2, 1]
+
+
+def test_read_reference_shards():
+    """The reference's in-tree binaries parse with the expected schemas
+    (mnist: dense 784 + 10-way label; qb data: 8 word-id slots + binary
+    label, matching the configs' word_dim 1451594)."""
+    from paddle_tpu.data.proto_data import read_shard
+
+    header, samples = read_shard(os.path.join(REF_TESTS, "mnist_bin_part"))
+    assert [(sd.type, sd.dim) for sd in header] == [(0, 784), (3, 10)]
+    assert len(samples) == 1227
+    assert all(len(s.vector_slots[0].values) == 784 for s in samples[:10])
+    assert all(0 <= s.id_slots[0] < 10 for s in samples)
+
+    header, samples = read_shard(os.path.join(REF_TESTS, "data_bin_part"))
+    assert [(sd.type, sd.dim) for sd in header] == [(1, 1451594)] * 8 + [(3, 2)]
+    assert len(samples) == 1000
+
+
+# ---------------------------------------------------------------------------
+# training helpers
+# ---------------------------------------------------------------------------
+
+
+def _train_config(conf_path, max_batches=None, config_args="", num_passes=1):
+    """cmd_train's wiring, programmatic (the test_TrainerOnePass idiom):
+    parse → optimizer → feeder/reader from the config's own DataConfig →
+    train; returns per-pass avg costs."""
+    from paddle_tpu.cli import _make_reader, bind_provider_types
+    from paddle_tpu.config import build_optimizer
+    from paddle_tpu.config.config_parser import parse_config
+    from paddle_tpu.trainer.events import EndPass
+    from paddle_tpu.trainer.trainer import SGDTrainer
+
+    pc = parse_config(conf_path, config_args, emit_proto=False)
+    bundle = build_optimizer(pc.trainer_config.opt_config)
+    costs_out = [l for l in pc.outputs if getattr(l, "is_cost", False)] or pc.outputs
+    extras = [l for l in pc.outputs if l not in costs_out]
+    trainer = SGDTrainer(costs_out, bundle.optimizer, extra_outputs=extras,
+                         schedule=bundle.schedule, seed=7)
+    dc = pc.trainer_config.data_config
+    feeding = bind_provider_types(pc.topology, dc)
+    feeder = pc.topology.make_feeder(feeding)
+    base_reader = _make_reader(dc, pc.trainer_config.opt_config.batch_size or 32)
+    reader = (
+        (lambda: itertools.islice(base_reader(), max_batches))
+        if max_batches
+        else base_reader
+    )
+    costs = []
+    trainer.train(
+        reader,
+        num_passes=num_passes,
+        feeder=feeder,
+        event_handler=lambda e: costs.append(e.metrics["avg_cost"])
+        if isinstance(e, EndPass)
+        else None,
+    )
+    return pc, trainer, costs
+
+
+# ---------------------------------------------------------------------------
+# the trainer corpus trains (not just parses)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mnist_proto_trains_opt_a():
+    """sample_trainer_config_opt_a.conf: unmodified config + the in-tree
+    mnist_bin_part shard train with momentum; cost must drop across passes
+    (test_TrainerOnePass.cpp checkWork idiom)."""
+    pc, _, costs = _train_config(
+        os.path.join(REF_TESTS, "sample_trainer_config_opt_a.conf"),
+        num_passes=3,
+    )
+    assert len(costs) == 3 and all(np.isfinite(costs))
+    assert costs[-1] < costs[0], costs
+    assert costs[0] < 10.0  # ~log(10) + init noise, not garbage
+
+
+@pytest.mark.slow
+def test_mnist_proto_trains_opt_b():
+    pc, _, costs = _train_config(
+        os.path.join(REF_TESTS, "sample_trainer_config_opt_b.conf"),
+        num_passes=2,
+    )
+    assert all(np.isfinite(costs)) and costs[-1] < costs[0]
+
+
+@pytest.mark.slow
+def test_qb_rnn_trains_on_proto_sequence_data():
+    """sample_trainer_config_qb_rnn.conf (raw Layer() API, 1.45M-word
+    embedding, rank cost over left/right towers) trains on the in-tree
+    data_bin_part proto_sequence shard."""
+    pc, _, costs = _train_config(
+        os.path.join(REF_TESTS, "sample_trainer_config_qb_rnn.conf"),
+        max_batches=2,
+    )
+    assert np.isfinite(costs[0]) and 0.0 < costs[0] < 5.0
+
+
+@pytest.mark.slow
+def test_rnn_group_config_matches_flat_recurrent():
+    """test_CompareTwoNets.cpp idiom on the reference's own config pair:
+    sample_trainer_config_rnn.conf builds the recurrence with the raw
+    RecurrentLayerGroupBegin/Memory API, qb_rnn with the flat `recurrent`
+    layer — same parameter names, so with shared weights the costs must
+    match on the same batch."""
+    import itertools as it
+
+    import jax
+
+    from paddle_tpu.cli import _make_reader, bind_provider_types
+    from paddle_tpu.config.config_parser import parse_config
+    from paddle_tpu.nn.graph import Network, reset_name_scope
+
+    reset_name_scope()
+    pa = parse_config(
+        os.path.join(REF_TESTS, "sample_trainer_config_qb_rnn.conf"),
+        emit_proto=False,
+    )
+    reset_name_scope()
+    pb = parse_config(
+        os.path.join(REF_TESTS, "sample_trainer_config_rnn.conf"),
+        emit_proto=False,
+    )
+
+    batches = {}
+    for tag, pc in (("a", pa), ("b", pb)):
+        dc = pc.trainer_config.data_config
+        feeding = bind_provider_types(pc.topology, dc)
+        feeder = pc.topology.make_feeder(feeding)
+        raw = next(it.islice(_make_reader(dc, 10)(), 1))
+        batches[tag] = feeder(raw)
+
+    net_a = Network(pa.outputs)
+    net_b = Network(pb.outputs)
+    params_a, st_a = net_a.init(jax.random.PRNGKey(0), batches["a"])
+    params_b, st_b = net_b.init(jax.random.PRNGKey(1), batches["b"])
+    # identical parameter names by construction (embedding.w0, rnn1.*, ...)
+    shared = {k: params_a[k] if k in params_a else v for k, v in params_b.items()}
+    missing = [k for k in params_b if k not in params_a]
+    assert not missing, f"parameter names diverge: {missing}"
+    out_a, _ = net_a.apply(params_a, st_a, batches["a"])
+    out_b, _ = net_b.apply(shared, st_b, batches["b"])
+    cost_a = float(np.asarray(out_a[pa.outputs[0].name].value))
+    cost_b = float(np.asarray(out_b[pb.outputs[0].name].value))
+    assert cost_a == pytest.approx(cost_b, rel=2e-4), (cost_a, cost_b)
+
+
+@pytest.mark.slow
+def test_compare_sparse_config_trains():
+    """sample_trainer_config_compare_sparse.conf on its own shard
+    (test_CompareSparse.cpp's config; the cross-process half lives in
+    tests/test_distributed.py)."""
+    pc, _, costs = _train_config(
+        os.path.join(REF_TESTS, "sample_trainer_config_compare_sparse.conf"),
+        max_batches=2,
+    )
+    assert np.isfinite(costs[0])
+
+
+# ---------------------------------------------------------------------------
+# chunking end-to-end on generated CoNLL shards
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chunking_conf_e2e(tmp_path):
+    """chunking.conf (raw Layer() API + CRF + ProtoData): generate the
+    train/test shards from the in-tree CoNLL text exactly like
+    gen_proto_data.py, check the feature dim lands on the config's declared
+    4339, then train and eval with the ChunkEvaluator attached."""
+    from paddle_tpu.cli import _make_reader, bind_provider_types
+    from paddle_tpu.config import build_optimizer
+    from paddle_tpu.config.config_parser import parse_config
+    from paddle_tpu.data.datasets.conll_chunking import build_chunking_shards
+    from paddle_tpu.metrics.evaluators import ChunkEvaluator
+    from paddle_tpu.trainer.events import EndIteration
+    from paddle_tpu.trainer.trainer import SGDTrainer
+
+    info = build_chunking_shards(
+        os.path.join(REF_TESTS, "train.txt"),
+        os.path.join(REF_TESTS, "test.txt"),
+        str(tmp_path),
+    )
+    assert info["feature_dim"] == 4339  # chunking.conf's features size
+    assert info["index_dims"][2] == 23  # chunk labels
+
+    pc = parse_config(os.path.join(REF_TESTS, "chunking.conf"), emit_proto=False)
+    # point the unmodified config's relative data paths at the generated dir
+    # (the reference's CMake generates the shards into its run dir too)
+    for dc in (pc.trainer_config.data_config, pc.trainer_config.test_data_config):
+        dc.config_dir = str(tmp_path)
+
+    decoding = pc.topology.network.layers_by_name["crf_decoding"]
+    bundle = build_optimizer(pc.trainer_config.opt_config)
+    trainer = SGDTrainer(
+        pc.outputs, bundle.optimizer, extra_outputs=[decoding],
+        schedule=bundle.schedule, seed=3,
+    )
+    # the conf's own Evaluator("error", type="sum", inputs="crf_decoding")
+    # parsed into the evaluator list
+    assert any(e.type == "sum" for e in pc.context.evaluators)
+    dc = pc.trainer_config.data_config
+    feeding = bind_provider_types(pc.topology, dc)
+    base_feeder = pc.topology.make_feeder(feeding)
+    fed = []
+
+    def feeder(samples):
+        batch = base_feeder(samples)
+        fed.append(batch)
+        return batch
+
+    reader = lambda: itertools.islice(_make_reader(dc, 100)(), 4)  # noqa: E731
+
+    chunk_eval = ChunkEvaluator(scheme="IOB", num_chunk_types=11)
+    chunk_eval.start()
+    costs = []
+
+    def handler(event):
+        if isinstance(event, EndIteration):
+            costs.append(float(event.cost))
+            batch = fed[-1]
+            chunk_eval.update(
+                output=event.metrics["crf_decoding"],
+                label=batch["chunk"],
+                lengths=batch.get("chunk.lengths"),
+            )
+
+    trainer.train(reader, num_passes=2, feeder=feeder, event_handler=handler)
+    f1 = chunk_eval.finish()
+    assert 0.0 <= f1 <= 1.0
+    assert all(np.isfinite(c) for c in costs)
+    # CRF NLL per sequence starts near T*log(23); training must reduce it
+    assert np.mean(costs[-4:]) < np.mean(costs[:4]), costs
