@@ -48,6 +48,16 @@ def _train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
     p.add_argument("--job", default="train", choices=["train", "test", "time"])
     p.add_argument("--num_batches", type=int, default=20, help="--job=time batches")
+    p.add_argument(
+        "--prefetch_depth", type=int, default=2,
+        help="device-resident batches to prefetch ahead of the train step "
+             "(0 disables the async input pipeline)",
+    )
+    p.add_argument(
+        "--compile_cache", default=None,
+        help="persistent XLA compilation cache dir "
+             "(default: $PADDLE_TPU_COMPILE_CACHE, unset = off)",
+    )
 
 
 # Names injected into legacy provider modules: the reference embedded
@@ -253,6 +263,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         log_period=args.log_period,
         seed=args.seed,
         **({"dtype_policy": args.dtype} if args.dtype else {}),
+        **({"compile_cache": args.compile_cache} if args.compile_cache else {}),
     )
 
     pc = parse_config(args.config, args.config_args, emit_proto=False)
@@ -355,8 +366,26 @@ def cmd_train(args: argparse.Namespace) -> int:
         elif ec.type == "max_id_printer":
             kw = dict(num_results=ec.num_results)
         elif ec.type == "seq_text_printer":
-            kw = dict(result_file=ec.result_file or "generated_sequences.txt",
-                      dict_file=ec.dict_file, delimited=ec.delimited)
+            # resolve the config's relative result/dict paths against the
+            # config directory with generation.py's own helper — training
+            # from another cwd must not break dict loading or scatter result
+            # files. Only an explicitly configured result_file follows the
+            # config dir; the fallback stays cwd-relative so a config on a
+            # read-only tree still trains.
+            from paddle_tpu.trainer.generation import _resolve
+
+            base = (bind_dc.config_dir if bind_dc is not None else None) or (
+                os.path.dirname(os.path.abspath(args.config))
+            )
+            kw = dict(
+                result_file=(
+                    _resolve(ec.result_file, base)
+                    if ec.result_file
+                    else "generated_sequences.txt"
+                ),
+                dict_file=_resolve(ec.dict_file, base),
+                delimited=ec.delimited,
+            )
         return EVALUATORS.get(ec.type)(**kw)
 
     active = [
@@ -392,6 +421,16 @@ def cmd_train(args: argparse.Namespace) -> int:
             for k, v in stats.items():
                 line += f" {k}={v}"
             print(line)
+
+    if args.prefetch_depth > 0 and reader is not None:
+        # run the feeder + batch sharding + H2D on a background thread so
+        # host input prep overlaps the donated compiled step
+        from paddle_tpu.data.pipeline import DevicePrefetcher
+
+        reader = DevicePrefetcher(
+            reader, feeder, parallel=parallel,
+            prefetch_depth=args.prefetch_depth,
+        )
 
     trainer.train(
         reader,

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from typing import Any, Dict, Optional
 
 log = logging.getLogger("paddle_tpu")
@@ -30,6 +31,9 @@ class GlobalFlags:
     seed: int = 0
     # Dtype policy name ("float32" | "bfloat16").
     dtype_policy: str = "float32"
+    # Persistent XLA compilation-cache directory ("" = PADDLE_TPU_COMPILE_CACHE
+    # env, which itself defaults to off).
+    compile_cache: str = ""
     extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
@@ -43,6 +47,40 @@ def flags() -> GlobalFlags:
 
 def is_initialized() -> bool:
     return _initialized
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Wire jax's persistent compilation cache to `cache_dir` (or the
+    PADDLE_TPU_COMPILE_CACHE env var). Repeat bench/profiling/test runs then
+    skip XLA compilation for unchanged programs — tracing still happens, but
+    the compile (the dominant cost) is served from disk. Returns the active
+    directory, or None when disabled.
+
+    The min-size/min-compile-time thresholds are zeroed so even the small CPU
+    oracle programs cache; cache entries are keyed on serialized HLO + backend
+    so a stale entry cannot be served for changed code."""
+    cache_dir = cache_dir or os.environ.get("PADDLE_TPU_COMPILE_CACHE")
+    if not cache_dir:
+        return None
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    redirecting = jax.config.jax_compilation_cache_dir != cache_dir
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    if redirecting:
+        # jax latches its cache object (even a None one, if a compile ran
+        # before any dir was configured); any dir change — including
+        # None → dir — needs an explicit reset or the setting is a no-op
+        from jax.experimental.compilation_cache import compilation_cache
+
+        compilation_cache.reset_cache()
+    from paddle_tpu.core import stats
+
+    stats.install_cache_listener()
+    log.info("persistent compilation cache at %s", cache_dir)
+    return cache_dir
 
 
 def init(**kwargs: Any) -> GlobalFlags:
@@ -59,6 +97,7 @@ def init(**kwargs: Any) -> GlobalFlags:
         else:
             _flags.extras[key] = value
     dtypes.set_policy(dtypes.get(_flags.dtype_policy))
+    enable_compilation_cache(_flags.compile_cache or None)
     if not logging.getLogger().handlers:
         logging.basicConfig(
             level=logging.INFO,

@@ -86,6 +86,119 @@ def enable_timers(on: bool = True) -> None:
     GLOBAL_STATS.enabled = on
 
 
+# -- recompile / input-pipeline telemetry ------------------------------------
+#
+# Every distinct batch-shape signature traces and compiles the jitted step
+# again (SURVEY §7 hard-part (2): XLA recompiles per shape). The trainer
+# records one signature per batch; the counter exposes per-pass and all-time
+# distinct counts and warns once when shape churn crosses a threshold —
+# the usual culprit is a missing/too-fine `seq_bucket` on a sequence slot.
+
+
+def batch_signature(batch) -> tuple:
+    """Hashable shape/dtype signature of a feed-ready batch dict — the same
+    information XLA keys its compiled-executable cache on."""
+    import numpy as np
+
+    return tuple(
+        sorted(
+            (k, tuple(np.shape(v)), str(getattr(v, "dtype", type(v).__name__)))
+            for k, v in batch.items()
+        )
+    )
+
+
+class RecompileStats:
+    """Counts distinct batch-shape signatures (== step recompiles) plus
+    persistent-compilation-cache hits/misses reported by jax.monitoring."""
+
+    def __init__(self, warn_threshold: int = 0):
+        self._lock = threading.Lock()
+        self._all: set = set()
+        self._pass: set = set()
+        self._warned = False
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.warn_threshold = warn_threshold or int(
+            os.environ.get("PADDLE_TPU_SHAPE_WARN", "8")
+        )
+
+    def record(self, signature: tuple) -> bool:
+        """Record one batch signature; True when it is new this pass (i.e.
+        the compiled step for it was not yet built this pass)."""
+        with self._lock:
+            new = signature not in self._pass
+            self._pass.add(signature)
+            self._all.add(signature)
+            n = len(self._pass)
+            should_warn = (
+                new and not self._warned and n == self.warn_threshold
+            )
+            if should_warn:
+                self._warned = True
+        if should_warn:
+            import logging
+
+            logging.getLogger("paddle_tpu.stats").warning(
+                "input pipeline produced %d distinct batch shapes this pass; "
+                "each one recompiles the train step — check seq_bucket / "
+                "batch-size settings for shape churn", n,
+            )
+        return new
+
+    def start_pass(self) -> None:
+        with self._lock:
+            self._pass = set()
+
+    def pass_signatures(self) -> int:
+        with self._lock:
+            return len(self._pass)
+
+    def total_signatures(self) -> int:
+        with self._lock:
+            return len(self._all)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._all = set()
+            self._pass = set()
+            self._warned = False
+            self.cache_hits = 0
+            self.cache_misses = 0
+
+    def report(self) -> str:
+        return (
+            f"shape signatures: pass={self.pass_signatures()} "
+            f"total={self.total_signatures()} "
+            f"persistent-cache hits={self.cache_hits} "
+            f"misses={self.cache_misses}"
+        )
+
+
+RECOMPILES = RecompileStats()
+
+_cache_listener_installed = False
+
+
+def install_cache_listener() -> None:
+    """Count persistent-compilation-cache hits/misses into RECOMPILES via
+    jax.monitoring (events /jax/compilation_cache/cache_hits|cache_misses).
+    Idempotent; importing jax here is fine — callers already run under it."""
+    global _cache_listener_installed
+    if _cache_listener_installed:
+        return
+    import jax
+
+    def _on_event(event: str, **_kw) -> None:
+        if event.endswith("/cache_hits"):
+            RECOMPILES.cache_hits += 1
+        elif event.endswith("/cache_misses"):
+            RECOMPILES.cache_misses += 1
+
+    jax.monitoring.register_event_listener(_on_event)
+    _cache_listener_installed = True
+
+
 @contextlib.contextmanager
 def timer(name: str) -> Iterator[None]:
     """REGISTER_TIMER_INFO analog: `with timer("forwardBackward"): ...`."""

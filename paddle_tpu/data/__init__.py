@@ -1,4 +1,5 @@
 from paddle_tpu.data import reader as reader  # noqa: F401
+from paddle_tpu.data.pipeline import DevicePrefetcher, is_device_batch  # noqa: F401
 from paddle_tpu.data.feeder import DataFeeder, InputSpec  # noqa: F401
 from paddle_tpu.data.feeder import dense_vector, integer_value  # noqa: F401
 from paddle_tpu.data.feeder import dense_array, integer_value_sequence  # noqa: F401

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import gzip
 import os
+import random
 import struct
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -81,12 +82,25 @@ def _iter_fields(buf: memoryview) -> Iterator[Tuple[int, int, Any]]:
             yield fnum, wire, v
         elif wire == 2:
             n, pos = _read_varint(buf, pos)
+            if pos + n > end:
+                raise ValueError(
+                    f"truncated length-delimited field {fnum}: need {n} "
+                    f"bytes, {end - pos} left"
+                )
             yield fnum, wire, buf[pos : pos + n]
             pos += n
         elif wire == 5:
+            if pos + 4 > end:
+                raise ValueError(
+                    f"truncated fixed32 field {fnum}: {end - pos} bytes left"
+                )
             yield fnum, wire, struct.unpack_from("<I", buf, pos)[0]
             pos += 4
         elif wire == 1:
+            if pos + 8 > end:
+                raise ValueError(
+                    f"truncated fixed64 field {fnum}: {end - pos} bytes left"
+                )
             yield fnum, wire, struct.unpack_from("<Q", buf, pos)[0]
             pos += 8
         else:
@@ -207,20 +221,32 @@ def parse_sample(buf: memoryview) -> DataSample:
 
 def read_shard(path: str) -> Tuple[List[SlotDef], List[DataSample]]:
     """One shard file → (slot_defs, samples). `.gz` handled like the
-    reference (ProtoReader GzipInputStream)."""
+    reference (ProtoReader GzipInputStream). A truncated or corrupt shard
+    raises ValueError naming the file — the reference's ProtoReader fails on
+    ParseFromZeroCopyStream too, rather than training on partial samples."""
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         raw = f.read()
     buf = memoryview(raw)
-    pos = 0
-    n, pos = _read_varint(buf, pos)
-    header = parse_header(buf[pos : pos + n])
-    pos += n
-    samples: List[DataSample] = []
-    while pos < len(buf):
+    try:
+        pos = 0
         n, pos = _read_varint(buf, pos)
-        samples.append(parse_sample(buf[pos : pos + n]))
+        if pos + n > len(buf):
+            raise ValueError("truncated header")
+        header = parse_header(buf[pos : pos + n])
         pos += n
+        samples: List[DataSample] = []
+        while pos < len(buf):
+            n, pos = _read_varint(buf, pos)
+            if pos + n > len(buf):
+                raise ValueError(
+                    f"truncated sample {len(samples)}: need {n} bytes, "
+                    f"{len(buf) - pos} left"
+                )
+            samples.append(parse_sample(buf[pos : pos + n]))
+            pos += n
+    except (ValueError, struct.error, IndexError) as e:
+        raise ValueError(f"corrupt proto data shard {path!r}: {e}") from e
     return header, samples
 
 
@@ -309,11 +335,14 @@ def write_shard(
 # ---------------------------------------------------------------------------
 
 
-def resolve_data_path(path: str, config_dir: str) -> Optional[str]:
+def resolve_data_path(path: Optional[str], config_dir: str) -> Optional[str]:
     """The reference resolves data paths against its run directory; configs
     name them relative to the source root (e.g. 'trainer/tests/x'). Try the
     path itself, then the config dir and its ancestors. None when nothing
-    exists. Shared by the shard loader and the cli's file-list resolution."""
+    exists (or when no path was configured — DataConfig.files defaults to
+    None). Shared by the shard loader and the cli's file-list resolution."""
+    if not path:
+        return None
     cands = [path]
     d = config_dir
     for _ in range(4):
@@ -342,12 +371,14 @@ class ProtoProvider:
 
     can_over_batch_size = True
 
-    def __init__(self, seq_mode: bool, config_dir: str = ""):
+    def __init__(self, seq_mode: bool, config_dir: str = "", seed: int = 0):
         self.seq_mode = seq_mode
         self.config_dir = config_dir
+        self.seed = seed
         self._slot_defs: Optional[List[SlotDef]] = None
         self._sequences: Optional[List[List[DataSample]]] = None
         self._iid = True
+        self._epoch = 0  # reshuffles differently each training pass
 
     # -- loading ------------------------------------------------------------
     def _load(self, file_list: Sequence[str]) -> None:
@@ -370,7 +401,10 @@ class ProtoProvider:
                     seq_starts.append(len(samples))
                 samples.append(s)
         if slot_defs is None:
-            raise ValueError("no proto data shards given")
+            raise ValueError(
+                "no proto data shards given — is DataConfig.files set and "
+                "resolvable from the config directory?"
+            )
         self._slot_defs = slot_defs
         self._iid = len(seq_starts) == len(samples)
         seq_starts.append(len(samples))
@@ -456,7 +490,17 @@ class ProtoProvider:
     def __call__(self, obj=None, file_list=None, is_train=True, **_kw):
         self._load(file_list or ())
         assert self._sequences is not None
-        for seq in self._sequences:
+        sequences = self._sequences
+        if is_train:
+            # ProtoDataProvider::reset() shuffles sequence order every
+            # training pass (ProtoDataProvider.cpp:372-385); seeded per pass
+            # so runs stay reproducible. Test/generation readers keep file
+            # order. Shuffles a copy — the loaded corpus stays pristine.
+            self._epoch += 1
+            rnd = random.Random(self.seed * 1000003 + self._epoch)
+            sequences = list(sequences)
+            rnd.shuffle(sequences)
+        for seq in sequences:
             if self.seq_mode:
                 # each sample is one sequence: token ids per sparse slot,
                 # one label per INDEX slot (an empty token slot yields the
@@ -480,6 +524,11 @@ class ProtoProvider:
 
 
 def make_proto_provider(dc) -> ProtoProvider:
-    """DataConfig (type proto / proto_sequence / *_group) → builtin provider."""
+    """DataConfig (type proto / proto_sequence / *_group) → builtin provider.
+    The per-pass shuffle seed follows the global --seed flag."""
+    from paddle_tpu.core.init_ctx import flags
+
     seq_mode = "sequence" in (dc.type or "")
-    return ProtoProvider(seq_mode, config_dir=dc.config_dir or "")
+    return ProtoProvider(
+        seq_mode, config_dir=dc.config_dir or "", seed=flags().seed
+    )

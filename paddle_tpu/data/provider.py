@@ -15,9 +15,7 @@ from __future__ import annotations
 
 import functools
 import logging
-import queue
 import random
-import threading
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
@@ -252,8 +250,6 @@ class MultiDataProvider:
 # DoubleBuffer: background prefetch of converted batches
 # ---------------------------------------------------------------------------
 
-_STOP = object()
-
 
 class DoubleBuffer:
     """Async batch prefetcher (DataProvider.h:249).
@@ -261,7 +257,12 @@ class DoubleBuffer:
     Wraps a batched reader (+ optional feeder) and keeps up to `capacity`
     ready-to-feed batches in a background thread, so numpy conversion overlaps
     device execution. Use as: `for batch in DoubleBuffer(reader, feeder): ...`;
-    one iteration = one pass."""
+    one iteration = one pass.
+
+    Host-side only: batches still pay sharding + H2D on the consumer.
+    `data.pipeline.DevicePrefetcher` subsumes this (feeder AND device
+    placement off-thread); a DoubleBuffer also composes as the reader of a
+    DevicePrefetcher, which then adds just the device leg."""
 
     def __init__(self, reader: Callable, feeder: Optional[DataFeeder] = None, capacity: int = 4):
         self.reader = reader
@@ -272,43 +273,13 @@ class DoubleBuffer:
         return iter(self)
 
     def __iter__(self):
-        q: "queue.Queue" = queue.Queue(maxsize=self.capacity)
-        err: List[BaseException] = []
-        stop = threading.Event()
+        from paddle_tpu.data.pipeline import iter_async
 
-        def put(item) -> bool:
-            # bounded put that notices consumer abandonment (GeneratorExit)
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def work():
-            try:
-                for raw in self.reader():
-                    if not put(self.feeder(raw) if self.feeder is not None else raw):
-                        return
-            except BaseException as e:  # surface worker errors to the consumer
-                err.append(e)
-            finally:
-                put(_STOP)
-
-        t = threading.Thread(target=work, daemon=True, name="paddle-tpu-double-buffer")
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _STOP:
-                    break
-                yield item
-            t.join()
-            if err:
-                raise err[0]
-        finally:
-            stop.set()  # unblock and retire the producer on early exit
+        prepare = self.feeder if self.feeder is not None else (lambda raw: raw)
+        return iter_async(
+            self.reader, prepare, self.capacity,
+            name="paddle-tpu-double-buffer",
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -611,6 +611,12 @@ class SequenceTextPrinter(Evaluator):
             all_scores = np.asarray(beam["scores"])
             all_lens = np.asarray(beam["lengths"])
             n = len(all_hist)
+        elif values is None:
+            raise ValueError(
+                "SequenceTextPrinter.update needs `output` ids or a beam "
+                "payload with history/lengths; got neither — is the "
+                "evaluator's input layer among the network outputs?"
+            )
         else:
             n = len(values)
         ids_flat = (

@@ -75,6 +75,16 @@ class DataParallel:
     def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         return self._put(batch, self._batch_sharding)
 
+    def is_sharded_batch(self, batch: Dict[str, Any]) -> bool:
+        """True when every slot already carries this plan's batch sharding —
+        the trainer's device-batch fast path must not skip shard_batch for
+        arrays that merely live on the default device."""
+        return all(
+            isinstance(v, jax.Array)
+            and v.sharding.is_equivalent_to(self._batch_sharding, v.ndim)
+            for v in batch.values()
+        )
+
     def shard_batches(self, batches: Dict[str, Any]) -> Dict[str, Any]:
         """Shard a K-stacked batch dict ([K, B, ...] per slot) for the
         multi-step scan driver: the scan axis stays unsharded, batch axis 1

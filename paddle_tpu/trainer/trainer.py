@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import stats
+from paddle_tpu.data.pipeline import coerce_batch as _coerce_batch
+from paddle_tpu.data.pipeline import is_device_batch
 from paddle_tpu.nn.graph import Argument, Layer, Network
 from paddle_tpu.optim.optimizers import Optimizer
 from paddle_tpu.optim.average import ModelAverage
@@ -225,17 +227,30 @@ class SGDTrainer:
         for pass_id in range(num_passes):
             event_handler(BeginPass(pass_id))
             self.updater.start_pass()
+            stats.RECOMPILES.start_pass()
             t0 = time.time()
             cost_sum_dev, n_batches = None, 0
             for batch_id, raw in enumerate(reader()):
-                # dict batches are already feed-ready (e.g. from a DoubleBuffer
-                # that ran the feeder on its prefetch thread)
-                batch = (
-                    feeder(raw)
-                    if feeder is not None and not isinstance(raw, dict)
-                    else _coerce_batch(raw)
+                # device batches (from a DevicePrefetcher) arrive fed, sharded
+                # and resident — skip the whole host prep leg; dict batches
+                # are already feed-ready (e.g. from a DoubleBuffer that ran
+                # the feeder on its prefetch thread). Under DataParallel the
+                # fast path additionally requires the mesh batch sharding —
+                # device-resident but unsharded arrays still go through
+                # shard_batch below.
+                on_device = is_device_batch(raw) and (
+                    self.parallel is None or self.parallel.is_sharded_batch(raw)
                 )
-                if self.parallel is not None:
+                if on_device:
+                    batch = raw  # hostFeed/h2d were stamped by the prefetcher
+                else:
+                    with stats.timer("hostFeed"):
+                        batch = (
+                            feeder(raw)
+                            if feeder is not None and not isinstance(raw, dict)
+                            else _coerce_batch(raw)
+                        )
+                if self.parallel is not None and not on_device:
                     if not self.parallel.batch_divisible(batch):
                         # trailing partial batch not divisible by the mesh data
                         # axis — skip it (drop_last semantics), like the
@@ -245,16 +260,23 @@ class SGDTrainer:
                             "data axis", batch_id,
                         )
                         continue
-                    batch = self.parallel.shard_batch(batch)
+                    with stats.timer("h2d"):
+                        batch = self.parallel.shard_batch(batch)
                 if self.state is None:
                     self.init_state(batch)
                 if self._step_fn is None:
                     self._step_fn = self._make_step()
+                # one distinct signature = one XLA trace+compile of the step;
+                # churn past the threshold warns (misconfigured seq_buckets)
+                stats.RECOMPILES.record(stats.batch_signature(batch))
                 event_handler(BeginIteration(pass_id, batch_id))
                 # REGISTER_TIMER_INFO("forwardBackward") parity
                 # (TrainerInternal.cpp:94-152); enable via PADDLE_TPU_TIMER.
                 # Timing is opt-in, so when enabled we sync the device inside
                 # the timer — otherwise it would measure only async dispatch.
+                # "forwardBackward" is the device-step segment; with the
+                # "hostFeed"/"h2d" timers above it gives the input-pipeline
+                # occupancy split without a chip profiler.
                 with stats.timer("forwardBackward"):
                     self.state, cost, extras = self._step_fn(self.state, batch)
                     if stats.GLOBAL_STATS.enabled:
@@ -276,7 +298,12 @@ class SGDTrainer:
                 ),
                 "batches": n_batches,
                 "pass_seconds": time.time() - t0,
+                "shape_signatures": stats.RECOMPILES.pass_signatures(),
             }
+            if stats.GLOBAL_STATS.enabled:
+                log.info(
+                    "pass %d %s", pass_id, stats.RECOMPILES.report()
+                )
             self.updater.finish_pass()
             if test_reader is not None:
                 metrics["test_cost"] = self.test(test_reader, feeder)["cost"]
@@ -292,12 +319,17 @@ class SGDTrainer:
             self._eval_fn = self._make_eval()
         total, n = 0.0, 0
         for raw in reader():
+            on_device = is_device_batch(raw) and (
+                self.parallel is None or self.parallel.is_sharded_batch(raw)
+            )
             batch = (
-                feeder(raw)
+                raw
+                if on_device
+                else feeder(raw)
                 if feeder is not None and not isinstance(raw, dict)
                 else _coerce_batch(raw)
             )
-            if self.parallel is not None:
+            if self.parallel is not None and not on_device:
                 batch = self.parallel.shard_batch(batch)
             cost, _ = self._eval_fn(self.state, batch)
             bs = _batch_size(batch)
@@ -349,24 +381,6 @@ class SGDTrainer:
             # re-establish mesh placement (sharded head weights, replicated
             # slots) — plain asarray loads land unsharded otherwise
             self.state = self.parallel.shard_state(self.state)
-
-
-def _coerce_batch(batch: Dict[str, Any]) -> Dict[str, Any]:
-    """Make a dict batch feed-ready, failing fast on ragged/object slots
-    instead of letting the jitted step produce an opaque shape error."""
-    out: Dict[str, Any] = {}
-    for k, v in batch.items():
-        if isinstance(v, (np.ndarray, jax.Array)):
-            out[k] = v
-            continue
-        arr = np.asarray(v)
-        if arr.dtype == object:
-            raise ValueError(
-                f"batch slot {k!r} is ragged or non-numeric; feed it through a "
-                f"DataFeeder (which pads sequences) instead of a raw dict"
-            )
-        out[k] = arr
-    return out
 
 
 def _batch_size(batch: Dict[str, Any]) -> int:

@@ -22,8 +22,35 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the suite: point PADDLE_TPU_COMPILE_CACHE
+# at a durable dir to make repeat runs skip compilation entirely (the suite is
+# compile-dominated); unset, a per-run temp dir still dedups identical programs
+# within the run. Hit/miss counts print at session end (see
+# pytest_terminal_summary) so shape-churn suite-time regressions are visible.
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+from paddle_tpu.core import stats as _stats  # noqa: E402
+from paddle_tpu.core.init_ctx import enable_compilation_cache  # noqa: E402
+
+_cache_dir = os.environ.get("PADDLE_TPU_COMPILE_CACHE")
+if not _cache_dir:  # per-run temp dir: in-run dedup only, removed on exit
+    _cache_dir = tempfile.mkdtemp(prefix="paddle_tpu_xla_cache_")
+    atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
+_cache_dir = enable_compilation_cache(_cache_dir)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_terminal_summary(terminalreporter):
+    terminalreporter.write_line(
+        f"paddle_tpu compile cache [{_cache_dir}]: "
+        f"hits={_stats.RECOMPILES.cache_hits} "
+        f"misses={_stats.RECOMPILES.cache_misses} "
+        f"distinct step shapes={_stats.RECOMPILES.total_signatures()}"
+    )
 
 
 @pytest.fixture

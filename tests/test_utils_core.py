@@ -130,3 +130,19 @@ def test_value_printer_evaluator():
     ev.update(output=np.ones((2, 3)))
     assert ev.finish() == 1.0
     assert lines and "value_printer" in lines[0] and "(2, 3)" in lines[0]
+
+
+def test_seq_text_printer_rejects_missing_payload(tmp_path):
+    """update() with neither output ids nor a usable beam payload must raise
+    a clear ValueError, not TypeError on len(None)."""
+    from paddle_tpu.metrics.evaluators import SequenceTextPrinter
+
+    printer = SequenceTextPrinter(result_file=str(tmp_path / "out.txt"))
+    printer.start()
+    try:
+        with pytest.raises(ValueError, match="neither"):
+            printer.update()
+        with pytest.raises(ValueError, match="neither"):
+            printer.update(beam=None, output=None)
+    finally:
+        printer.finish()
