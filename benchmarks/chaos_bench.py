@@ -1,0 +1,124 @@
+"""Chaos benchmark: training throughput under injected faults.
+
+Measures steps/sec for the same toy workload three ways — clean, under an
+input-side fault mix (flaky feeder + slowed H2D), and with periodic NaN
+batches absorbed by the skip_batch divergence guard — all through the seeded
+injector in paddle_tpu/core/faults.py, so a run is reproducible bit-for-bit.
+The interesting number is the ratio: how much throughput the fault-tolerance
+machinery (retries, guard sync, watchdog) costs when faults actually happen,
+and (via --faults "") what the guard alone costs when they never do.
+
+Usage:
+  JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py [--faults SPEC] [--seed N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_FAULTS = "feeder_raise:0.05,h2d_delay:2ms"
+
+
+def build_trainer(args, policy=None):
+    from paddle_tpu.nn import costs as C
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn.graph import reset_name_scope
+    from paddle_tpu.optim import SGD
+    from paddle_tpu.trainer import SGDTrainer
+
+    reset_name_scope()
+    x = L.Data("x", shape=(args.dim,))
+    lbl = L.Data("label", shape=())
+    h = L.Fc(x, args.hidden, act="relu")
+    logits = L.Fc(h, args.classes, act=None)
+    cost = C.ClassificationCost(logits, lbl)
+    return SGDTrainer(
+        cost, SGD(learning_rate=0.01), seed=0, divergence_policy=policy
+    )
+
+
+def run_mode(args, spec: str, policy=None) -> dict:
+    """steps/sec over the timed (second) pass; first pass compiles."""
+    import numpy as np
+
+    from paddle_tpu.core import faults, stats
+    from paddle_tpu.data.feeder import DataFeeder, dense_vector, integer_value
+    from paddle_tpu.data.pipeline import DevicePrefetcher
+    from paddle_tpu.trainer import EndPass
+
+    rs = np.random.RandomState(0)
+    raws = [
+        [
+            (rs.randn(args.dim).astype(np.float32), int(i % args.classes))
+            for i in range(args.batch_size)
+        ]
+        for _ in range(args.batches)
+    ]
+    feeder = DataFeeder(
+        {"x": dense_vector(args.dim), "label": integer_value(args.classes)}
+    )
+    reader = DevicePrefetcher(
+        lambda: iter(raws), feeder, prefetch_depth=2, feed_retries=3
+    )
+    trainer = build_trainer(args, policy=policy)
+    pass_stats = []
+    stats.FT_EVENTS.reset()
+    with faults.inject(spec, seed=args.seed) as inj:
+        trainer.train(
+            reader, num_passes=2, feeder=feeder,
+            event_handler=lambda e: pass_stats.append(e.metrics)
+            if isinstance(e, EndPass) else None,
+        )
+        fired = dict(inj.fired)
+    m = pass_stats[-1]
+    return {
+        "steps_per_sec": round(m["batches"] / m["pass_seconds"], 2),
+        "faults_fired": fired,
+        "divergence_events": m["divergence_events"],
+        "ft_events": stats.FT_EVENTS.as_dict(),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--faults", default=DEFAULT_FAULTS,
+                    help="input-side fault mix for the chaos mode")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--batches", type=int, default=50)
+    ap.add_argument("--batch_size", type=int, default=256)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--classes", type=int, default=8)
+    ap.add_argument("--nan_every", type=int, default=10,
+                    help="guard mode poisons every Nth batch (via probability "
+                         "1/N) to exercise skip_batch under load")
+    args = ap.parse_args()
+
+    import jax
+
+    clean = run_mode(args, spec="")
+    chaos = run_mode(args, spec=args.faults)
+    guard = run_mode(
+        args, spec=f"nan_loss:{1.0 / args.nan_every}", policy="skip_batch"
+    )
+    print(json.dumps({
+        "metric": "chaos_throughput_retention",
+        "value": round(chaos["steps_per_sec"] / clean["steps_per_sec"], 3),
+        "unit": "x",
+        "clean": clean,
+        "input_faults": {"spec": args.faults, **chaos},
+        "nan_guard": {"spec": f"nan_loss:{1.0 / args.nan_every}", **guard},
+        "seed": args.seed,
+        "batches": args.batches,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,8 @@ Usage:
     python -m paddle_tpu train --config=conf.py [--config_args=k=v,...]
         [--num_passes=N] [--save_dir=DIR] [--trainer_count=N] [--use_tpu=1]
         [--init_model_path=DIR] [--start_pass=N] [--log_period=N] [--job=train|test|time]
+        [--auto_resume=1] [--divergence_policy=skip_batch|rollback|raise]
+        [--keep_last_n=N] [--faults=SPEC]
     python -m paddle_tpu dump_config --config=conf.py
     python -m paddle_tpu merge_model --config=conf.py --model_dir=DIR --output=FILE
     python -m paddle_tpu version
@@ -57,6 +59,27 @@ def _train_args(p: argparse.ArgumentParser) -> None:
         "--compile_cache", default=None,
         help="persistent XLA compilation cache dir "
              "(default: $PADDLE_TPU_COMPILE_CACHE, unset = off)",
+    )
+    p.add_argument(
+        "--auto_resume", type=_str2bool, default=False,
+        help="on startup, resume from the newest CRC-valid checkpoint under "
+             "--save_dir (corrupt/partial pass dirs are skipped)",
+    )
+    p.add_argument(
+        "--divergence_policy", default=None,
+        choices=["skip_batch", "rollback", "raise"],
+        help="react to a NaN/Inf step cost: skip the batch, roll back to the "
+             "last checkpoint with the LR halved, or raise (default: guard off)",
+    )
+    p.add_argument(
+        "--keep_last_n", type=int, default=0,
+        help="retain only the newest N pass checkpoints under --save_dir "
+             "(0 = keep all)",
+    )
+    p.add_argument(
+        "--faults", default=None,
+        help="chaos-injection spec, e.g. 'feeder_raise:0.01,nan_loss:step=37' "
+             "(overrides $PADDLE_TPU_FAULTS; see paddle_tpu/core/faults.py)",
     )
 
 
@@ -266,6 +289,11 @@ def cmd_train(args: argparse.Namespace) -> int:
         **({"compile_cache": args.compile_cache} if args.compile_cache else {}),
     )
 
+    if args.faults:
+        from paddle_tpu.core import faults
+
+        faults.get().configure(args.faults)
+
     pc = parse_config(args.config, args.config_args, emit_proto=False)
     oc = pc.trainer_config.opt_config
     bundle = build_optimizer(oc)
@@ -305,6 +333,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         model_average=bundle.model_average,
         parallel=parallel,
         seed=args.seed,
+        divergence_policy=args.divergence_policy,
     )
     batch_size = oc.batch_size or 32
 
@@ -440,6 +469,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         test_reader=test_reader,
         save_dir=args.save_dir,
         log_period=args.log_period,
+        auto_resume=args.auto_resume,
+        keep_last_n=args.keep_last_n or None,
     )
     return 0
 

@@ -86,6 +86,39 @@ def enable_timers(on: bool = True) -> None:
     GLOBAL_STATS.enabled = on
 
 
+class EventCounter:
+    """Thread-safe named counters for rare-but-load-bearing runtime events
+    (divergence guard trips, feeder retries, pipeline stalls, master
+    reconnects). Unlike Stat these are unconditional — failure telemetry must
+    not hide behind PADDLE_TPU_TIMER."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def incr(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+            return self._counts[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def as_dict(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+# fault-tolerance event counters (trainer divergence guard, pipeline
+# retries/stalls, master client reconnects)
+FT_EVENTS = EventCounter()
+
+
 # -- recompile / input-pipeline telemetry ------------------------------------
 #
 # Every distinct batch-shape signature traces and compiles the jitted step

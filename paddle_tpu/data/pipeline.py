@@ -20,14 +20,16 @@ batches — including a `DoubleBuffer` — and then only adds the device leg.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
 
-from paddle_tpu.core import stats
+from paddle_tpu.core import faults, stats
 
 log = logging.getLogger("paddle_tpu.pipeline")
 
@@ -40,13 +42,30 @@ def iter_async(
     prepare: Callable[[Any], Any],
     capacity: int,
     name: str = "paddle-tpu-async-producer",
+    retries: int = 0,
+    stall_warn_s: Optional[float] = None,
 ):
     """Shared background-producer loop (DoubleBuffer + DevicePrefetcher):
     a worker thread runs `prepare(raw)` over `reader()` and keeps up to
     `capacity` results ahead of the consumer. Items come out in reader
     order; `prepare` returning SKIP drops the item; worker exceptions
-    re-raise in the consumer; abandoning the generator (break/GeneratorExit)
-    retires the worker via the bounded put's stop poll."""
+    re-raise in the consumer with the worker's original traceback attached;
+    abandoning the generator (break/GeneratorExit) retires the worker via
+    the bounded put's stop poll.
+
+    retries: transient `prepare` exceptions (flaky storage, a hiccuping
+    remote feeder) are retried that many times on the same item — with a
+    short growing backoff — before the error propagates. reader() errors are
+    never retried: the iterator's position is gone.
+
+    stall_warn_s (default $PADDLE_TPU_STALL_WARN_S or 30; <= 0 disables):
+    the consumer logs a warning whenever it has been starved that long
+    waiting on the producer — the watchdog that distinguishes "feeder
+    wedged" from "training slow"."""
+    if stall_warn_s is None:
+        stall_warn_s = float(os.environ.get("PADDLE_TPU_STALL_WARN_S", "30"))
+    if stall_warn_s <= 0:  # disabled: plain blocking get, no watchdog
+        stall_warn_s = None
     q: "queue.Queue" = queue.Queue(maxsize=capacity)
     err: List[BaseException] = []
     stop = threading.Event()
@@ -61,10 +80,25 @@ def iter_async(
                 continue
         return False
 
+    def prepare_with_retry(raw):
+        for attempt in range(retries + 1):
+            try:
+                faults.get().maybe_raise("feeder_raise")  # chaos hook
+                return prepare(raw)
+            except Exception as e:
+                if attempt >= retries:
+                    raise
+                stats.FT_EVENTS.incr("feeder_retry")
+                log.warning(
+                    "%s: prepare failed (%s: %s) — retry %d/%d",
+                    name, type(e).__name__, e, attempt + 1, retries,
+                )
+                time.sleep(min(0.05 * 2 ** attempt, 1.0))
+
     def work():
         try:
             for raw in reader():
-                item = prepare(raw)
+                item = prepare_with_retry(raw)
                 if item is SKIP:
                     continue
                 if not put(item):
@@ -78,12 +112,24 @@ def iter_async(
     t.start()
     try:
         while True:
-            item = q.get()
+            try:
+                item = q.get(timeout=stall_warn_s)
+            except queue.Empty:  # starved, not done: watchdog, then keep waiting
+                stats.FT_EVENTS.incr("pipeline_stall")
+                log.warning(
+                    "%s: consumer starved for > %.1fs waiting on the producer "
+                    "thread (feeder wedged or reader stalled?)",
+                    name, stall_warn_s,
+                )
+                continue
             if item is _STOP:
                 break
             yield item
         t.join()
         if err:
+            # the exception object still carries the worker's traceback, so
+            # the failing feeder frame surfaces here, not just this loop
+            # (locked in by test_worker_traceback_reaches_consumer)
             raise err[0]
     finally:
         stop.set()  # unblock and retire the producer on early exit
@@ -132,6 +178,9 @@ class DevicePrefetcher:
         flight counting the one the consumer holds). 2 hides a feeder that is
         as slow as the step; deeper only buys burst tolerance at the cost of
         device memory.
+    feed_retries: transient worker exceptions (feeder/coerce/H2D) are retried
+        this many times per batch before propagating (see iter_async);
+        deterministic feeder bugs still surface — they just fail every retry.
 
     One iteration = one pass. Worker exceptions surface in the consumer;
     abandoning the iterator (break / GeneratorExit) retires the worker.
@@ -148,6 +197,7 @@ class DevicePrefetcher:
         parallel: Optional[Any] = None,
         prefetch_depth: int = 2,
         device: Optional[Any] = None,
+        feed_retries: int = 2,
     ):
         if prefetch_depth < 1:
             raise ValueError(f"prefetch_depth must be >= 1, got {prefetch_depth}")
@@ -156,6 +206,7 @@ class DevicePrefetcher:
         self.parallel = parallel
         self.prefetch_depth = prefetch_depth
         self.device = device
+        self.feed_retries = feed_retries
 
     def __call__(self):
         return iter(self)
@@ -169,6 +220,7 @@ class DevicePrefetcher:
                 else coerce_batch(raw)
             )
         with stats.timer("h2d"):
+            faults.get().sleep("h2d_delay")  # chaos hook: slow transfer leg
             if self.parallel is not None:
                 if not self.parallel.batch_divisible(batch):
                     log.warning(
@@ -184,5 +236,5 @@ class DevicePrefetcher:
     def __iter__(self):
         return iter_async(
             self.reader, self._prepare, self.prefetch_depth,
-            name="paddle-tpu-device-prefetch",
+            name="paddle-tpu-device-prefetch", retries=self.feed_retries,
         )

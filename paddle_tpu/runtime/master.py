@@ -12,15 +12,20 @@ from __future__ import annotations
 
 import ctypes as C
 import json
+import logging
 import os
+import random
 import socket
 import socketserver
 import threading
 import time
 from typing import Any, Callable, Iterator, List, Optional, Sequence
 
+from paddle_tpu.core import faults, stats
 from paddle_tpu.runtime import native
 from paddle_tpu.runtime import recordio
+
+log = logging.getLogger("paddle_tpu.master")
 
 
 class TaskMaster:
@@ -118,6 +123,11 @@ class _Handler(socketserver.StreamRequestHandler):
                 self._reply({"err": "bad json"})
                 continue
             method = req.get("method")
+            if faults.get().fire("master_drop"):
+                # chaos hook: the RPC vanishes in transit — drop the
+                # connection without processing or replying; the client's
+                # reconnect/backoff path has to absorb it
+                return
             with lock:
                 if method == "get_task":
                     got = master.get_task()
@@ -133,8 +143,16 @@ class _Handler(socketserver.StreamRequestHandler):
                     if snapshot_path:
                         try:
                             master.snapshot(snapshot_path)
-                        except OSError:
-                            pass
+                        except OSError as e:
+                            # progress was acked to the trainer but NOT made
+                            # durable — a crash now replays this task; say so
+                            # instead of silently losing recovery fidelity
+                            self.server.snapshot_failures += 1  # type: ignore[attr-defined]
+                            log.warning(
+                                "master snapshot to %s failed (%s); a crash "
+                                "before the next successful snapshot will "
+                                "re-dispatch acked tasks", snapshot_path, e,
+                            )
                 elif method == "task_failed":
                     resp = {"ok": master.task_failed(int(req["task_id"]))}
                 elif method == "set_dataset":
@@ -150,6 +168,9 @@ class _Handler(socketserver.StreamRequestHandler):
                     }
                 elif method == "stats":
                     resp = master.stats()
+                    resp["snapshot_failures"] = (
+                        self.server.snapshot_failures  # type: ignore[attr-defined]
+                    )
                 else:
                     resp = {"err": f"unknown method {method!r}"}
             self._reply(resp)
@@ -178,6 +199,7 @@ class MasterServer:
         self._srv.master = self.master  # type: ignore[attr-defined]
         self._srv.master_lock = threading.Lock()  # type: ignore[attr-defined]
         self._srv.snapshot_path = snapshot_path  # type: ignore[attr-defined]
+        self._srv.snapshot_failures = 0  # type: ignore[attr-defined]
         if snapshot_path and os.path.exists(snapshot_path):
             self.master.restore(snapshot_path)  # crash recovery (service.go:166)
         self._thread: Optional[threading.Thread] = None
@@ -185,6 +207,10 @@ class MasterServer:
     @property
     def address(self) -> tuple:
         return self._srv.server_address
+
+    @property
+    def snapshot_failures(self) -> int:
+        return self._srv.snapshot_failures  # type: ignore[attr-defined]
 
     def start(self) -> "MasterServer":
         self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
@@ -197,11 +223,27 @@ class MasterServer:
 
 
 class MasterClient:
-    """Blocking line-JSON client with reconnect (go/master/client.go parity)."""
+    """Blocking line-JSON client with reconnect (go/master/client.go parity).
 
-    def __init__(self, address: tuple, timeout: float = 30.0):
+    Failed calls reconnect and retry with bounded exponential backoff plus
+    jitter (the Go client's backoff discipline; jitter keeps a restarted
+    master from being stampeded by every trainer retrying in lockstep).
+    After `retries` attempts the terminal ConnectionError names the method,
+    the address, the attempt count and the last underlying error."""
+
+    def __init__(
+        self,
+        address: tuple,
+        timeout: float = 30.0,
+        retries: int = 5,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+    ):
         self.address = tuple(address)
         self.timeout = timeout
+        self.retries = max(1, int(retries))
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
         self._sock: Optional[socket.socket] = None
         self._rfile = None
 
@@ -212,7 +254,7 @@ class MasterClient:
 
     def call(self, method: str, **kw) -> dict:
         last_err: Optional[Exception] = None
-        for _ in range(3):  # auto-reconnect like the Go client
+        for attempt in range(self.retries):
             try:
                 self._connect()
                 msg = json.dumps({"method": method, **kw}).encode() + b"\n"
@@ -224,8 +266,21 @@ class MasterClient:
             except (OSError, ConnectionError, json.JSONDecodeError) as e:
                 last_err = e
                 self.close()
-                time.sleep(0.1)
-        raise ConnectionError(f"master RPC {method} failed: {last_err}")
+                stats.FT_EVENTS.incr("master_reconnect")
+                if attempt + 1 < self.retries:
+                    delay = min(self.backoff_max, self.backoff_base * 2 ** attempt)
+                    delay *= 0.5 + random.random() / 2  # full-jitter in [.5d, d)
+                    log.warning(
+                        "master RPC %r failed (%s: %s); reconnecting in %.0fms "
+                        "(attempt %d/%d)", method, type(e).__name__, e,
+                        delay * 1e3, attempt + 1, self.retries,
+                    )
+                    time.sleep(delay)
+        raise ConnectionError(
+            f"master RPC {method!r} to {self.address} failed after "
+            f"{self.retries} attempts; giving up (last error: "
+            f"{type(last_err).__name__}: {last_err})"
+        ) from last_err
 
     def close(self) -> None:
         if self._sock is not None:

@@ -4,5 +4,9 @@ from paddle_tpu.trainer.events import (  # noqa: F401
     EndIteration,
     EndPass,
 )
-from paddle_tpu.trainer.trainer import SGDTrainer, TrainState  # noqa: F401
+from paddle_tpu.trainer.trainer import (  # noqa: F401
+    DivergenceError,
+    SGDTrainer,
+    TrainState,
+)
 from paddle_tpu.trainer import checkpoint as checkpoint  # noqa: F401

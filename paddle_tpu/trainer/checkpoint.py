@@ -11,13 +11,21 @@ shapes, dtypes and a CRC of each file; writes are atomic (tmp + rename)."""
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shutil
 import tempfile
 import zlib
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from paddle_tpu.core import faults
+
+log = logging.getLogger("paddle_tpu.checkpoint")
+
+LATEST_FILE = "latest"
 
 
 def _path_key(path) -> str:
@@ -74,13 +82,21 @@ def save_pass(
     opt_state: Optional[Any] = None,
     extra_meta: Optional[Dict[str, Any]] = None,
     v1_binary: bool = True,
+    keep_last_n: Optional[int] = None,
 ) -> str:
-    """Write save_dir/pass-%05d/{params,states,opt}.npz + manifest.json.
+    """Write save_dir/pass-%05d/{params,states,opt}.npz + manifest.json, then
+    point save_dir/latest at it (tmp+rename, so the pointer is never torn).
 
     v1_binary (default on) additionally writes each parameter as a
     reference-format `Parameter::save` file in the pass dir (ParamUtil layout
     — SURVEY §7 step 8 model interchange; see trainer/v1_format.py), so every
-    pass dir doubles as a reference-consumable model dir."""
+    pass dir doubles as a reference-consumable model dir.
+
+    keep_last_n (None/0 = keep all): after a successful write, delete the
+    oldest pass dirs beyond the newest N — never the one just written. The
+    dir is renamed aside first, so a reader never sees a half-deleted pass."""
+    if keep_last_n is not None and keep_last_n < 0:
+        raise ValueError(f"keep_last_n must be >= 0, got {keep_last_n}")
     pdir = os.path.join(save_dir, f"pass-{pass_id:05d}")
     os.makedirs(pdir, exist_ok=True)
     if v1_binary:
@@ -96,6 +112,11 @@ def save_pass(
         flat = _to_numpy_tree(tree)
         path = os.path.join(pdir, f"{name}.npz")
         crc = _save_npz_atomic(path, flat)
+        if faults.get().fire("ckpt_truncate"):
+            # chaos hook: a torn write that defeated tmp+rename (lying fs,
+            # power cut after rename) — CRC verification must catch it
+            with open(path, "r+b") as f:
+                f.truncate(max(os.path.getsize(path) // 2, 1))
         manifest["files"][name] = {
             "crc32": crc,
             "keys": {k: [list(v.shape), str(v.dtype)] for k, v in flat.items()},
@@ -105,7 +126,99 @@ def save_pass(
     with os.fdopen(fd, "w") as f:
         json.dump(manifest, f, indent=1)
     os.replace(tmp, mpath)
+    _write_latest(save_dir, pass_id)
+    if keep_last_n:
+        _prune_old_passes(save_dir, keep=keep_last_n, just_written=pdir)
     return pdir
+
+
+def _write_latest(save_dir: str, pass_id: int) -> None:
+    fd, tmp = tempfile.mkstemp(dir=save_dir, suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        f.write(f"pass-{pass_id:05d}\n")
+    os.replace(tmp, os.path.join(save_dir, LATEST_FILE))
+
+
+def _list_pass_ids(save_dir: str) -> List[int]:
+    try:
+        names = os.listdir(save_dir)
+    except OSError:
+        return []
+    out = []
+    for d in names:
+        if d.startswith("pass-") and os.path.isdir(os.path.join(save_dir, d)):
+            try:
+                out.append(int(d.split("-")[1]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def _prune_old_passes(save_dir: str, keep: int, just_written: str) -> None:
+    # sweep trash left by a crash between rename-aside and rmtree in an
+    # earlier run, or keep_last_n's disk bound erodes one dir per kill
+    for d in os.listdir(save_dir):
+        if d.startswith(".trash-pass-"):
+            shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
+    passes = _list_pass_ids(save_dir)
+    for pid in passes[:-keep] if keep < len(passes) else []:
+        victim = os.path.join(save_dir, f"pass-{pid:05d}")
+        if os.path.abspath(victim) == os.path.abspath(just_written):
+            continue
+        # rename aside first so a concurrent reader never opens a
+        # half-deleted pass dir; the rmtree then races with nobody
+        trash = os.path.join(save_dir, f".trash-pass-{pid:05d}")
+        try:
+            os.replace(victim, trash)
+        except OSError as e:
+            log.warning("checkpoint retention: cannot retire %s: %s", victim, e)
+            continue
+        shutil.rmtree(trash, ignore_errors=True)
+
+
+def verify_pass(pdir: str) -> bool:
+    """True when `pdir` holds a readable manifest and every file it lists
+    exists and passes its CRC — the load_pass acceptance test, minus the
+    loading."""
+    mpath = os.path.join(pdir, "manifest.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        for name in manifest.get("files", {}):
+            path = os.path.join(pdir, f"{name}.npz")
+            with open(path, "rb") as f:
+                if zlib.crc32(f.read()) != manifest["files"][name]["crc32"]:
+                    return False
+    except (OSError, ValueError, KeyError):
+        return False
+    return True
+
+
+def find_latest_valid_pass(save_dir: str) -> Optional[int]:
+    """Newest pass id under `save_dir` whose checkpoint passes CRC, or None.
+
+    Tries the `latest` pointer first, then scans pass dirs newest-to-oldest;
+    corrupt or partial pass dirs (torn npz, missing manifest — a crash
+    mid-save) are skipped with a warning, so auto-resume lands on the newest
+    checkpoint that can actually be trusted."""
+    if not os.path.isdir(save_dir):
+        return None
+    candidates = _list_pass_ids(save_dir)[::-1]
+    try:
+        with open(os.path.join(save_dir, LATEST_FILE)) as f:
+            pointed = int(f.read().strip().split("-")[1])
+        candidates = [pointed] + [p for p in candidates if p != pointed]
+    except (OSError, ValueError, IndexError):
+        pass
+    for pid in candidates:
+        pdir = os.path.join(save_dir, f"pass-{pid:05d}")
+        if verify_pass(pdir):
+            return pid
+        log.warning(
+            "auto-resume: skipping corrupt/partial checkpoint %s "
+            "(CRC or manifest check failed)", pdir,
+        )
+    return None
 
 
 def is_v1_model_dir(dirname: str) -> bool:

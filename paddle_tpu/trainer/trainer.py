@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.core import stats
+from paddle_tpu.core import faults, stats
 from paddle_tpu.data.pipeline import coerce_batch as _coerce_batch
 from paddle_tpu.data.pipeline import is_device_batch
 from paddle_tpu.nn.graph import Argument, Layer, Network
@@ -36,6 +36,12 @@ from paddle_tpu.trainer.events import BeginIteration, BeginPass, EndIteration, E
 log = logging.getLogger("paddle_tpu.trainer")
 
 TrainState = Dict[str, Any]  # params / opt / states / avg / samples / rng
+
+DIVERGENCE_POLICIES = ("skip_batch", "rollback", "raise")
+
+
+class DivergenceError(RuntimeError):
+    """Raised by divergence_policy="raise" when a step cost goes NaN/Inf."""
 
 
 class SGDTrainer:
@@ -52,6 +58,7 @@ class SGDTrainer:
         updater: Optional[Any] = None,  # parallel.ParameterUpdater
         seed: int = 0,
         remat: Optional[str] = None,  # None | "conv_only" | "full"
+        divergence_policy: Optional[str] = None,  # skip_batch|rollback|raise
     ):
         costs = [cost] if isinstance(cost, Layer) else list(cost)
         self.cost_names = [c.name for c in costs]
@@ -78,9 +85,24 @@ class SGDTrainer:
         self.model_average = model_average or ModelAverage(0.0)
         self.parallel = parallel
         self.seed = seed
+        # Divergence guard (SURVEY §5 failure-as-common-case): with a policy
+        # set, the compiled step checks jnp.isfinite(cost) and hands back the
+        # PRE-step state on NaN/Inf (donation-safe — the select happens inside
+        # the same program), so one poisoned batch cannot corrupt params/opt;
+        # the host then reacts per policy. None = guard compiled out (the
+        # step program and its async dispatch behavior stay byte-identical).
+        if divergence_policy is not None and divergence_policy not in DIVERGENCE_POLICIES:
+            raise ValueError(
+                f"divergence_policy must be one of {DIVERGENCE_POLICIES} or "
+                f"None, got {divergence_policy!r}"
+            )
+        self.divergence_policy = divergence_policy
         self.state: Optional[TrainState] = None
         self._step_fn = None
         self._eval_fn = None
+        # (save_dir, pass_id) of the newest checkpoint this trainer wrote or
+        # loaded — lets _rollback skip a full CRC re-scan per divergence event
+        self._known_good_pass: Optional[tuple] = None
 
     # -- state ---------------------------------------------------------------
     def init_state(self, sample_batch: Dict[str, Any]) -> TrainState:
@@ -95,6 +117,9 @@ class SGDTrainer:
             # int32 (not float32): float32 absorbs small increments past 2^24
             # samples, which would freeze LR schedules and the per-step rng
             "samples": jnp.zeros((), jnp.int32),
+            # host-adjustable LR multiplier: the rollback divergence policy
+            # halves it on every restore (the classic diverged-run response)
+            "lr_scale": jnp.ones((), jnp.float32),
             "rng": rng,
         }
         if self.parallel is not None:
@@ -119,7 +144,7 @@ class SGDTrainer:
 
         def step(state: TrainState, batch: Dict[str, Any]):
             bs = _batch_size(batch)
-            lr = schedule(state["samples"].astype(jnp.float32))
+            lr = schedule(state["samples"].astype(jnp.float32)) * state["lr_scale"]
             step_rng = jax.random.fold_in(state["rng"], state["samples"])
 
             def loss_fn(params):
@@ -158,8 +183,18 @@ class SGDTrainer:
                 "states": new_states,
                 "avg": new_avg,
                 "samples": state["samples"] + bs,
+                "lr_scale": state["lr_scale"],
                 "rng": state["rng"],
             }
+            if self.divergence_policy is not None:
+                # divergence guard: on a NaN/Inf cost every state leaf —
+                # params, opt slots, BN states, samples counter — reverts to
+                # its pre-step value, so the poisoned update never lands. The
+                # returned (non-finite) cost is the flag the host reads.
+                ok = jnp.isfinite(cost)
+                new_state = jax.tree.map(
+                    lambda new, old: jnp.where(ok, new, old), new_state, state
+                )
             extras = {n: outs[n].value for n in extra_names}
             return new_state, cost, extras
 
@@ -220,16 +255,44 @@ class SGDTrainer:
         test_reader: Optional[Callable] = None,
         save_dir: Optional[str] = None,
         log_period: int = 100,
+        auto_resume: bool = False,
+        keep_last_n: Optional[int] = None,
     ) -> TrainState:
         """reader yields batches (lists of samples if feeder given, else dicts
-        of arrays). One call = `num_passes` passes (v1 --num_passes)."""
+        of arrays). One call = `num_passes` passes (v1 --num_passes).
+
+        auto_resume (needs save_dir): scan save_dir for the newest checkpoint
+        that passes CRC — corrupt/partial pass dirs from a crashed save are
+        skipped with a warning — restore params/opt/states and the pass and
+        sample counters from it, and continue with the next pass. A run
+        killed mid-pass and restarted this way replays the interrupted pass
+        from its boundary and, with a deterministic reader, produces final
+        params bitwise-identical to a never-killed run."""
         event_handler = event_handler or (lambda e: None)
+        inj = faults.get()
+        resume_pass: Optional[int] = None
+        resume_pending = False
+        if auto_resume and save_dir is not None:
+            resume_pass = ckpt_mod.find_latest_valid_pass(save_dir)
+            if resume_pass is not None:
+                log.info(
+                    "auto-resume: restoring from %s/pass-%05d "
+                    "(continuing at pass %d)", save_dir, resume_pass,
+                    resume_pass + 1,
+                )
+                if self.state is not None:
+                    self.load(save_dir, resume_pass)
+                    self._known_good_pass = (save_dir, resume_pass)
+                else:  # state shapes unknown until the first batch arrives
+                    resume_pending = True
         for pass_id in range(num_passes):
+            if resume_pass is not None and pass_id <= resume_pass:
+                continue  # completed by the run we are resuming
             event_handler(BeginPass(pass_id))
             self.updater.start_pass()
             stats.RECOMPILES.start_pass()
             t0 = time.time()
-            cost_sum_dev, n_batches = None, 0
+            cost_sum_dev, n_batches, n_diverged = None, 0, 0
             for batch_id, raw in enumerate(reader()):
                 # device batches (from a DevicePrefetcher) arrive fed, sharded
                 # and resident — skip the whole host prep leg; dict batches
@@ -264,8 +327,19 @@ class SGDTrainer:
                         batch = self.parallel.shard_batch(batch)
                 if self.state is None:
                     self.init_state(batch)
+                    if resume_pending:  # deferred auto-resume load
+                        self.load(save_dir, resume_pass)
+                        self._known_good_pass = (save_dir, resume_pass)
+                        resume_pending = False
                 if self._step_fn is None:
                     self._step_fn = self._make_step()
+                if inj.active:
+                    if inj.fire("kill"):
+                        raise faults.InjectedKill(
+                            f"injected kill at pass {pass_id} batch {batch_id}"
+                        )
+                    if inj.fire("nan_loss"):
+                        batch = _poison_batch(batch)
                 # one distinct signature = one XLA trace+compile of the step;
                 # churn past the threshold warns (misconfigured seq_buckets)
                 stats.RECOMPILES.record(stats.batch_signature(batch))
@@ -281,6 +355,26 @@ class SGDTrainer:
                     self.state, cost, extras = self._step_fn(self.state, batch)
                     if stats.GLOBAL_STATS.enabled:
                         jax.block_until_ready(cost)
+                if self.divergence_policy is not None and not np.isfinite(
+                    float(cost)  # forces a per-step sync — the guard's price
+                ):
+                    # the step already handed back the pre-step state; react
+                    n_diverged += 1
+                    stats.FT_EVENTS.incr("divergence")
+                    if self.divergence_policy == "raise":
+                        raise DivergenceError(
+                            f"non-finite cost ({float(cost)}) at pass "
+                            f"{pass_id} batch {batch_id}; state rolled back "
+                            f"to the pre-step values"
+                        )
+                    if self.divergence_policy == "rollback":
+                        self._rollback(save_dir, pass_id, batch_id)
+                    else:
+                        log.warning(
+                            "divergence guard: non-finite cost at pass %d "
+                            "batch %d — batch skipped", pass_id, batch_id,
+                        )
+                    continue  # poisoned batch joins neither cost nor events
                 n_batches += 1
                 # accumulate the pass cost ON DEVICE (async scalar add) and
                 # hand handlers a lazy event — the device is synced only when
@@ -299,6 +393,7 @@ class SGDTrainer:
                 "batches": n_batches,
                 "pass_seconds": time.time() - t0,
                 "shape_signatures": stats.RECOMPILES.pass_signatures(),
+                "divergence_events": n_diverged,
             }
             if stats.GLOBAL_STATS.enabled:
                 log.info(
@@ -308,9 +403,79 @@ class SGDTrainer:
             if test_reader is not None:
                 metrics["test_cost"] = self.test(test_reader, feeder)["cost"]
             if save_dir is not None:
-                self.save(save_dir, pass_id)
+                self.save(save_dir, pass_id, keep_last_n=keep_last_n)
+                self._known_good_pass = (save_dir, pass_id)
             event_handler(EndPass(pass_id, metrics))
+        if resume_pending:
+            # every requested pass was already checkpointed — nothing ran, so
+            # state was never initialized; pull one batch just for shapes and
+            # load the final checkpoint so the caller still gets it back
+            raw = next(iter(reader()), None)
+            if raw is not None:
+                on_device = is_device_batch(raw) and (
+                    self.parallel is None or self.parallel.is_sharded_batch(raw)
+                )
+                batch = (
+                    raw
+                    if on_device
+                    else feeder(raw)
+                    if feeder is not None and not isinstance(raw, dict)
+                    else _coerce_batch(raw)
+                )
+                if self.parallel is not None and not on_device:
+                    batch = self.parallel.shard_batch(batch)
+                self.init_state(batch)
+                self.load(save_dir, resume_pass)
+                self._known_good_pass = (save_dir, resume_pass)
         return self.state
+
+    def _rollback(self, save_dir: Optional[str], pass_id: int, batch_id: int) -> None:
+        """Divergence rollback: restore the newest valid checkpoint and halve
+        the LR multiplier; with no checkpoint to return to, degrade to
+        skip_batch (the in-step guard already protected the state)."""
+        latest: Optional[int] = None
+        if save_dir is not None:
+            # last checkpoint this trainer wrote/loaded needs no CRC re-scan
+            # (a stream of NaN batches would otherwise re-read the whole
+            # checkpoint set once per diverged step)
+            if self._known_good_pass and self._known_good_pass[0] == save_dir:
+                latest = self._known_good_pass[1]
+            else:
+                latest = ckpt_mod.find_latest_valid_pass(save_dir)
+        if latest is None:
+            log.warning(
+                "divergence rollback at pass %d batch %d: no valid checkpoint "
+                "under %r — falling back to skipping the batch",
+                pass_id, batch_id, save_dir,
+            )
+            return
+        cur_scale = float(self.state["lr_scale"])
+        try:
+            self.load(save_dir, latest)
+        except (OSError, ValueError):
+            # the remembered checkpoint rotted on disk — fall back to a scan
+            self._known_good_pass = None
+            latest = ckpt_mod.find_latest_valid_pass(save_dir)
+            if latest is None:
+                log.warning(
+                    "divergence rollback at pass %d batch %d: no valid "
+                    "checkpoint under %r — falling back to skipping the batch",
+                    pass_id, batch_id, save_dir,
+                )
+                return
+            self.load(save_dir, latest)
+        # halve from the LOWER of the live and checkpointed scales, so
+        # back-to-back rollbacks onto the same checkpoint keep compounding
+        # (0.5 → 0.25 → …) instead of resetting to the stored value
+        self.state["lr_scale"] = jnp.asarray(
+            min(cur_scale, float(self.state["lr_scale"])) * 0.5, jnp.float32
+        )
+        stats.FT_EVENTS.incr("divergence_rollback")
+        log.warning(
+            "divergence rollback at pass %d batch %d: restored pass-%05d, "
+            "lr_scale now %g", pass_id, batch_id, latest,
+            float(self.state["lr_scale"]),
+        )
 
     def test(self, reader: Callable, feeder: Optional[Callable] = None) -> Dict[str, Any]:
         """Tester analog (paddle/trainer/Tester.cpp): average cost over a reader."""
@@ -337,7 +502,9 @@ class SGDTrainer:
             n += bs
         return {"cost": total / max(n, 1), "samples": n}
 
-    def save(self, save_dir: str, pass_id: int) -> str:
+    def save(
+        self, save_dir: str, pass_id: int, keep_last_n: Optional[int] = None
+    ) -> str:
         """Raw params + optimizer + averaging state are all persisted so
         load() is a true resume; deployment-time averaged weights are
         recoverable via ModelAverage.averaged_params on the loaded state."""
@@ -351,7 +518,11 @@ class SGDTrainer:
             self.state["params"],
             self.state["states"],
             opt_tree,
-            extra_meta={"samples": int(self.state["samples"])},
+            extra_meta={
+                "samples": int(self.state["samples"]),
+                "lr_scale": float(self.state["lr_scale"]),
+            },
+            keep_last_n=keep_last_n,
         )
 
     def load(self, save_dir: str, pass_id: Optional[int] = None) -> None:
@@ -377,6 +548,9 @@ class SGDTrainer:
         samples = manifest.get("extra", {}).get("samples")
         if samples is not None:
             self.state["samples"] = jnp.asarray(int(samples), jnp.int32)
+        lr_scale = manifest.get("extra", {}).get("lr_scale")
+        if lr_scale is not None:
+            self.state["lr_scale"] = jnp.asarray(float(lr_scale), jnp.float32)
         if self.parallel is not None:
             # re-establish mesh placement (sharded head weights, replicated
             # slots) — plain asarray loads land unsharded otherwise
@@ -388,3 +562,17 @@ def _batch_size(batch: Dict[str, Any]) -> int:
         if not k.endswith(".lengths"):
             return int(np.shape(v)[0])
     raise ValueError("empty batch")
+
+
+def _poison_batch(batch: Dict[str, Any]) -> Dict[str, Any]:
+    """nan_loss chaos hook: NaN out the first float slot (shape and dtype
+    unchanged, so no recompile) — the realistic corrupt-sample fault the
+    divergence guard exists for."""
+    out = dict(batch)
+    for k, v in batch.items():
+        if not k.endswith(".lengths") and np.issubdtype(
+            np.dtype(getattr(v, "dtype", np.asarray(v).dtype)), np.floating
+        ):
+            out[k] = v * np.float32("nan")
+            return out
+    raise ValueError("nan_loss fault: batch has no float slot to poison")

@@ -1,0 +1,487 @@
+"""Chaos-injection tests: fault tolerance as a first-class, tested code path.
+
+Every test here drives a REAL failure path — injected kill + auto-resume,
+NaN loss + divergence policies, torn checkpoint writes, dropped master RPCs,
+flaky feeders — through the seeded harness in paddle_tpu/core/faults.py, so
+each failure is deterministic and cheap enough for tier-1 (the reference's
+failure machinery, go/master + go/pserver, was only ever exercised by
+hand-run cluster jobs)."""
+
+import os
+import traceback
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import faults, stats
+from paddle_tpu.data import DataFeeder, dense_vector, integer_value, reader as rd
+from paddle_tpu.data.pipeline import DevicePrefetcher
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.graph import reset_name_scope
+from paddle_tpu.optim import SGD
+from paddle_tpu.trainer import DivergenceError, EndPass, SGDTrainer
+from paddle_tpu.trainer import checkpoint as ckpt
+
+pytestmark = pytest.mark.chaos
+
+DIM, CLASSES = 4, 3
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    reset_name_scope()
+    stats.FT_EVENTS.reset()
+    yield
+
+
+def _reader(n=64, seed=0):
+    rs = np.random.RandomState(seed)
+    xs = rs.randn(n, DIM).astype(np.float32)
+    ys = (np.arange(n) % CLASSES).astype(np.int64)
+
+    def reader():
+        for x, y in zip(xs, ys):
+            yield {"x": x, "label": int(y)}
+
+    return reader
+
+
+def _feeder():
+    return DataFeeder({"x": dense_vector(DIM), "label": integer_value(CLASSES)})
+
+
+def _trainer(policy=None, seed=5, lr=0.1):
+    reset_name_scope()
+    x = L.Data("x", shape=(DIM,))
+    lbl = L.Data("label", shape=())
+    logits = L.Fc(L.Fc(x, 16, act="relu"), CLASSES, act=None)
+    cost = C.ClassificationCost(logits, lbl)
+    return SGDTrainer(
+        cost, SGD(learning_rate=lr), seed=seed, divergence_policy=policy
+    )
+
+
+def _params(t):
+    return {k: np.asarray(v) for k, v in t.state["params"].items()}
+
+
+# ---------------------------------------------------------------------------
+# fault spec / injector
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parsing():
+    spec = faults.parse_spec(
+        "feeder_raise:0.01,h2d_delay:5ms,master_drop:0.05,nan_loss:step=37"
+    )
+    assert spec["feeder_raise"].prob == 0.01
+    assert spec["h2d_delay"].delay_s == pytest.approx(0.005)
+    assert spec["master_drop"].prob == 0.05
+    assert spec["nan_loss"].step == 37
+    assert faults.parse_spec("io_delay:1.5s")["io_delay"].delay_s == 1.5
+    assert faults.parse_spec("") == {}
+    # durations are only meaningful on *_delay sites ("kill:5s" would
+    # otherwise silently mean "kill every batch")
+    for bad in ("nan_loss", "x:1.5", "x:-0.1", "x:abc", "x:step=q",
+                "kill:5s", "nan_loss:5ms", "h2d_delay:0.5", "h2d_delay:step=3"):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+def test_injector_is_seeded_and_deterministic():
+    a = faults.FaultInjector("f:0.3", seed=7)
+    b = faults.FaultInjector("f:0.3", seed=7)
+    c = faults.FaultInjector("f:0.3", seed=8)
+    pat = lambda inj: [inj.fire("f") for _ in range(64)]  # noqa: E731
+    pa, pb, pc = pat(a), pat(b), pat(c)
+    assert pa == pb, "same seed must give the same fire pattern"
+    assert pa != pc, "different seed must give a different pattern"
+    assert a.fired["f"] == sum(pa) and a.hits["f"] == 64
+    # step= fires exactly once, on the right hit
+    s = faults.FaultInjector("f:step=2")
+    assert [s.fire("f") for s_ in range(5)] == [False, False, True, False, False]
+    # unknown sites never fire and are never counted
+    assert not a.fire("unknown") and "unknown" not in a.hits
+
+
+def test_inject_context_restores_previous_config():
+    before = faults.get().spec_str
+    with faults.inject("kill:step=0") as inj:
+        assert inj.active and faults.get() is inj
+    assert faults.get().spec_str == before
+
+
+# ---------------------------------------------------------------------------
+# tentpole: kill + auto-resume is bitwise-identical to an unfaulted run
+# ---------------------------------------------------------------------------
+
+
+def test_kill_and_auto_resume_bitwise_identical(tmp_path):
+    """A run killed mid-pass and auto-resumed must land on EXACTLY the params
+    of a never-killed run (allclose rtol=0 == array_equal) — the CPU-oracle
+    determinism contract for the whole save/CRC/restore chain."""
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)  # 2 batches/pass
+
+    t_ref = _trainer()
+    t_ref.train(batches, num_passes=3, feeder=feeder,
+                save_dir=str(tmp_path / "ref"))
+    ref = _params(t_ref)
+
+    # faulted run: SIGKILL analog at global step 3 = pass 1, batch 1
+    d = str(tmp_path / "faulted")
+    with faults.inject("kill:step=3") as inj:
+        t1 = _trainer()
+        with pytest.raises(faults.InjectedKill):
+            t1.train(batches, num_passes=3, feeder=feeder, save_dir=d)
+        assert inj.fired["kill"] == 1
+    assert ckpt.find_latest_valid_pass(d) == 0  # only pass 0 completed
+
+    # "restarted process": fresh trainer, same config, auto_resume
+    t2 = _trainer()
+    t2.train(batches, num_passes=3, feeder=feeder, save_dir=d,
+             auto_resume=True)
+    got = _params(t2)
+    assert set(got) == set(ref)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=0, atol=0, err_msg=k)
+    assert int(t2.state["samples"]) == int(t_ref.state["samples"])
+
+
+def test_auto_resume_skips_corrupt_checkpoint(tmp_path, caplog):
+    """Truncate the newest params.npz: auto-resume must fall back to the
+    previous valid pass (with a warning) and end up exactly where a clean
+    resume from that pass would."""
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)
+    d = str(tmp_path / "ckpts")
+
+    t1 = _trainer()
+    t1.train(batches, num_passes=2, feeder=feeder, save_dir=d)
+    ref = _params(t1)  # state after pass 1
+
+    bad = os.path.join(d, "pass-00001", "params.npz")
+    with open(bad, "r+b") as f:
+        f.truncate(os.path.getsize(bad) // 2)
+    with caplog.at_level("WARNING", logger="paddle_tpu.checkpoint"):
+        assert ckpt.find_latest_valid_pass(d) == 0
+    assert any("corrupt" in r.message for r in caplog.records)
+
+    # resume re-runs pass 1 from the pass-0 checkpoint → same final params
+    t2 = _trainer()
+    t2.train(batches, num_passes=2, feeder=feeder, save_dir=d,
+             auto_resume=True)
+    got = _params(t2)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=0, atol=0, err_msg=k)
+
+
+def test_auto_resume_with_all_passes_done_loads_state(tmp_path):
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)
+    d = str(tmp_path / "done")
+    t1 = _trainer()
+    t1.train(batches, num_passes=2, feeder=feeder, save_dir=d)
+
+    t2 = _trainer()
+    state = t2.train(batches, num_passes=2, feeder=feeder, save_dir=d,
+                     auto_resume=True)
+    assert state is not None
+    for k, v in _params(t1).items():
+        np.testing.assert_array_equal(np.asarray(state["params"][k]), v)
+
+
+def test_ckpt_truncate_fault_is_caught_by_crc(tmp_path):
+    params = {"w": np.arange(8, dtype=np.float32)}
+    with faults.inject("ckpt_truncate:1.0") as inj:
+        pdir = ckpt.save_pass(str(tmp_path), 0, params, v1_binary=False)
+        assert inj.fired["ckpt_truncate"] >= 1
+    assert not ckpt.verify_pass(pdir)
+    assert ckpt.find_latest_valid_pass(str(tmp_path)) is None
+    with pytest.raises(IOError, match="CRC"):
+        ckpt.load_pass(str(tmp_path), 0)
+
+
+def test_keep_last_n_retention_and_latest_pointer(tmp_path):
+    d = str(tmp_path)
+    for p in range(5):
+        ckpt.save_pass(d, p, {"w": np.full(4, p, np.float32)},
+                       v1_binary=False, keep_last_n=2)
+    dirs = sorted(x for x in os.listdir(d) if x.startswith("pass-"))
+    assert dirs == ["pass-00003", "pass-00004"]
+    assert not [x for x in os.listdir(d) if x.startswith(".trash")]
+    with open(os.path.join(d, ckpt.LATEST_FILE)) as f:
+        assert f.read().strip() == "pass-00004"
+    assert ckpt.find_latest_valid_pass(d) == 4
+    # a stale/corrupt latest pointer degrades to the scan, not a crash
+    with open(os.path.join(d, ckpt.LATEST_FILE), "w") as f:
+        f.write("garbage")
+    assert ckpt.find_latest_valid_pass(d) == 4
+
+
+# ---------------------------------------------------------------------------
+# divergence guard
+# ---------------------------------------------------------------------------
+
+
+def test_nan_without_guard_poisons_params(tmp_path):
+    """The motivating failure: with no policy, one NaN batch silently poisons
+    every parameter from then on."""
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)
+    with faults.inject("nan_loss:step=1"):
+        t = _trainer(policy=None)
+        t.train(batches, num_passes=1, feeder=feeder)
+    assert any(not np.isfinite(v).all() for v in _params(t).values())
+
+
+def test_divergence_skip_batch_recovers():
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)
+    passes = []
+    with faults.inject("nan_loss:step=1") as inj:
+        t = _trainer(policy="skip_batch")
+        t.train(
+            batches, num_passes=2, feeder=feeder,
+            event_handler=lambda e: passes.append(e.metrics)
+            if isinstance(e, EndPass) else None,
+        )
+        assert inj.fired["nan_loss"] == 1
+    # the poisoned step landed in neither params nor the pass average
+    assert all(np.isfinite(v).all() for v in _params(t).values())
+    assert all(np.isfinite(m["avg_cost"]) for m in passes)
+    assert passes[0]["divergence_events"] == 1 and passes[0]["batches"] == 1
+    assert passes[1]["divergence_events"] == 0
+    assert stats.FT_EVENTS.get("divergence") == 1
+
+
+def test_divergence_rollback_restores_and_cuts_lr(tmp_path):
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)  # 2 batches/pass
+    d = str(tmp_path / "roll")
+    # NaN at global step 4 = pass 2 batch 0; passes 0/1 are checkpointed
+    with faults.inject("nan_loss:step=4"):
+        t = _trainer(policy="rollback")
+        t.train(batches, num_passes=3, feeder=feeder, save_dir=d)
+    assert float(t.state["lr_scale"]) == 0.5  # halved exactly once
+    assert all(np.isfinite(v).all() for v in _params(t).values())
+    assert stats.FT_EVENTS.get("divergence_rollback") == 1
+    # the halved lr_scale is persisted for the NEXT resume
+    _, _, _, manifest = ckpt.load_pass(d)
+    assert manifest["extra"]["lr_scale"] == 0.5
+
+
+def test_divergence_rollback_without_checkpoint_degrades_to_skip(caplog):
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)
+    with faults.inject("nan_loss:step=0"):
+        t = _trainer(policy="rollback")
+        with caplog.at_level("WARNING", logger="paddle_tpu.trainer"):
+            t.train(batches, num_passes=1, feeder=feeder)  # no save_dir
+    assert any("falling back" in r.message for r in caplog.records)
+    assert float(t.state["lr_scale"]) == 1.0
+    assert all(np.isfinite(v).all() for v in _params(t).values())
+
+
+def test_divergence_raise_is_loud_and_state_safe():
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)
+    with faults.inject("nan_loss:step=1"):
+        t = _trainer(policy="raise")
+        with pytest.raises(DivergenceError, match="non-finite cost.*pass 0 batch 1"):
+            t.train(batches, num_passes=1, feeder=feeder)
+    # the guard still protected the state before the raise
+    assert all(np.isfinite(v).all() for v in _params(t).values())
+
+
+def test_bad_divergence_policy_rejected():
+    with pytest.raises(ValueError, match="divergence_policy"):
+        _trainer(policy="explode")
+
+
+# ---------------------------------------------------------------------------
+# pipeline: retry, traceback fidelity, stall watchdog
+# ---------------------------------------------------------------------------
+
+
+def _raw_batches(n=4, bs=8):
+    rs = np.random.RandomState(0)
+    return [
+        [(rs.randn(DIM).astype(np.float32), int(i % CLASSES)) for i in range(bs)]
+        for _ in range(n)
+    ]
+
+
+def test_feeder_retry_rescues_transient_fault():
+    raws = _raw_batches(n=4)
+    with faults.inject("feeder_raise:step=1") as inj:
+        got = list(DevicePrefetcher(lambda: iter(raws), _feeder(),
+                                    prefetch_depth=1, feed_retries=2))
+        fired = inj.fired.get("feeder_raise", 0)
+    assert len(got) == 4, "one transient fault must not lose a batch"
+    assert fired == 1
+    assert stats.FT_EVENTS.get("feeder_retry") == 1
+
+
+def test_feeder_retries_exhausted_raises():
+    raws = _raw_batches(n=2)
+    with faults.inject("feeder_raise:1.0"):  # every attempt fails
+        with pytest.raises(faults.InjectedFault, match="feeder_raise"):
+            list(DevicePrefetcher(lambda: iter(raws), _feeder(),
+                                 prefetch_depth=1, feed_retries=2))
+    assert stats.FT_EVENTS.get("feeder_retry") == 2  # N retries, then raise
+
+
+def test_worker_traceback_reaches_consumer():
+    """The satellite fix: a feeder bug must surface with the WORKER's frames
+    (the actual buggy function), not just the consumer re-raise site."""
+
+    def bad_feeder(raw):
+        raise ValueError("corrupt sample: negative length")
+
+    with pytest.raises(ValueError, match="corrupt sample") as ei:
+        list(DevicePrefetcher(lambda: iter(_raw_batches(n=1)), bad_feeder,
+                             prefetch_depth=1, feed_retries=0))
+    frames = [f.name for f in traceback.extract_tb(ei.value.__traceback__)]
+    assert "bad_feeder" in frames, f"worker frames lost: {frames}"
+
+
+def test_h2d_delay_fault_and_stall_watchdog(caplog):
+    from paddle_tpu.data.pipeline import iter_async
+
+    def slow_reader():
+        import time as _t
+
+        _t.sleep(0.25)  # producer wedged long past the watchdog period
+        yield {"x": np.zeros((2, DIM), np.float32)}
+
+    with caplog.at_level("WARNING", logger="paddle_tpu.pipeline"):
+        got = list(iter_async(slow_reader, lambda r: r, capacity=1,
+                              stall_warn_s=0.05))
+    assert len(got) == 1  # starvation logs, it does not drop data
+    assert any("starved" in r.message for r in caplog.records)
+    assert stats.FT_EVENTS.get("pipeline_stall") >= 1
+
+    # h2d_delay measurably slows the prefetcher's device leg
+    with faults.inject("h2d_delay:30ms") as inj:
+        import time as _t
+
+        t0 = _t.perf_counter()
+        list(DevicePrefetcher(lambda: iter(_raw_batches(n=3)), _feeder(),
+                             prefetch_depth=1))
+        assert _t.perf_counter() - t0 > 0.09  # 3 batches x 30ms
+        assert inj.fired["h2d_delay"] == 3
+
+
+# ---------------------------------------------------------------------------
+# master: dropped RPCs, snapshot failures, kill-and-restart mid-pass
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.runtime import (  # noqa: E402
+    MasterClient,
+    MasterServer,
+    TaskMaster,
+    available,
+    cluster_reader,
+    recordio,
+)
+
+needs_native = pytest.mark.skipif(
+    not available(), reason="native runtime library unavailable"
+)
+
+
+@needs_native
+def test_master_drop_fault_client_backoff_completes(tmp_path):
+    """Randomly dropped RPCs (seeded) must be absorbed by the client's
+    reconnect+backoff: one pass still yields every sample exactly once."""
+    samples = [{"x": i} for i in range(48)]
+    shards = recordio.convert(
+        str(tmp_path / "ds"), lambda: iter(samples), records_per_file=12
+    )
+    server = MasterServer(TaskMaster(timeout_s=30, failure_max=2)).start()
+    try:
+        with faults.inject("master_drop:0.2", seed=3) as inj:
+            client = MasterClient(server.address, retries=6, backoff_base=0.01)
+            assert client.call("set_dataset", shards=shards,
+                               chunks_per_task=1)["ok"]
+            got = sorted(list(cluster_reader(server.address)()),
+                         key=lambda s: s["x"])
+            client.close()
+            dropped = inj.fired.get("master_drop", 0)
+        assert got == samples
+        assert dropped >= 1, "chaos produced no drops — raise prob or hits"
+        assert stats.FT_EVENTS.get("master_reconnect") >= dropped
+    finally:
+        server.stop()
+
+
+def test_master_client_terminal_error_is_clear():
+    # nothing listens on this port: the client must back off, then name the
+    # method, address and attempt count in one terminal error
+    dead = MasterClient(("127.0.0.1", 1), timeout=0.2, retries=2,
+                        backoff_base=0.01)
+    with pytest.raises(ConnectionError, match="'get_task'.*after 2 attempts"):
+        dead.call("get_task")
+
+
+@needs_native
+def test_master_snapshot_failure_logged_and_counted(tmp_path, caplog):
+    """The satellite fix: snapshot OSError is no longer swallowed — it warns
+    and shows up in stats()['snapshot_failures']."""
+    bad = str(tmp_path / "no_such_dir" / "m.snap")  # parent doesn't exist
+    server = MasterServer(
+        TaskMaster(timeout_s=30, failure_max=2), snapshot_path=bad
+    ).start()
+    try:
+        client = MasterClient(server.address)
+        client.call("set_dataset", shards=["s0", "s1"], chunks_per_task=1)
+        with caplog.at_level("WARNING", logger="paddle_tpu.master"):
+            resp = client.call("get_task")
+            client.call("task_finished", task_id=resp["task_id"])
+        st = client.call("stats")
+        assert st["snapshot_failures"] >= 1
+        assert server.snapshot_failures >= 1
+        assert any("snapshot" in r.message for r in caplog.records)
+        client.close()
+    finally:
+        server.stop()
+
+
+@needs_native
+def test_master_kill_restart_midpass_no_loss_no_dup(tmp_path):
+    """Kill the master with a task LEASED (pending) mid-pass: the restarted
+    master restores from snapshot, re-dispatches the lost lease, and never
+    re-issues finished work — no sample lost, none duplicated."""
+    samples = list(range(40))
+    shards = recordio.convert(
+        str(tmp_path / "ds"), lambda: iter(samples), records_per_file=10
+    )
+    snap = str(tmp_path / "m.snap")
+    server = MasterServer(
+        TaskMaster(timeout_s=30, failure_max=2), snapshot_path=snap
+    ).start()
+    client = MasterClient(server.address)
+    client.call("set_dataset", shards=shards, chunks_per_task=1)
+    done = client.call("get_task")          # will be finished + snapshotted
+    leased = client.call("get_task")        # will be LOST with the server
+    consumed = list(recordio.read_shards(done["shards"]))
+    client.call("task_finished", task_id=done["task_id"])
+    client.close()
+    server.stop()                           # kill mid-pass, lease outstanding
+
+    server2 = MasterServer(
+        TaskMaster(timeout_s=30, failure_max=2), snapshot_path=snap
+    ).start()
+    try:
+        rest = list(cluster_reader(server2.address)())
+        # exactly-once over the pass: finished work not re-issued, the lost
+        # lease re-dispatched (lease-requeue semantics)
+        assert sorted(consumed + rest) == samples
+        leased_samples = list(recordio.read_shards(leased["shards"]))
+        assert all(s in rest for s in leased_samples)
+        st = MasterClient(server2.address).call("stats")
+        assert st["todo"] == 0 and st["pending"] == 0
+    finally:
+        server2.stop()
