@@ -1,15 +1,24 @@
-"""Chaos benchmark: training throughput under injected faults.
+"""Chaos benchmark: training throughput under injected faults, plus a
+multi-process cluster failover scenario.
 
-Measures steps/sec for the same toy workload three ways — clean, under an
-input-side fault mix (flaky feeder + slowed H2D), and with periodic NaN
-batches absorbed by the skip_batch divergence guard — all through the seeded
-injector in paddle_tpu/core/faults.py, so a run is reproducible bit-for-bit.
-The interesting number is the ratio: how much throughput the fault-tolerance
-machinery (retries, guard sync, watchdog) costs when faults actually happen,
-and (via --faults "") what the guard alone costs when they never do.
+--mode local (default) measures steps/sec for the same toy workload three
+ways — clean, under an input-side fault mix (flaky feeder + slowed H2D), and
+with periodic NaN batches absorbed by the skip_batch divergence guard — all
+through the seeded injector in paddle_tpu/core/faults.py, so a run is
+reproducible bit-for-bit. The interesting number is the ratio: how much
+throughput the fault-tolerance machinery (retries, guard sync, watchdog)
+costs when faults actually happen, and (via --faults "") what the guard
+alone costs when they never do.
+
+--mode cluster spawns a REAL master process that dies to the seeded
+`master_kill` fault mid-pass, a warm-standby process that takes over from
+the shared snapshot, and N consumer threads failing over through their
+endpoint list — and reports the wall-clock cost of the failover plus the
+exactly-once bookkeeping (done == ntasks, discarded == 0, replayed records).
 
 Usage:
-  JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py [--faults SPEC] [--seed N]
+  JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py [--mode local|cluster]
+      [--faults SPEC] [--seed N]
 """
 
 from __future__ import annotations
@@ -85,11 +94,149 @@ def run_mode(args, spec: str, policy=None) -> dict:
     }
 
 
+def run_cluster(args) -> dict:
+    """Kill-the-master failover drill with real OS processes (see module
+    docstring); returns the JSON-able result dict."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    from paddle_tpu.core import stats
+    from paddle_tpu.runtime import recordio
+    from paddle_tpu.runtime.master import (
+        KILLED_EXIT, MasterClient, cluster_reader, standby_master,
+    )
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="chaos_cluster_")
+    nrec = args.cluster_tasks * args.records_per_task
+    standby_holder = {}
+    primary = None
+    try:
+        shards = recordio.convert(
+            os.path.join(tmp, "ds"),
+            lambda: ({"sid": i} for i in range(nrec)),
+            records_per_file=args.records_per_task,
+        )
+        p1, p2 = free_port(), free_port()
+        snap = os.path.join(tmp, "m.snap")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = os.pathsep.join(
+            [sys.path[0]] + env.get("PYTHONPATH", "").split(os.pathsep)
+        ).strip(os.pathsep)
+        primary = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.runtime.master", "serve",
+             "--port", str(p1), "--snapshot", snap, "--lease_s", "2",
+             "--timeout_s", "30", "--failure_max", "10",
+             "--faults", f"master_kill:step={args.kill_rpc}",
+             "--faults_seed", str(args.seed)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", p1), 0.5).close()
+                break
+            except OSError:
+                time.sleep(0.1)
+        boot = MasterClient(("127.0.0.1", p1))
+        boot.call("set_dataset", shards=shards, chunks_per_task=1)
+        boot.close()
+
+        def run_standby():
+            standby_holder["srv"] = standby_master(
+                ("127.0.0.1", p1), port=p2, snapshot_path=snap,
+                poll_s=0.1, max_wait_s=120, lease_s=2.0,
+            )
+
+        threading.Thread(target=run_standby, daemon=True).start()
+
+        endpoints = [("127.0.0.1", p1), ("127.0.0.1", p2)]
+        consumed = [[] for _ in range(args.consumers)]
+        stats.FT_EVENTS.reset()
+
+        def consume(i):
+            reader = cluster_reader(
+                endpoints, client_kw={"retries": 40, "timeout": 5}
+            )
+            for s in reader():
+                consumed[i].append(s["sid"])
+                time.sleep(args.work_ms / 1e3)
+
+        threads = [
+            threading.Thread(target=consume, args=(i,))
+            for i in range(args.consumers)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        elapsed = time.time() - t0
+        primary.wait(timeout=10)
+        srv = standby_holder.get("srv")
+        st = {}
+        if srv is not None:
+            post = MasterClient(("127.0.0.1", p2))
+            st = post.call("stats")
+            post.close()
+        flat = [x for c in consumed for x in c]
+        return {
+            "metric": "cluster_failover_wall_s",
+            "value": round(elapsed, 3),
+            "unit": "s",
+            "tasks": args.cluster_tasks,
+            "records": nrec,
+            "consumers": args.consumers,
+            "primary_exit": primary.returncode,
+            "primary_killed_by_chaos": primary.returncode == KILLED_EXIT,
+            "standby_takeover": srv is not None,
+            "done": st.get("done"),
+            "discarded": st.get("discarded"),
+            "exactly_once_tasks": (
+                st.get("done") == args.cluster_tasks
+                and st.get("discarded") == 0
+            ),
+            "records_delivered": len(flat),
+            "records_replayed": len(flat) - len(set(flat)),
+            "coverage_complete": set(flat) == set(range(nrec)),
+            "ft_events": stats.FT_EVENTS.as_dict(),
+            "seed": args.seed,
+        }
+    finally:
+        if primary is not None and primary.poll() is None:
+            primary.kill()
+        srv = standby_holder.get("srv")
+        if srv is not None:
+            srv.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="local", choices=["local", "cluster"],
+                    help="local: in-process throughput-under-faults; "
+                         "cluster: multi-process master-failover drill")
     ap.add_argument("--faults", default=DEFAULT_FAULTS,
                     help="input-side fault mix for the chaos mode")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cluster_tasks", type=int, default=16)
+    ap.add_argument("--records_per_task", type=int, default=4)
+    ap.add_argument("--consumers", type=int, default=2)
+    ap.add_argument("--work_ms", type=float, default=10.0,
+                    help="per-record consumer work, keeps the pass alive "
+                         "long enough for the kill to land mid-pass")
+    ap.add_argument("--kill_rpc", type=int, default=9,
+                    help="cluster mode: the RPC hit on which master_kill "
+                         "fires (seeded, deterministic)")
     ap.add_argument("--batches", type=int, default=50)
     ap.add_argument("--batch_size", type=int, default=256)
     ap.add_argument("--dim", type=int, default=128)
@@ -99,6 +246,10 @@ def main():
                     help="guard mode poisons every Nth batch (via probability "
                          "1/N) to exercise skip_batch under load")
     args = ap.parse_args()
+
+    if args.mode == "cluster":
+        print(json.dumps(run_cluster(args)))
+        return
 
     import jax
 

@@ -11,6 +11,7 @@ Usage:
         [--init_model_path=DIR] [--start_pass=N] [--log_period=N] [--job=train|test|time]
         [--auto_resume=1] [--divergence_policy=skip_batch|rollback|raise]
         [--keep_last_n=N] [--faults=SPEC]
+        [--master_endpoints=a:p1,b:p2] [--preempt_grace_s=S]
     python -m paddle_tpu dump_config --config=conf.py
     python -m paddle_tpu merge_model --config=conf.py --model_dir=DIR --output=FILE
     python -m paddle_tpu version
@@ -80,6 +81,20 @@ def _train_args(p: argparse.ArgumentParser) -> None:
         "--faults", default=None,
         help="chaos-injection spec, e.g. 'feeder_raise:0.01,nan_loss:step=37' "
              "(overrides $PADDLE_TPU_FAULTS; see paddle_tpu/core/faults.py)",
+    )
+    p.add_argument(
+        "--master_endpoints", default=None,
+        help="pull training data from an elastic task master instead of the "
+             "config's provider: 'host:port' or a failover list "
+             "'a:p1,b:p2' (primary + standby); shards hold pickled "
+             "provider-format samples",
+    )
+    p.add_argument(
+        "--preempt_grace_s", type=float, default=30.0,
+        help="drain budget after a SIGTERM/SIGINT preemption notice: finish "
+             "the step and checkpoint within this many seconds, then exit "
+             "with code 77 (preempt.EXIT_PREEMPTED) so a supervisor restart "
+             "with --auto_resume=1 continues from the drained batch boundary",
     )
 
 
@@ -294,6 +309,12 @@ def cmd_train(args: argparse.Namespace) -> int:
 
         faults.get().configure(args.faults)
 
+    # SIGTERM/SIGINT (cloud preemption notice) → drain at the next batch
+    # boundary, checkpoint, exit with preempt.EXIT_PREEMPTED (see below)
+    from paddle_tpu.core import preempt
+
+    preempt.install(grace_s=args.preempt_grace_s)
+
     pc = parse_config(args.config, args.config_args, emit_proto=False)
     oc = pc.trainer_config.opt_config
     bundle = build_optimizer(oc)
@@ -337,7 +358,13 @@ def cmd_train(args: argparse.Namespace) -> int:
     )
     batch_size = oc.batch_size or 32
 
-    if pc.trainer_config.data_config is None and args.job != "test":
+    if (
+        pc.trainer_config.data_config is None
+        and args.job != "test"
+        and not args.master_endpoints
+    ):
+        # --master_endpoints replaces the provider as the sample source, so a
+        # config without local data sources is legitimate there
         print("config declares no data sources (define_py_data_sources2)", file=sys.stderr)
         return 2
 
@@ -355,6 +382,14 @@ def cmd_train(args: argparse.Namespace) -> int:
         if pc.trainer_config.data_config
         else None
     )
+    if args.master_endpoints:
+        # elastic-cluster data path: this trainer is a stateless consumer of
+        # the shared task queue; the endpoint list gives it a standby to fail
+        # over to when the primary master dies mid-pass
+        from paddle_tpu.data import reader as rd
+        from paddle_tpu.runtime.master import cluster_reader
+
+        reader = rd.batch(cluster_reader(args.master_endpoints), batch_size)
     test_reader = (
         _make_reader(pc.trainer_config.test_data_config, batch_size, is_train=False)
         if pc.trainer_config.test_data_config
@@ -461,17 +496,34 @@ def cmd_train(args: argparse.Namespace) -> int:
             prefetch_depth=args.prefetch_depth,
         )
 
-    trainer.train(
-        reader,
-        num_passes=args.num_passes,
-        event_handler=handler,
-        feeder=feeder,
-        test_reader=test_reader,
-        save_dir=args.save_dir,
-        log_period=args.log_period,
-        auto_resume=args.auto_resume,
-        keep_last_n=args.keep_last_n or None,
-    )
+    from paddle_tpu.trainer.trainer import Preempted
+
+    try:
+        trainer.train(
+            reader,
+            num_passes=args.num_passes,
+            event_handler=handler,
+            feeder=feeder,
+            test_reader=test_reader,
+            save_dir=args.save_dir,
+            log_period=args.log_period,
+            auto_resume=args.auto_resume,
+            keep_last_n=args.keep_last_n or None,
+        )
+    except Preempted as p:
+        # distinct exit code: a supervisor restarting with --auto_resume=1
+        # continues bitwise-identically from the drained batch boundary
+        where = (
+            f"checkpoint saved to {p.checkpoint_dir}"
+            if p.checkpoint_dir
+            else "no mid-pass checkpoint (no --save_dir or grace expired)"
+        )
+        print(
+            f"preempted ({p.reason}): drained at pass {p.pass_id} batch "
+            f"{p.batches_done}; {where}; restart with --auto_resume=1 to "
+            f"continue", file=sys.stderr,
+        )
+        return preempt.EXIT_PREEMPTED
     return 0
 
 

@@ -27,6 +27,14 @@ Known sites (hooks live next to the code they sabotage):
     ckpt_truncate  torn write: truncate an .npz post-rename  (trainer.checkpoint.save_pass)
     nan_loss       poison a float batch slot with NaN        (trainer.SGDTrainer.train)
     kill           raise InjectedKill before a train step    (trainer.SGDTrainer.train)
+    master_kill    master process dies mid-RPC: the server   (runtime.master._Handler)
+                   shuts down abruptly, no reply, no final
+                   snapshot — failover/standby must absorb
+    preempt        simulated preemption notice: sets the     (trainer.SGDTrainer.train)
+                   core.preempt drain flag (SIGTERM analog)
+    conn_reset     client-side partition: the master RPC     (runtime.master.MasterClient)
+                   socket resets after connect; reconnect/
+                   failover path must absorb
 
 Seeding: `PADDLE_TPU_FAULTS_SEED` (or the `seed` argument). Each site gets
 its own `random.Random(f"{seed}:{site}")` stream, so the fire pattern of one

@@ -115,7 +115,8 @@ class EventCounter:
 
 
 # fault-tolerance event counters (trainer divergence guard, pipeline
-# retries/stalls, master client reconnects)
+# retries/stalls, master client reconnects/failovers, trainer-lease
+# evictions, lost task acks, preemption drains, standby takeovers)
 FT_EVENTS = EventCounter()
 
 

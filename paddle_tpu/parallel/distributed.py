@@ -8,7 +8,10 @@ device set feeds one Mesh spanning all hosts; DCN handles cross-slice."""
 
 from __future__ import annotations
 
+import itertools
 import logging
+import os
+import time
 from typing import Optional
 
 import jax
@@ -16,6 +19,11 @@ import jax
 log = logging.getLogger("paddle_tpu.distributed")
 
 _initialized = False
+
+
+class BarrierTimeout(RuntimeError):
+    """A host-level barrier expired; the message names which process ids
+    never arrived (the hang diagnostic a stuck pod actually needs)."""
 
 
 def initialize(
@@ -60,11 +68,68 @@ def process_count() -> int:
     return jax.process_count()
 
 
-def barrier(name: str = "barrier") -> None:
+# every process must call barrier() in the same order, so a shared call
+# counter yields matching (unique) barrier ids without any negotiation
+_barrier_seq = itertools.count()
+
+
+def _coordinator_client():
+    """The jax.distributed KV/barrier client, or None outside a multi-process
+    run (the public alias for global_state moved around across jax versions —
+    go through the _src module that owns it)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client
+    except Exception:  # pragma: no cover - depends on jax internals
+        return None
+
+
+def barrier(
+    name: str = "barrier",
+    timeout_s: Optional[float] = None,
+    _client: Optional[object] = None,
+) -> None:
     """Host-level sync point — parity with ParameterServer2::synchronize
     (ParameterServer2.h:423) and the ThreadBarrier across gradient servers.
-    Implemented as a tiny psum across all devices."""
-    import jax.numpy as jnp
 
-    x = jnp.ones((jax.local_device_count(),))
-    jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()
+    Multi-process runs go through the coordinator's barrier service with a
+    timeout (default $PADDLE_TPU_BARRIER_TIMEOUT_S or 300 s): instead of
+    hanging the pod forever on one dead host, the raised BarrierTimeout says
+    WHICH process ids never arrived (each arrival is recorded in the
+    coordinator KV store first). Single-process runs keep the tiny-psum
+    barrier — there is no remote peer to wait on, so nothing can hang."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("PADDLE_TPU_BARRIER_TIMEOUT_S", "300"))
+    client = _client if _client is not None else _coordinator_client()
+    n = jax.process_count()
+    if client is None or n <= 1:
+        import jax.numpy as jnp
+
+        x = jnp.ones((jax.local_device_count(),))
+        jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()
+        return
+    seq = next(_barrier_seq)
+    bid = f"paddle_tpu/{name}/{seq}"
+    me = jax.process_index()
+    try:
+        # arrival marker for the who-is-missing diagnostic; best-effort
+        client.key_value_set(f"{bid}/arrived/{me}", str(time.time()))
+    except Exception:
+        pass
+    try:
+        client.wait_at_barrier(bid, int(timeout_s * 1000))
+    except Exception as e:
+        arrived = set()
+        try:
+            for key, _val in client.key_value_dir_get(f"{bid}/arrived/"):
+                arrived.add(int(key.rsplit("/", 1)[1]))
+        except Exception:
+            pass
+        missing = sorted(set(range(n)) - arrived)
+        raise BarrierTimeout(
+            f"barrier {name!r} (#{seq}) timed out after {timeout_s:.0f}s on "
+            f"process {me}: waiting for process(es) "
+            f"{missing if missing else '<unknown>'}; arrived "
+            f"{sorted(arrived)} of {n}"
+        ) from e

@@ -19,10 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# feature-detects the check_vma/check_rep kwarg rename across jax versions
+from paddle_tpu.parallel.shard_map_compat import shard_map
 
 Array = jax.Array
 

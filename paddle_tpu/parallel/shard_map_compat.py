@@ -1,0 +1,39 @@
+"""shard_map import + kwarg compatibility across jax versions.
+
+The replication-check kwarg was renamed over jax's life: `check_rep`
+(experimental shard_map, <= 0.4.x) became `check_vma` when shard_map moved to
+the jax namespace. Code in this repo targets the newer spelling; this shim
+feature-detects what the installed jax actually accepts and translates, so
+the same call sites run on jax 0.4.37 (the container's pin) and on current
+jax without a version switch at every call site.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # moved out of experimental in newer jax
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+# the two spellings of the replication/varying-manual-axes check kwarg
+_CHECK_ALIASES = ("check_vma", "check_rep")
+
+
+def shard_map(f=None, /, **kwargs):
+    """`jax.shard_map` with `check_vma`/`check_rep` translated to whichever
+    spelling the installed jax supports (dropped when it supports neither).
+    Usable exactly like the real one, including partial application:
+    `functools.partial(shard_map, mesh=..., in_specs=..., out_specs=...)`."""
+    for given in _CHECK_ALIASES:
+        if given in kwargs and given not in _PARAMS:
+            value = kwargs.pop(given)
+            other = next(a for a in _CHECK_ALIASES if a != given)
+            if other in _PARAMS:
+                kwargs[other] = value
+    if f is None:
+        return functools.partial(shard_map, **kwargs)
+    return _shard_map(f, **kwargs)

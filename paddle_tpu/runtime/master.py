@@ -6,7 +6,24 @@ MasterServer exposes it over TCP (newline-delimited JSON — the Go master's
 net/rpc role) so multi-host trainers share one queue; MasterClient +
 `cluster_reader` replace python/paddle/v2/master/client.py:15 (the ctypes→Go
 reader shim): trainers are stateless task consumers pulling recordio shard
-lists."""
+lists.
+
+Cluster-level failure is a first-class code path here:
+
+- **Failover**: MasterClient takes an endpoint *list* ("a:p,b:p") and rotates
+  through it inside its existing reconnect/backoff loop; `standby_master`
+  watches a primary and takes over from the shared snapshot the moment it
+  dies (pending tasks snapshot as todo, so lost leases re-dispatch — the Go
+  master's etcd-recovery discipline, service.go:166).
+- **Membership**: trainers `register` for a lease and renew it via
+  `heartbeat` (every RPC bearing a trainer_id renews implicitly — RPCs stay
+  retry-exact, per "RPC Considered Harmful"). An expired trainer's pending
+  tasks are re-queued *eagerly*, not left to the per-task timeout; live and
+  evicted counts ride in `stats()`.
+- **Chaos**: the seeded sites `master_drop` (RPC vanishes), `master_kill`
+  (server dies mid-RPC, no final snapshot) and `conn_reset` (client socket
+  resets) make every failover path deterministic and testable.
+"""
 
 from __future__ import annotations
 
@@ -19,13 +36,48 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Any, Callable, Iterator, List, Optional, Sequence
+import uuid
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple, Union
 
 from paddle_tpu.core import faults, stats
 from paddle_tpu.runtime import native
 from paddle_tpu.runtime import recordio
 
 log = logging.getLogger("paddle_tpu.master")
+
+Endpoint = Tuple[str, int]
+EndpointsLike = Union[str, Endpoint, Sequence[Union[str, Endpoint]]]
+
+
+def parse_endpoints(address: EndpointsLike) -> List[Endpoint]:
+    """Normalize one endpoint or a failover list into [(host, port), ...].
+
+    Accepts a (host, port) tuple, "host:port", the CLI's comma form
+    "a:p1,b:p2", or any sequence mixing those."""
+    if isinstance(address, str):
+        parts = [p.strip() for p in address.split(",") if p.strip()]
+    elif (
+        isinstance(address, (tuple, list))
+        and len(address) == 2
+        and isinstance(address[0], str)
+        and isinstance(address[1], int)
+    ):
+        parts = [address]
+    else:
+        parts = list(address)
+    out: List[Endpoint] = []
+    for p in parts:
+        if isinstance(p, str):
+            host, sep, port = p.rpartition(":")
+            if not sep:
+                raise ValueError(f"bad master endpoint {p!r}: want host:port")
+            out.append((host, int(port)))
+        else:
+            host, port = p
+            out.append((str(host), int(port)))
+    if not out:
+        raise ValueError(f"no master endpoints in {address!r}")
+    return out
 
 
 class TaskMaster:
@@ -87,12 +139,18 @@ class TaskMaster:
         }
 
     def snapshot(self, path: str) -> None:
+        if self._m is None:  # killed under a debounced writer — not a segfault
+            raise OSError("snapshot on a closed TaskMaster")
         if self._lib.pt_master_snapshot(self._m, path.encode()) != 0:
             raise OSError(f"snapshot to {path} failed")
 
     def restore(self, path: str) -> None:
         if self._lib.pt_master_restore(self._m, path.encode()) != 0:
             raise OSError(f"restore from {path} failed")
+
+    @property
+    def closed(self) -> bool:
+        return self._m is None
 
     def close(self) -> None:
         if self._m:
@@ -107,15 +165,163 @@ class TaskMaster:
 
 
 # ---------------------------------------------------------------------------
+# Trainer membership: register/heartbeat leases + eager re-queue on eviction
+# ---------------------------------------------------------------------------
+
+
+class _Membership:
+    """Soft-state trainer leases (go/master's etcd TTL keys, in-process).
+
+    Any RPC bearing a trainer_id renews — or adopts — the lease, so a
+    failover to a standby that never saw `register` heals itself on the next
+    request instead of erroring (retry-exact RPCs). Pending-task ownership is
+    tracked so an expired trainer's tasks can be re-queued eagerly."""
+
+    def __init__(self, lease_s: float):
+        self.lease_s = float(lease_s)
+        self._lock = threading.Lock()
+        self._last_seen: Dict[str, float] = {}
+        self._owned: Dict[str, Set[int]] = {}
+        self._owner: Dict[int, str] = {}
+        self._next = 0
+        # server-unique prefix: ids minted by a primary and its standby never
+        # collide, so an adopted lease is unambiguous
+        self._prefix = uuid.uuid4().hex[:6]
+        self.evicted = 0
+
+    def register(self) -> str:
+        with self._lock:
+            tid = f"tr-{self._prefix}-{self._next}"
+            self._next += 1
+            self._last_seen[tid] = time.monotonic()
+            return tid
+
+    def note_seen(self, tid: Optional[str]) -> None:
+        if not tid:
+            return
+        with self._lock:
+            self._last_seen[tid] = time.monotonic()
+
+    def own(self, tid: Optional[str], task_id: int) -> None:
+        if not tid:
+            return
+        with self._lock:
+            self._owned.setdefault(tid, set()).add(task_id)
+            self._owner[task_id] = tid
+
+    def release(self, task_id: int) -> None:
+        with self._lock:
+            tid = self._owner.pop(task_id, None)
+            if tid is not None:
+                self._owned.get(tid, set()).discard(task_id)
+
+    def drop(self, tid: str) -> Set[int]:
+        """Forget a trainer (graceful deregister or eviction); returns the
+        task ids it still held, for the caller to re-queue."""
+        with self._lock:
+            self._last_seen.pop(tid, None)
+            tasks = self._owned.pop(tid, set())
+            for t in tasks:
+                self._owner.pop(t, None)
+            return tasks
+
+    def expired(self) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                tid for tid, seen in self._last_seen.items()
+                if now - seen > self.lease_s
+            ]
+
+    @property
+    def live(self) -> int:
+        with self._lock:
+            return len(self._last_seen)
+
+
+class _SnapshotPolicy:
+    """Debounced, atomic snapshot writes OUTSIDE the RPC lock.
+
+    The native snapshot takes the master's own mutex, so the only thing the
+    RPC lock was buying during the write was a full stall of every other
+    trainer behind one fsync. Writes go to a temp file + rename (never a torn
+    snapshot for a standby to restore), rate-limited to at most once per
+    `every` acks and once per `interval_s` seconds."""
+
+    def __init__(self, path: str, every: int = 1, interval_s: float = 0.0):
+        self.path = path
+        self.every = max(1, int(every))
+        self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._acks = 0
+        self._last = 0.0  # monotonic; 0 = never written
+        self.failures = 0
+
+    def note_ack(self) -> bool:
+        """Record one durable-progress event; True when a snapshot is due."""
+        with self._lock:
+            self._acks += 1
+            return self._due_locked()
+
+    def _due_locked(self) -> bool:
+        if self._acks < self.every:
+            return False
+        if self.interval_s and time.monotonic() - self._last < self.interval_s:
+            return False
+        return True
+
+    def pending(self) -> bool:
+        """Acks recorded but not yet made durable (reaper/stop flush them).
+        Before the FIRST write, sub-threshold acks stay debounced (stop()
+        still flushes them) — `_last == 0` must not read as 'interval long
+        since elapsed'."""
+        with self._lock:
+            if self._acks == 0:
+                return False
+            if not self.interval_s:
+                return True
+            if self._last == 0.0:
+                return False
+            return time.monotonic() - self._last >= self.interval_s
+
+    def write(self, master: TaskMaster) -> None:
+        with self._lock:
+            self._acks = 0
+            self._last = time.monotonic()
+        with self._write_lock:
+            tmp = f"{self.path}.{os.getpid()}.tmp"
+            try:
+                master.snapshot(tmp)
+                os.replace(tmp, self.path)
+            except OSError as e:
+                # progress was acked to the trainer but NOT made durable — a
+                # crash now replays those tasks; say so instead of silently
+                # losing recovery fidelity
+                self.failures += 1
+                log.warning(
+                    "master snapshot to %s failed (%s); a crash before the "
+                    "next successful snapshot will re-dispatch acked tasks",
+                    self.path, e,
+                )
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+
+
+# ---------------------------------------------------------------------------
 # TCP service (the Go master's RPC role), newline-delimited JSON
 # ---------------------------------------------------------------------------
 
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
-        master: TaskMaster = self.server.master  # type: ignore[attr-defined]
-        lock: threading.Lock = self.server.master_lock  # type: ignore[attr-defined]
-        snapshot_path = self.server.snapshot_path  # type: ignore[attr-defined]
+        ms: MasterServer = self.server.ctx  # type: ignore[attr-defined]
+        master = ms.master
+        lock = ms.master_lock
         for line in self.rfile:
             try:
                 req = json.loads(line)
@@ -128,7 +334,37 @@ class _Handler(socketserver.StreamRequestHandler):
                 # connection without processing or replying; the client's
                 # reconnect/backoff path has to absorb it
                 return
+            if faults.get().fire("master_kill"):
+                # chaos hook: the master process dies mid-RPC — no reply, no
+                # final snapshot, every open connection severed; only a
+                # standby restoring the last on-disk snapshot saves the pass
+                log.warning("chaos: master_kill fired — dying without reply")
+                ms.kill()
+                return
+            trainer_id = req.get("trainer_id")
+            ms.membership.note_seen(trainer_id)
+            # (expired leases are swept by the reaper thread every lease_s/4 —
+            # that bound IS the eager-requeue guarantee; scanning again per
+            # RPC would only add membership-lock traffic to the hot path)
+            # membership RPCs never touch the native queue — answered outside
+            # master_lock (drop_trainer takes it itself for the re-queue)
+            if method == "register":
+                self._reply({
+                    "trainer_id": ms.membership.register(),
+                    "lease_s": ms.membership.lease_s,
+                })
+                continue
+            if method == "heartbeat":
+                # note_seen above already renewed (or adopted) the lease
+                self._reply({"ok": bool(trainer_id)})
+                continue
+            if method == "deregister":
+                self._reply({"ok": ms.drop_trainer(trainer_id, evict=False)})
+                continue
+            snapshot_due = False
             with lock:
+                if master.closed:  # killed under us — sever like a crash
+                    return
                 if method == "get_task":
                     got = master.get_task()
                     if got is None:
@@ -137,24 +373,19 @@ class _Handler(socketserver.StreamRequestHandler):
                         resp = {"pass_finished": True}
                     else:
                         resp = {"task_id": got[0], "shards": got[1]}
+                        ms.membership.own(trainer_id, got[0])
                 elif method == "task_finished":
-                    ok = master.task_finished(int(req["task_id"]))
+                    tid = int(req["task_id"])
+                    ok = master.task_finished(tid)
+                    ms.membership.release(tid)
                     resp = {"ok": ok}
-                    if snapshot_path:
-                        try:
-                            master.snapshot(snapshot_path)
-                        except OSError as e:
-                            # progress was acked to the trainer but NOT made
-                            # durable — a crash now replays this task; say so
-                            # instead of silently losing recovery fidelity
-                            self.server.snapshot_failures += 1  # type: ignore[attr-defined]
-                            log.warning(
-                                "master snapshot to %s failed (%s); a crash "
-                                "before the next successful snapshot will "
-                                "re-dispatch acked tasks", snapshot_path, e,
-                            )
+                    if ok and ms.snap is not None:
+                        snapshot_due = ms.snap.note_ack()
                 elif method == "task_failed":
-                    resp = {"ok": master.task_failed(int(req["task_id"]))}
+                    tid = int(req["task_id"])
+                    ok = master.task_failed(tid)
+                    ms.membership.release(tid)
+                    resp = {"ok": ok}
                 elif method == "set_dataset":
                     master.set_dataset(
                         req["shards"], int(req.get("chunks_per_task", 1))
@@ -168,21 +399,35 @@ class _Handler(socketserver.StreamRequestHandler):
                     }
                 elif method == "stats":
                     resp = master.stats()
-                    resp["snapshot_failures"] = (
-                        self.server.snapshot_failures  # type: ignore[attr-defined]
-                    )
+                    resp["snapshot_failures"] = ms.snapshot_failures
+                    resp["live_trainers"] = ms.membership.live
+                    resp["evicted_trainers"] = ms.membership.evicted
                 else:
                     resp = {"err": f"unknown method {method!r}"}
+            if snapshot_due:
+                # the write happens OUTSIDE master_lock: other trainers keep
+                # getting tasks while this thread does file I/O (the native
+                # snapshot takes its own internal mutex for a consistent view)
+                ms.snap.write(master)
             self._reply(resp)
 
     def _reply(self, obj: Any) -> None:
-        self.wfile.write(json.dumps(obj).encode() + b"\n")
-        self.wfile.flush()
+        try:
+            self.wfile.write(json.dumps(obj).encode() + b"\n")
+            self.wfile.flush()
+        except (OSError, ValueError):
+            pass  # peer vanished mid-reply; its retry path handles it
 
 
 class MasterServer:
     """Threaded TCP wrapper; start()/stop(); port 0 picks a free port (the
-    reference's in-process-localhost test idiom, test_CompareSparse.cpp:65)."""
+    reference's in-process-localhost test idiom, test_CompareSparse.cpp:65).
+
+    lease_s: trainer membership lease — a trainer silent for longer is
+    evicted and its pending tasks are re-queued immediately.
+    snapshot_every / snapshot_interval_s: debounce for the per-ack snapshot
+    (at most once per N acks and once per T seconds; the reaper thread and
+    stop() flush anything still pending)."""
 
     def __init__(
         self,
@@ -190,19 +435,31 @@ class MasterServer:
         host: str = "127.0.0.1",
         port: int = 0,
         snapshot_path: Optional[str] = None,
+        lease_s: float = 10.0,
+        snapshot_every: int = 1,
+        snapshot_interval_s: float = 0.0,
     ):
         self.master = master or TaskMaster()
+        self.master_lock = threading.Lock()
+        self.membership = _Membership(lease_s)
+        self.snap = (
+            _SnapshotPolicy(snapshot_path, snapshot_every, snapshot_interval_s)
+            if snapshot_path
+            else None
+        )
+        self.snapshot_path = snapshot_path
         self._srv = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
         )
         self._srv.daemon_threads = True
-        self._srv.master = self.master  # type: ignore[attr-defined]
-        self._srv.master_lock = threading.Lock()  # type: ignore[attr-defined]
-        self._srv.snapshot_path = snapshot_path  # type: ignore[attr-defined]
-        self._srv.snapshot_failures = 0  # type: ignore[attr-defined]
+        self._srv.ctx = self  # type: ignore[attr-defined]
         if snapshot_path and os.path.exists(snapshot_path):
             self.master.restore(snapshot_path)  # crash recovery (service.go:166)
         self._thread: Optional[threading.Thread] = None
+        self._reaper: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._stopped = False
+        self._killed = False
 
     @property
     def address(self) -> tuple:
@@ -210,53 +467,323 @@ class MasterServer:
 
     @property
     def snapshot_failures(self) -> int:
-        return self._srv.snapshot_failures  # type: ignore[attr-defined]
+        return self.snap.failures if self.snap is not None else 0
+
+    @property
+    def alive(self) -> bool:
+        return (
+            self._thread is not None
+            and self._thread.is_alive()
+            and not self._stopped
+            and not self._killed
+        )
+
+    def evict_expired(self) -> int:
+        """Drop trainers whose lease lapsed; re-queue their pending tasks NOW
+        (the per-task timeout would get there eventually — minutes later)."""
+        n = 0
+        for tid in self.membership.expired():
+            if self.drop_trainer(tid, evict=True):
+                n += 1
+        return n
+
+    def drop_trainer(self, tid: Optional[str], evict: bool) -> bool:
+        if not tid:
+            return False
+        tasks = self.membership.drop(tid)
+        requeued = 0
+        with self.master_lock:
+            if not self.master.closed:
+                for t in tasks:
+                    if self.master.task_failed(t):
+                        requeued += 1
+        if evict:
+            self.membership.evicted += 1
+            stats.FT_EVENTS.incr("trainer_evicted")
+            log.warning(
+                "trainer %s lease expired (%gs); evicted, %d pending task(s) "
+                "re-queued eagerly", tid, self.membership.lease_s, requeued,
+            )
+        elif requeued:
+            log.info(
+                "trainer %s deregistered with %d task(s) in flight; re-queued",
+                tid, requeued,
+            )
+        return True
+
+    def _reap_loop(self) -> None:
+        period = max(0.05, min(1.0, self.membership.lease_s / 4.0))
+        while not self._stop_evt.wait(period):
+            self.evict_expired()
+            if self.snap is not None and self.snap.pending():
+                # quiet-period flush: acks below the debounce threshold still
+                # become durable without waiting for the next burst
+                with self.master_lock:
+                    closed = self.master.closed
+                if not closed:
+                    self.snap.write(self.master)
 
     def start(self) -> "MasterServer":
         self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
         self._thread.start()
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+        self._reaper.start()
         return self
 
     def stop(self) -> None:
-        self._srv.shutdown()
+        """Graceful shutdown: stop serving, flush a final snapshot, close the
+        native handle. Idempotent (and safe after kill())."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._stop_evt.set()
+        if self._thread is not None:  # shutdown() hangs if serve never ran
+            self._srv.shutdown()
         self._srv.server_close()
+        if self._reaper is not None:
+            self._reaper.join(timeout=5.0)
+        if not self._killed and self.snap is not None and not self.master.closed:
+            self.snap.write(self.master)
+        # the native TaskMaster handle used to leak here — close it (close()
+        # is a no-op on an already-closed handle)
+        self._close_master()
+
+    def _close_master(self) -> None:
+        """Destroy the native handle serialized against BOTH in-flight RPC
+        dispatch (master_lock) and any debounced snapshot writer that runs
+        outside it (_write_lock) — never a use-after-free under the lib."""
+        if self.snap is not None:
+            with self.snap._write_lock, self.master_lock:
+                self.master.close()
+        else:
+            with self.master_lock:
+                self.master.close()
+
+    def kill(self) -> None:
+        """Crash semantics (chaos master_kill): stop serving abruptly — NO
+        final snapshot, so recovery exercises the last debounced on-disk
+        state, exactly like a real master death."""
+        if self._killed or self._stopped:
+            return
+        self._killed = True
+        self._stop_evt.set()
+
+        def _die():
+            try:
+                if self._thread is not None:
+                    self._srv.shutdown()
+                self._srv.server_close()
+            except OSError:
+                pass
+            self._close_master()
+
+        # shutdown() must not run on a handler thread holding the serve loop's
+        # attention — a dedicated thread severs everything without deadlock
+        threading.Thread(target=_die, daemon=True).start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+
+def standby_master(
+    primary: EndpointsLike,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    snapshot_path: Optional[str] = None,
+    poll_s: float = 0.2,
+    confirm_failures: int = 2,
+    max_wait_s: Optional[float] = None,
+    stop_evt: Optional[threading.Event] = None,
+    **server_kw,
+) -> Optional[MasterServer]:
+    """Warm-standby loop: watch `primary`; when it stays unreachable for
+    `confirm_failures` consecutive probes, restore the shared snapshot and
+    start serving on (host, port). Blocks until takeover (returns the started
+    server), `max_wait_s` elapses, or `stop_evt` is set (returns None).
+
+    The standby does NOT bind its port before takeover — a client failing
+    over early gets connection-refused and keeps rotating. Death evidence is
+    weighed: a refused/unreachable probe counts fully, a TIMED-OUT probe
+    (slow ≠ dead) only half, and a final patient probe must still fail
+    before binding — a briefly-overloaded primary is not usurped. Without a
+    consensus backend this is still a heuristic: a primary alive on the far
+    side of a real network partition can double-serve; production
+    deployments should fence via the shared snapshot storage."""
+    (phost, pport) = parse_endpoints(primary)[0]
+    misses = 0.0
+    deadline = time.monotonic() + max_wait_s if max_wait_s is not None else None
+    while True:
+        if stop_evt is not None and stop_evt.is_set():
+            return None
+        if deadline is not None and time.monotonic() > deadline:
+            return None
+        try:
+            socket.create_connection((phost, pport), timeout=1.0).close()
+            misses = 0.0
+        except TimeoutError:
+            misses += 0.5  # slow ≠ dead: timeouts need twice the evidence
+        except OSError:
+            misses += 1.0
+        if misses >= confirm_failures:
+            try:  # final confirmation, patient timeout: live beats standby
+                socket.create_connection((phost, pport), timeout=3.0).close()
+                misses = 0.0
+            except OSError:
+                break
+        time.sleep(poll_s)
+    log.warning(
+        "standby: primary %s:%d unreachable %d times — taking over on "
+        "%s:%d from snapshot %s", phost, pport, misses, host, port,
+        snapshot_path,
+    )
+    stats.FT_EVENTS.incr("master_takeover")
+    return MasterServer(
+        host=host, port=port, snapshot_path=snapshot_path, **server_kw
+    ).start()
+
+
+# exit code of a served master that died to the master_kill chaos site —
+# distinct from 0 (clean stop) so a supervisor/test can tell crash from stop
+KILLED_EXIT = 17
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    """`python -m paddle_tpu.runtime.master serve|standby ...` — a master (or
+    warm standby) as its own OS process, for the multi-process chaos
+    scenarios in benchmarks/chaos_bench.py and tests/test_cluster.py."""
+    import argparse
+    import signal as _signal
+
+    ap = argparse.ArgumentParser(prog="paddle_tpu.runtime.master")
+    sub = ap.add_subparsers(dest="role", required=True)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--host", default="127.0.0.1")
+    common.add_argument("--port", type=int, required=True)
+    common.add_argument("--snapshot", default=None)
+    common.add_argument("--lease_s", type=float, default=10.0)
+    common.add_argument("--snapshot_every", type=int, default=1)
+    common.add_argument("--snapshot_interval_s", type=float, default=0.0)
+    common.add_argument("--timeout_s", type=float, default=60.0)
+    common.add_argument("--failure_max", type=int, default=3)
+    common.add_argument("--faults", default=None)
+    common.add_argument("--faults_seed", type=int, default=0)
+    sub.add_parser("serve", parents=[common])
+    st = sub.add_parser("standby", parents=[common])
+    st.add_argument("--primary", required=True, help="host:port to watch")
+    st.add_argument("--poll_s", type=float, default=0.2)
+    st.add_argument("--max_wait_s", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    if args.faults:
+        faults.get().configure(args.faults, args.faults_seed)
+
+    def build() -> MasterServer:
+        return MasterServer(
+            TaskMaster(timeout_s=args.timeout_s, failure_max=args.failure_max),
+            host=args.host,
+            port=args.port,
+            snapshot_path=args.snapshot,
+            lease_s=args.lease_s,
+            snapshot_every=args.snapshot_every,
+            snapshot_interval_s=args.snapshot_interval_s,
+        ).start()
+
+    if args.role == "serve":
+        server = build()
+    else:
+        got = standby_master(
+            args.primary,
+            host=args.host,
+            port=args.port,
+            snapshot_path=args.snapshot,
+            poll_s=args.poll_s,
+            max_wait_s=args.max_wait_s,
+            master=TaskMaster(
+                timeout_s=args.timeout_s, failure_max=args.failure_max
+            ),
+            lease_s=args.lease_s,
+            snapshot_every=args.snapshot_every,
+            snapshot_interval_s=args.snapshot_interval_s,
+        )
+        if got is None:
+            print(json.dumps({"role": args.role, "takeover": False}), flush=True)
+            return 3
+        server = got
+
+    _signal.signal(_signal.SIGTERM, lambda *_: server.stop())
+    _signal.signal(_signal.SIGINT, lambda *_: server.stop())
+    print(
+        json.dumps({"role": args.role, "address": list(server.address)}),
+        flush=True,
+    )
+    while server.alive:
+        time.sleep(0.05)
+    # distinguish the chaos master_kill crash from a clean SIGTERM stop
+    return KILLED_EXIT if server._killed else 0
 
 
 class MasterClient:
-    """Blocking line-JSON client with reconnect (go/master/client.go parity).
+    """Blocking line-JSON client with reconnect + endpoint failover
+    (go/master/client.go parity).
 
+    `address` may be one endpoint or a failover list ((h, p), "h:p",
+    "a:p1,b:p2", or a sequence of those — the CLI's --master_endpoints form).
     Failed calls reconnect and retry with bounded exponential backoff plus
     jitter (the Go client's backoff discipline; jitter keeps a restarted
-    master from being stampeded by every trainer retrying in lockstep).
-    After `retries` attempts the terminal ConnectionError names the method,
-    the address, the attempt count and the last underlying error."""
+    master from being stampeded by every trainer retrying in lockstep),
+    rotating to the next endpoint on every reconnect so a dead primary's
+    standby is found inside the same loop. After `retries` attempts
+    (default: enough for several full rotations) the terminal ConnectionError
+    names the method, the endpoints, the attempt count and the last
+    underlying error."""
 
     def __init__(
         self,
-        address: tuple,
+        address: EndpointsLike,
         timeout: float = 30.0,
-        retries: int = 5,
+        retries: Optional[int] = None,
         backoff_base: float = 0.05,
         backoff_max: float = 2.0,
     ):
-        self.address = tuple(address)
+        self.endpoints = parse_endpoints(address)
         self.timeout = timeout
-        self.retries = max(1, int(retries))
+        self.retries = (
+            max(1, int(retries))
+            if retries is not None
+            else max(5, 4 * len(self.endpoints))
+        )
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
+        self._i = 0
         self._sock: Optional[socket.socket] = None
         self._rfile = None
+
+    @property
+    def address(self) -> Endpoint:
+        """The endpoint currently in use (compat with the single-address API)."""
+        return self.endpoints[self._i]
 
     def _connect(self):
         if self._sock is None:
             self._sock = socket.create_connection(self.address, timeout=self.timeout)
             self._rfile = self._sock.makefile("rb")
 
+    def _rotate(self) -> None:
+        if len(self.endpoints) > 1:
+            self._i = (self._i + 1) % len(self.endpoints)
+            stats.FT_EVENTS.incr("master_failover")
+            log.warning("master failover: trying endpoint %s:%d", *self.address)
+
     def call(self, method: str, **kw) -> dict:
         last_err: Optional[Exception] = None
         for attempt in range(self.retries):
             try:
                 self._connect()
+                if faults.get().fire("conn_reset"):
+                    # chaos hook: network partition/RST between trainer and
+                    # master — the reconnect/failover path must absorb it
+                    raise ConnectionResetError("injected conn_reset (chaos)")
                 msg = json.dumps({"method": method, **kw}).encode() + b"\n"
                 self._sock.sendall(msg)
                 line = self._rfile.readline()
@@ -267,6 +794,7 @@ class MasterClient:
                 last_err = e
                 self.close()
                 stats.FT_EVENTS.incr("master_reconnect")
+                self._rotate()
                 if attempt + 1 < self.retries:
                     delay = min(self.backoff_max, self.backoff_base * 2 ** attempt)
                     delay *= 0.5 + random.random() / 2  # full-jitter in [.5d, d)
@@ -277,7 +805,7 @@ class MasterClient:
                     )
                     time.sleep(delay)
         raise ConnectionError(
-            f"master RPC {method!r} to {self.address} failed after "
+            f"master RPC {method!r} to {self.endpoints} failed after "
             f"{self.retries} attempts; giving up (last error: "
             f"{type(last_err).__name__}: {last_err})"
         ) from last_err
@@ -292,23 +820,89 @@ class MasterClient:
             self._rfile = None
 
 
+class _Heartbeater:
+    """Background lease renewal on its OWN connection (the reader's socket is
+    busy inside blocking calls; sharing it would interleave frames)."""
+
+    def __init__(
+        self,
+        address: EndpointsLike,
+        ident: Dict[str, Any],
+        client_kw: Optional[dict] = None,
+    ):
+        self._ident = ident
+        self._client = MasterClient(address, **(client_kw or {}))
+        self._evt = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="master-heartbeat", daemon=True
+        )
+
+    def start(self) -> "_Heartbeater":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            period = max(0.05, float(self._ident.get("lease_s", 10.0)) / 3.0)
+            if self._evt.wait(period):
+                return
+            tid = self._ident.get("trainer_id")
+            if tid is None:
+                continue
+            try:
+                self._client.call("heartbeat", trainer_id=tid)
+            except ConnectionError:
+                # terminal after retries+failover — the lease will lapse and
+                # the master re-queues our tasks; the reader's own calls will
+                # surface the outage, nothing more to do here
+                stats.FT_EVENTS.incr("heartbeat_lost")
+
+    def stop(self) -> None:
+        self._evt.set()
+        self._thread.join(timeout=5.0)
+        self._client.close()
+
+
 def cluster_reader(
-    master_address: tuple,
+    master_address: EndpointsLike,
     deserialize: Callable[[bytes], Any] = None,
     poll_interval: float = 0.5,
+    register: bool = True,
+    client_kw: Optional[dict] = None,
 ) -> Callable[[], Iterator[Any]]:
     """v2 cluster reader (master/client.py:15): pull tasks from the master,
     stream their recordio shards, ack on completion, report failures. One
-    call of the returned reader = one pass."""
+    call of the returned reader = one pass.
+
+    `master_address` may be a failover list (see MasterClient). With
+    `register=True` the reader takes out a membership lease and renews it
+    from a background heartbeat thread, so a trainer that dies mid-task is
+    evicted and its tasks re-queued eagerly rather than after the per-task
+    timeout; the lease is released (`deregister`) on a clean pass end."""
     import pickle
 
     deserialize = deserialize or pickle.loads
 
     def reader() -> Iterator[Any]:
-        client = MasterClient(master_address)
+        client = MasterClient(master_address, **(client_kw or {}))
+        ident: Dict[str, Any] = {"trainer_id": None, "lease_s": 10.0}
+        hb: Optional[_Heartbeater] = None
         try:
+            if register:
+                resp = client.call("register")
+                if "trainer_id" in resp:
+                    ident["trainer_id"] = resp["trainer_id"]
+                    ident["lease_s"] = float(resp.get("lease_s", 10.0))
+                    hb = _Heartbeater(
+                        master_address, ident, client_kw=client_kw
+                    ).start()
+            id_kw = (
+                {"trainer_id": ident["trainer_id"]}
+                if ident["trainer_id"] is not None
+                else {}
+            )
             while True:
-                resp = client.call("get_task")
+                resp = client.call("get_task", **id_kw)
                 if resp.get("pass_finished"):
                     return
                 if resp.get("retry"):
@@ -317,11 +911,46 @@ def cluster_reader(
                 task_id, shards = resp["task_id"], resp["shards"]
                 try:
                     yield from recordio.read_shards(shards, deserialize)
-                except Exception:
-                    client.call("task_failed", task_id=task_id)
+                except BaseException:
+                    # the failure ack itself can fail (master died too) — it
+                    # must never mask the original shard-read error; the lease
+                    # timeout replays the task either way
+                    try:
+                        client.call("task_failed", task_id=task_id, **id_kw)
+                    except ConnectionError as ack_err:
+                        stats.FT_EVENTS.incr("task_ack_failed")
+                        log.warning(
+                            "task_failed ack for task %d lost (%s); the task "
+                            "replays after its lease times out", task_id, ack_err,
+                        )
                     raise
-                client.call("task_finished", task_id=task_id)
+                try:
+                    client.call("task_finished", task_id=task_id, **id_kw)
+                except ConnectionError as ack_err:
+                    # terminal (retries + failover exhausted): progress was
+                    # made but not recorded — the task WILL be re-dispatched
+                    # after its lease expires, so downstream consumers see its
+                    # records twice; count it and say so
+                    stats.FT_EVENTS.incr("task_ack_failed")
+                    log.warning(
+                        "task_finished ack for task %d failed terminally (%s); "
+                        "the task will replay after its lease times out — "
+                        "records from it will be delivered again", task_id, ack_err,
+                    )
         finally:
+            if hb is not None:
+                hb.stop()
+            if ident["trainer_id"] is not None:
+                try:
+                    client.call("deregister", trainer_id=ident["trainer_id"])
+                except ConnectionError:
+                    pass  # lease will simply expire
             client.close()
 
     return reader
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

@@ -6,6 +6,7 @@ from paddle_tpu.trainer.events import (  # noqa: F401
 )
 from paddle_tpu.trainer.trainer import (  # noqa: F401
     DivergenceError,
+    Preempted,
     SGDTrainer,
     TrainState,
 )

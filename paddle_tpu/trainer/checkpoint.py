@@ -221,6 +221,19 @@ def find_latest_valid_pass(save_dir: str) -> Optional[int]:
     return None
 
 
+def pass_manifest(save_dir: str, pass_id: int) -> Dict[str, Any]:
+    """The manifest of one pass dir, or {} — how auto-resume learns whether a
+    checkpoint is a preemption-drain mid-pass save (extra.mid_pass +
+    extra.batches_done) or a normal pass-boundary one."""
+    try:
+        with open(
+            os.path.join(save_dir, f"pass-{pass_id:05d}", "manifest.json")
+        ) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
 def is_v1_model_dir(dirname: str) -> bool:
     """True when `dirname` looks like a reference ParamUtil model directory:
     no manifest.json, and at least one regular file whose 16 leading bytes

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.core import faults, stats
+from paddle_tpu.core import faults, preempt, stats
 from paddle_tpu.data.pipeline import coerce_batch as _coerce_batch
 from paddle_tpu.data.pipeline import is_device_batch
 from paddle_tpu.nn.graph import Argument, Layer, Network
@@ -42,6 +42,34 @@ DIVERGENCE_POLICIES = ("skip_batch", "rollback", "raise")
 
 class DivergenceError(RuntimeError):
     """Raised by divergence_policy="raise" when a step cost goes NaN/Inf."""
+
+
+class Preempted(RuntimeError):
+    """Raised by train() after a preemption-notice drain (core/preempt):
+    the in-flight step finished and — given a save_dir and remaining grace —
+    a CRC-valid mid-pass checkpoint was written. The CLI maps this to exit
+    code `preempt.EXIT_PREEMPTED`; a restart with auto_resume=True continues
+    from exactly this batch boundary."""
+
+    def __init__(
+        self,
+        pass_id: int,
+        batches_done: int,
+        checkpoint_dir: Optional[str],
+        reason: Optional[str] = None,
+    ):
+        self.pass_id = pass_id
+        self.batches_done = batches_done
+        self.checkpoint_dir = checkpoint_dir
+        self.reason = reason
+        where = (
+            f"checkpoint {checkpoint_dir}" if checkpoint_dir
+            else "no checkpoint written"
+        )
+        super().__init__(
+            f"preempted ({reason or 'signal'}) at pass {pass_id} after "
+            f"{batches_done} batch(es); {where}"
+        )
 
 
 class SGDTrainer:
@@ -272,13 +300,24 @@ class SGDTrainer:
         inj = faults.get()
         resume_pass: Optional[int] = None
         resume_pending = False
+        resume_mid = False  # checkpoint is a preemption-drain mid-pass save
+        resume_skip = 0  # batches of resume_pass already applied (mid-pass drain)
         if auto_resume and save_dir is not None:
             resume_pass = ckpt_mod.find_latest_valid_pass(save_dir)
             if resume_pass is not None:
+                extra = ckpt_mod.pass_manifest(save_dir, resume_pass).get(
+                    "extra", {}
+                )
+                if extra.get("mid_pass"):
+                    # preemption-drain checkpoint: pass resume_pass is only
+                    # partially applied — replay it from the drained boundary
+                    resume_mid = True
+                    resume_skip = int(extra.get("batches_done", 0))
                 log.info(
-                    "auto-resume: restoring from %s/pass-%05d "
-                    "(continuing at pass %d)", save_dir, resume_pass,
-                    resume_pass + 1,
+                    "auto-resume: restoring from %s/pass-%05d (continuing at "
+                    "pass %d%s)", save_dir, resume_pass,
+                    resume_pass if resume_mid else resume_pass + 1,
+                    f" batch {resume_skip}" if resume_mid else "",
                 )
                 if self.state is not None:
                     self.load(save_dir, resume_pass)
@@ -286,7 +325,10 @@ class SGDTrainer:
                 else:  # state shapes unknown until the first batch arrives
                     resume_pending = True
         for pass_id in range(num_passes):
-            if resume_pass is not None and pass_id <= resume_pass:
+            if resume_pass is not None and (
+                pass_id < resume_pass
+                or (pass_id == resume_pass and not resume_mid)
+            ):
                 continue  # completed by the run we are resuming
             event_handler(BeginPass(pass_id))
             self.updater.start_pass()
@@ -294,6 +336,26 @@ class SGDTrainer:
             t0 = time.time()
             cost_sum_dev, n_batches, n_diverged = None, 0, 0
             for batch_id, raw in enumerate(reader()):
+                if preempt.requested():
+                    # batch boundary: the previous step completed; drain —
+                    # checkpoint (mid-pass) and raise Preempted. The current
+                    # raw batch is unprocessed and replays after resume.
+                    # Inside a replayed prefix the restored state already
+                    # holds resume_skip batches — never report fewer, or the
+                    # next resume would re-apply some of them.
+                    done = batch_id
+                    if resume_mid and pass_id == resume_pass:
+                        done = max(batch_id, resume_skip)
+                    self._drain_preempt(save_dir, pass_id, done, keep_last_n)
+                if (
+                    resume_skip
+                    and pass_id == resume_pass
+                    and batch_id < resume_skip
+                ):
+                    # replayed prefix of the preempted pass: these batches are
+                    # already folded into the restored state — consume the
+                    # (deterministic) reader past them without stepping
+                    continue
                 # device batches (from a DevicePrefetcher) arrive fed, sharded
                 # and resident — skip the whole host prep leg; dict batches
                 # are already feed-ready (e.g. from a DoubleBuffer that ran
@@ -337,6 +399,14 @@ class SGDTrainer:
                     if inj.fire("kill"):
                         raise faults.InjectedKill(
                             f"injected kill at pass {pass_id} batch {batch_id}"
+                        )
+                    if inj.fire("preempt"):
+                        # simulated preemption notice (SIGTERM analog): only
+                        # sets the drain flag — this batch still steps, the
+                        # NEXT boundary checkpoints and exits ("finish the
+                        # step" semantics)
+                        preempt.get().request(
+                            f"injected preempt at pass {pass_id} batch {batch_id}"
                         )
                     if inj.fire("nan_loss"):
                         batch = _poison_batch(batch)
@@ -429,6 +499,41 @@ class SGDTrainer:
                 self._known_good_pass = (save_dir, resume_pass)
         return self.state
 
+    def _drain_preempt(
+        self,
+        save_dir: Optional[str],
+        pass_id: int,
+        batches_done: int,
+        keep_last_n: Optional[int],
+    ) -> None:
+        """Preemption drain at a batch boundary: persist a mid-pass checkpoint
+        (CRC-valid, `latest`-pointed) unless the grace budget is already
+        spent, then raise Preempted. save() syncs the device, so the
+        checkpoint holds the state AFTER the just-finished step."""
+        guard = preempt.get()
+        saved: Optional[str] = None
+        if self.state is not None and save_dir is not None:
+            if guard.deadline_passed():
+                log.warning(
+                    "preempt drain at pass %d batch %d: grace budget (%.1fs) "
+                    "already spent — exiting WITHOUT a mid-pass checkpoint; "
+                    "resume replays from the last durable one",
+                    pass_id, batches_done, guard.grace_s,
+                )
+            else:
+                saved = self.save(
+                    save_dir, pass_id, keep_last_n=keep_last_n,
+                    mid_pass_batches=batches_done,
+                )
+                self._known_good_pass = (save_dir, pass_id)
+        stats.FT_EVENTS.incr("preempt_drain")
+        log.warning(
+            "preempt drain: stopping at pass %d batch %d (%s)",
+            pass_id, batches_done,
+            f"checkpointed to {saved}" if saved else "no checkpoint",
+        )
+        raise Preempted(pass_id, batches_done, saved, guard.reason)
+
     def _rollback(self, save_dir: Optional[str], pass_id: int, batch_id: int) -> None:
         """Divergence rollback: restore the newest valid checkpoint and halve
         the LR multiplier; with no checkpoint to return to, degrade to
@@ -503,25 +608,37 @@ class SGDTrainer:
         return {"cost": total / max(n, 1), "samples": n}
 
     def save(
-        self, save_dir: str, pass_id: int, keep_last_n: Optional[int] = None
+        self,
+        save_dir: str,
+        pass_id: int,
+        keep_last_n: Optional[int] = None,
+        mid_pass_batches: Optional[int] = None,
     ) -> str:
         """Raw params + optimizer + averaging state are all persisted so
         load() is a true resume; deployment-time averaged weights are
-        recoverable via ModelAverage.averaged_params on the loaded state."""
+        recoverable via ModelAverage.averaged_params on the loaded state.
+
+        mid_pass_batches marks a preemption-drain save: the pass is only
+        applied through that many batches, and auto-resume replays the rest
+        of it instead of skipping to the next pass."""
         assert self.state is not None
         opt_tree = {"opt": self.state["opt"]}
         if self.state["avg"]:
             opt_tree["avg"] = self.state["avg"]
+        extra_meta = {
+            "samples": int(self.state["samples"]),
+            "lr_scale": float(self.state["lr_scale"]),
+        }
+        if mid_pass_batches is not None:
+            extra_meta["mid_pass"] = True
+            extra_meta["batches_done"] = int(mid_pass_batches)
         return ckpt_mod.save_pass(
             save_dir,
             pass_id,
             self.state["params"],
             self.state["states"],
             opt_tree,
-            extra_meta={
-                "samples": int(self.state["samples"]),
-                "lr_scale": float(self.state["lr_scale"]),
-            },
+            extra_meta=extra_meta,
             keep_last_n=keep_last_n,
         )
 

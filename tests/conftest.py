@@ -39,6 +39,9 @@ if not _cache_dir:  # per-run temp dir: in-run dedup only, removed on exit
     _cache_dir = tempfile.mkdtemp(prefix="paddle_tpu_xla_cache_")
     atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
 _cache_dir = enable_compilation_cache(_cache_dir)
+# subprocess-spawning tests (test_cluster, test_distributed) inherit the
+# cache dir via env, so child jax processes reuse this run's compilations
+os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE", _cache_dir)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -85,3 +88,39 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.module.__name__.rsplit(".", 1)[-1] in _SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+
+
+# -- per-test wall-clock timeout (@pytest.mark.timeout(seconds)) --------------
+# The multi-process cluster-chaos tests wait on subprocesses and sockets; a
+# wedged child must fail ITS test, not stall the whole tier-1 run until the
+# outer CI timeout. SIGALRM interrupts even a blocking wait; no plugin needed.
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    import signal as _signal
+    import threading as _threading
+
+    marker = item.get_closest_marker("timeout")
+    usable = (
+        marker is not None
+        and hasattr(_signal, "SIGALRM")
+        and _threading.current_thread() is _threading.main_thread()
+    )
+    if not usable:
+        yield
+        return
+    limit = float(marker.args[0]) if marker.args else 120.0
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} exceeded its {limit:.0f}s per-test timeout"
+        )
+
+    old = _signal.signal(_signal.SIGALRM, _on_alarm)
+    _signal.setitimer(_signal.ITIMER_REAL, limit)
+    try:
+        yield
+    finally:
+        _signal.setitimer(_signal.ITIMER_REAL, 0)
+        _signal.signal(_signal.SIGALRM, old)

@@ -15,11 +15,23 @@ Each worker:
    mesh, feeding only its shard_reader half of the data (grads allreduced by
    the SPMD partitioner over the data axis),
 4. dumps its final parameters + consumed ids for the parent to compare.
+
+Additional role (tests/test_cluster.py cluster-chaos scenarios):
+
+    python distributed_worker.py preempt_trainer <outdir> <mode> [pass batch]
+
+trains a deterministic toy classifier with checkpointing; mode `run` installs
+the core.preempt guard and SIGTERMs ITSELF right after the given (pass,
+batch) step — the real preemption-notice path — exiting with
+preempt.EXIT_PREEMPTED after the drain; `resume` continues the run with
+auto_resume=True; `clean` is the never-preempted oracle. Final params land in
+<outdir>/params_<mode>.npz for the parent's bitwise comparison.
 """
 
 import json
 import os
 import pickle
+import signal
 import sys
 
 import numpy as np
@@ -130,5 +142,72 @@ def main() -> None:
     print(f"worker {pid}: done, final cost {costs[-1]:.4f}", flush=True)
 
 
+def preempt_trainer(argv) -> None:
+    """See module docstring: <outdir> <run|resume|clean> [sig_pass sig_batch]."""
+    outdir, mode = argv[0], argv[1]
+    sig = (int(argv[2]), int(argv[3])) if len(argv) > 3 else (1, 2)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.core import preempt
+    from paddle_tpu.nn import costs as C
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn.graph import reset_name_scope
+    from paddle_tpu.optim import SGD
+    from paddle_tpu.trainer import Preempted, SGDTrainer
+    from paddle_tpu.trainer.events import EndIteration
+
+    dim, classes, batch = 8, 3, 8
+    rs = np.random.RandomState(7)
+    xs = rs.randn(64, dim).astype(np.float32)
+    ys = (np.arange(64) % classes).astype(np.int32)
+
+    def reader():
+        for i in range(0, len(xs), batch):
+            yield {"x": xs[i:i + batch], "label": ys[i:i + batch]}
+
+    reset_name_scope()
+    x = L.Data("x", shape=(dim,))
+    lbl = L.Data("label", shape=())
+    logits = L.Fc(L.Fc(x, 16, act="relu"), classes, act=None)
+    cost = C.ClassificationCost(logits, lbl)
+    tr = SGDTrainer(cost, SGD(learning_rate=0.1), seed=3)
+    save_dir = os.path.join(outdir, "ckpt")
+
+    handler = None
+    if mode == "run":
+        preempt.install(grace_s=30.0)
+
+        def handler(ev):
+            if isinstance(ev, EndIteration) and (ev.pass_id, ev.batch_id) == sig:
+                # the cloud's preemption notice, for real: SIGTERM to self —
+                # the guard's handler sets the drain flag, the next batch
+                # boundary checkpoints and raises Preempted
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        tr.train(
+            reader,
+            num_passes=3,
+            event_handler=handler,
+            save_dir=None if mode == "clean" else save_dir,
+            auto_resume=(mode == "resume"),
+            log_period=1000,
+        )
+    except Preempted as p:
+        print(f"worker preempted: {p}", flush=True)
+        sys.exit(preempt.EXIT_PREEMPTED)
+    np.savez(
+        os.path.join(outdir, f"params_{mode}.npz"),
+        **{k: np.asarray(v) for k, v in tr.state["params"].items()},
+    )
+    print(f"worker {mode}: done", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "preempt_trainer":
+        preempt_trainer(sys.argv[2:])
+    else:
+        main()

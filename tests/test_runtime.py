@@ -346,3 +346,89 @@ def test_recordio_oversized_chunk_header_is_corruption(tmp_path):
 
     r = recordio.Reader(path)
     assert list(r) == []  # framing untrustworthy -> no records, no abort
+
+
+# -- elastic-cluster satellites (ISSUE 3) ------------------------------------
+
+
+def test_master_restart_exactly_once_delivery(tmp_path):
+    """Exactly-once across a master restart: snapshot-on-ack, crash (kill(),
+    NO final snapshot), restore on a NEW port — done == ntasks, discarded ==
+    0, and every record is consumed exactly once."""
+    samples = list(range(36))
+    shards = recordio.convert(
+        str(tmp_path / "ds"), lambda: iter(samples), records_per_file=6
+    )
+    ntasks = len(shards)
+    snap = str(tmp_path / "m.snap")
+    s1 = MasterServer(
+        TaskMaster(timeout_s=30, failure_max=2), snapshot_path=snap
+    ).start()
+    c = MasterClient(s1.address)
+    c.call("set_dataset", shards=shards, chunks_per_task=1)
+    consumed = []
+    for _ in range(2):  # two tasks fully done + acked (each ack snapshots)
+        r = c.call("get_task")
+        consumed += list(recordio.read_shards(r["shards"]))
+        assert c.call("task_finished", task_id=r["task_id"])["ok"]
+    c.close()
+    s1.kill()  # crash semantics: no final snapshot, leases die with it
+    s1.join(timeout=10)
+    assert not s1.alive
+
+    s2 = MasterServer(
+        TaskMaster(timeout_s=30, failure_max=2), snapshot_path=snap
+    ).start()
+    try:
+        rest = list(cluster_reader(s2.address)())
+        assert sorted(consumed + rest) == samples  # exactly once, no dupes
+        st = MasterClient(s2.address).call("stats")
+        assert st["done"] == ntasks and st["discarded"] == 0
+    finally:
+        s2.stop()
+
+
+def test_master_stop_closes_native_handle():
+    m = TaskMaster()
+    server = MasterServer(m).start()
+    server.stop()
+    assert m.closed  # the handle used to leak here
+    server.stop()  # idempotent
+    assert m.closed
+
+
+def test_master_snapshot_debounce(tmp_path):
+    """snapshot_every/interval rate-limit the per-ack write; stop() makes
+    whatever is still pending durable."""
+    snap = str(tmp_path / "m.snap")
+    server = MasterServer(
+        TaskMaster(timeout_s=30, failure_max=2),
+        snapshot_path=snap,
+        snapshot_every=3,
+        snapshot_interval_s=60.0,
+    ).start()
+    try:
+        c = MasterClient(server.address)
+        c.call("set_dataset", shards=[f"s{i}" for i in range(8)])
+        tasks = [c.call("get_task")["task_id"] for _ in range(8)]
+        for t in tasks[:2]:
+            c.call("task_finished", task_id=t)
+        assert not os.path.exists(snap)  # 2 acks < every=3: debounced away
+        c.call("task_finished", task_id=tasks[2])
+        deadline = time.time() + 5
+        while not os.path.exists(snap) and time.time() < deadline:
+            time.sleep(0.02)
+        assert os.path.exists(snap)  # 3rd ack crossed the threshold
+        stamp = os.path.getmtime(snap), os.path.getsize(snap)
+        for t in tasks[3:6]:
+            c.call("task_finished", task_id=t)
+        # 3 more acks but inside the 60s interval: still the old snapshot
+        assert (os.path.getmtime(snap), os.path.getsize(snap)) == stamp
+        c.close()
+    finally:
+        server.stop()
+    # clean stop flushed the pending acks: a restore sees all 6 done
+    m2 = TaskMaster(timeout_s=30, failure_max=2)
+    m2.restore(snap)
+    assert m2.stats()["done"] == 6
+    m2.close()
