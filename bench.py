@@ -21,6 +21,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 import traceback
@@ -47,7 +48,68 @@ def _error_payload(msg: str) -> dict:
     }
 
 
+_PROBE_MEMO: list = []  # in-process memo: [verdict] once probed/cached
+
+
+def _probe_cache_path() -> str | None:
+    """Cross-invocation cache location for the probe verdict. BENCH_r05:
+    every metric re-probed a dead tunnel — 3 runs × 3 retries × 240 s = 12
+    minutes of guaranteed timeouts. Set BENCH_PROBE_CACHE=off to disable."""
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    path = os.environ.get(
+        "BENCH_PROBE_CACHE",
+        # per-uid filename: on a shared host another user's verdict (or an
+        # unwritable sticky-bit file) must not leak into this run
+        os.path.join(
+            tempfile.gettempdir(), f"paddle_tpu_bench_probe_{uid}.json"
+        ),
+    )
+    return None if path.lower() in ("", "off", "none", "0") else path
+
+
 def probe_backend() -> dict | None:
+    """The cached TPU-backend probe verdict: {'platform', 'n'} when the
+    backend came up, None when it is down. Probes at most ONCE per run —
+    in-process calls reuse the memo, and sibling invocations within
+    BENCH_PROBE_CACHE_TTL (default 3600 s) reuse the on-disk verdict file,
+    so a dead tunnel costs its timeout budget a single time."""
+    if _PROBE_MEMO:
+        return _PROBE_MEMO[0]
+    path = _probe_cache_path()
+    try:
+        ttl = float(os.environ.get("BENCH_PROBE_CACHE_TTL", "3600"))
+    except ValueError:  # garbled env var must not kill the whole bench
+        sys.stderr.write("[bench] bad BENCH_PROBE_CACHE_TTL, using 3600s\n")
+        ttl = 3600.0
+    if path:
+        try:
+            with open(path) as f:
+                cached = json.load(f)
+            # bounded on BOTH sides: a garbled/clock-skewed future timestamp
+            # must expire like any stale entry, not pin the verdict forever
+            if 0 <= time.time() - float(cached["time"]) <= ttl:
+                verdict = cached["verdict"]
+                sys.stderr.write(
+                    f"[bench] probe verdict (cached, {path}): {verdict}\n"
+                )
+                _PROBE_MEMO.append(verdict)
+                return verdict
+        except (OSError, ValueError, KeyError, TypeError):
+            pass  # missing/garbled/stale cache → probe for real
+    verdict = _probe_backend_uncached()
+    _PROBE_MEMO.append(verdict)
+    if path:
+        try:  # atomic write: a concurrent bench must never read a torn file
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"verdict": verdict, "time": time.time()}, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # cache is best-effort; the memo still covers this process
+    return verdict
+
+
+def _probe_backend_uncached() -> dict | None:
     """Try to bring up the default (TPU/axon) backend in a child process.
 
     The tunnel backend has two observed failure modes: a fast UNAVAILABLE

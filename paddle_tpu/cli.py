@@ -10,6 +10,7 @@ Usage:
         [--num_passes=N] [--save_dir=DIR] [--trainer_count=N] [--use_tpu=1]
         [--init_model_path=DIR] [--start_pass=N] [--log_period=N] [--job=train|test|time]
         [--auto_resume=1] [--divergence_policy=skip_batch|rollback|raise]
+        [--guard_check_every=N] [--steps_per_dispatch=K] [--async_checkpoint=0|1]
         [--keep_last_n=N] [--faults=SPEC]
         [--master_endpoints=a:p1,b:p2] [--preempt_grace_s=S]
     python -m paddle_tpu dump_config --config=conf.py
@@ -60,6 +61,26 @@ def _train_args(p: argparse.ArgumentParser) -> None:
         "--compile_cache", default=None,
         help="persistent XLA compilation cache dir "
              "(default: $PADDLE_TPU_COMPILE_CACHE, unset = off)",
+    )
+    p.add_argument(
+        "--steps_per_dispatch", type=int, default=1,
+        help="train steps fused into one compiled device dispatch "
+             "(lax.scan over K prefetcher-stacked batches); events, the "
+             "log line and chaos sites then fire per dispatch, not per "
+             "batch. 1 = one dispatch per batch",
+    )
+    p.add_argument(
+        "--guard_check_every", type=int, default=16,
+        help="steps between divergence-guard polls of the device-resident "
+             "diverged counter (reaction latency vs throughput; 1 = react "
+             "at the offending batch like the old per-step sync). Only "
+             "meaningful with --divergence_policy",
+    )
+    p.add_argument(
+        "--async_checkpoint", type=_str2bool, default=True,
+        help="write pass/drain checkpoints on a background thread after a "
+             "non-blocking device→host fetch (zero-stall); 0 = synchronous "
+             "writes on the training thread",
     )
     p.add_argument(
         "--auto_resume", type=_str2bool, default=False,
@@ -355,6 +376,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         parallel=parallel,
         seed=args.seed,
         divergence_policy=args.divergence_policy,
+        guard_check_every=args.guard_check_every,
     )
     batch_size = oc.batch_size or 32
 
@@ -455,6 +477,20 @@ def cmd_train(args: argparse.Namespace) -> int:
     active = [
         (_make_evaluator(ec), names) for ec, names in eval_objs
     ] if eval_objs else []
+    if active and args.steps_per_dispatch > 1:
+        # fused dispatches return no per-batch extra outputs, so evaluator
+        # update() would never run — producing stats over zero samples.
+        # Losing the user's requested metrics silently is worse than losing
+        # the fusion win; fall back loudly.
+        import logging
+
+        logging.getLogger("paddle_tpu.cli").warning(
+            "config declares %d evaluator(s), which need per-batch network "
+            "outputs — --steps_per_dispatch=%d would starve them; falling "
+            "back to steps_per_dispatch=1 (drop the evaluators to keep the "
+            "fused dispatch)", len(active), args.steps_per_dispatch,
+        )
+        args.steps_per_dispatch = 1
 
     def handler(event):
         if isinstance(event, BeginPass):
@@ -488,12 +524,15 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     if args.prefetch_depth > 0 and reader is not None:
         # run the feeder + batch sharding + H2D on a background thread so
-        # host input prep overlaps the donated compiled step
+        # host input prep overlaps the donated compiled step; with
+        # --steps_per_dispatch=K the worker also stacks K batches into one
+        # fused-dispatch payload (one device put per K steps)
         from paddle_tpu.data.pipeline import DevicePrefetcher
 
         reader = DevicePrefetcher(
             reader, feeder, parallel=parallel,
             prefetch_depth=args.prefetch_depth,
+            stack_k=args.steps_per_dispatch,
         )
 
     from paddle_tpu.trainer.trainer import Preempted
@@ -509,6 +548,8 @@ def cmd_train(args: argparse.Namespace) -> int:
             log_period=args.log_period,
             auto_resume=args.auto_resume,
             keep_last_n=args.keep_last_n or None,
+            steps_per_dispatch=args.steps_per_dispatch,
+            async_checkpoint=args.async_checkpoint,
         )
     except Preempted as p:
         # distinct exit code: a supervisor restarting with --auto_resume=1

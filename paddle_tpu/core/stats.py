@@ -114,10 +114,18 @@ class EventCounter:
             self._counts.clear()
 
 
-# fault-tolerance event counters (trainer divergence guard, pipeline
-# retries/stalls, master client reconnects/failovers, trainer-lease
-# evictions, lost task acks, preemption drains, standby takeovers)
+# fault-tolerance event counters (trainer divergence guard — incremented at
+# guard POLLS by the device counter's delta, so one entry may cover a whole
+# guard_check_every window — pipeline retries/stalls, master client
+# reconnects/failovers, trainer-lease evictions, lost task acks, preemption
+# drains, standby takeovers)
 FT_EVENTS = EventCounter()
+
+# Timer names stamped by the async execution runtime (PADDLE_TPU_TIMER):
+#   hostFeed / h2d        input-pipeline legs (trainer or prefetcher worker)
+#   forwardBackward       the device-step segment (syncs only when timing on)
+#   ckptFetch             non-blocking device→host snapshot copy (train thread)
+#   ckptWrite             npz/CRC/v1/retention on the async writer thread
 
 
 # -- recompile / input-pipeline telemetry ------------------------------------

@@ -37,6 +37,25 @@ _STOP = object()
 SKIP = object()  # prepare() return value meaning "drop this item"
 
 
+class StackedBatch(dict):
+    """K feed-ready batches stacked on a leading K axis — the payload of one
+    fused K-step dispatch (SGDTrainer.train(steps_per_dispatch=K) feeds it
+    straight to the lax.scan driver). Still a plain dict of device arrays,
+    so is_device_batch() holds; `k` is the scan width."""
+
+    k: int = 1
+
+
+class _Group(list):
+    """Marker: a stack_k-sized run of raw reader items (worker-side only)."""
+
+
+class _Singles(list):
+    """Marker: prepared single batches the consumer yields one by one — the
+    degraded path for trailing remainders, shape churn inside a group, or
+    groups that lost members to the divisibility filter."""
+
+
 def iter_async(
     reader: Callable,
     prepare: Callable[[Any], Any],
@@ -181,6 +200,15 @@ class DevicePrefetcher:
     feed_retries: transient worker exceptions (feeder/coerce/H2D) are retried
         this many times per batch before propagating (see iter_async);
         deterministic feeder bugs still surface — they just fail every retry.
+    stack_k: >1 groups K consecutive batches on the worker thread, feeds each
+        on host, stacks them into ONE [K, B, ...] array per slot and does ONE
+        device put (shard_batches under DataParallel) — a StackedBatch the
+        trainer runs as a single fused K-step dispatch
+        (train(steps_per_dispatch=K)). Groups that cannot stack — trailing
+        remainder, shape churn inside the group, members dropped by the
+        divisibility filter — degrade to ordinary single device batches, so
+        the sample stream is identical either way. The h2d_delay chaos site
+        then fires once per GROUP (per-dispatch granularity).
 
     One iteration = one pass. Worker exceptions surface in the consumer;
     abandoning the iterator (break / GeneratorExit) retires the worker.
@@ -198,43 +226,116 @@ class DevicePrefetcher:
         prefetch_depth: int = 2,
         device: Optional[Any] = None,
         feed_retries: int = 2,
+        stack_k: int = 1,
     ):
         if prefetch_depth < 1:
             raise ValueError(f"prefetch_depth must be >= 1, got {prefetch_depth}")
+        if stack_k < 1:
+            raise ValueError(f"stack_k must be >= 1, got {stack_k}")
         self.reader = reader
         self.feeder = feeder
         self.parallel = parallel
         self.prefetch_depth = prefetch_depth
         self.device = device
         self.feed_retries = feed_retries
+        self.stack_k = stack_k
 
     def __call__(self):
         return iter(self)
 
-    def _prepare(self, raw: Any) -> Any:
-        """Raw reader item → device-resident batch (SKIP = drop)."""
+    def _feed(self, raw: Any) -> Dict[str, Any]:
+        """Raw reader item → feed-ready host batch (the hostFeed leg)."""
         with stats.timer("hostFeed"):
-            batch = (
+            return (
                 self.feeder(raw)
                 if self.feeder is not None and not isinstance(raw, dict)
                 else coerce_batch(raw)
             )
+
+    def _device_put(self, batch: Dict[str, Any], stacked: bool = False) -> Any:
+        """Feed-ready batch → device-resident batch (the h2d leg). stacked
+        places a [K, B, ...] group with the scan-axis sharding; the chaos
+        sleep fires once per call either way = once per dispatch."""
+        faults.get().sleep("h2d_delay")  # chaos hook: slow transfer leg
+        if self.parallel is not None:
+            put = self.parallel.shard_batches if stacked else self.parallel.shard_batch
+            return put(batch)
+        if self.device is not None:
+            return {k: jax.device_put(v, self.device) for k, v in batch.items()}
+        return {k: jax.device_put(v) for k, v in batch.items()}
+
+    def _prepare(self, raw: Any) -> Any:
+        """Raw reader item → device-resident batch (SKIP = drop)."""
+        batch = self._feed(raw)
         with stats.timer("h2d"):
-            faults.get().sleep("h2d_delay")  # chaos hook: slow transfer leg
-            if self.parallel is not None:
-                if not self.parallel.batch_divisible(batch):
-                    log.warning(
-                        "prefetcher dropping batch: size not divisible by "
-                        "the mesh data axis"
-                    )
-                    return SKIP
-                return self.parallel.shard_batch(batch)
-            if self.device is not None:
-                return {k: jax.device_put(v, self.device) for k, v in batch.items()}
-            return {k: jax.device_put(v) for k, v in batch.items()}
+            if self.parallel is not None and not self.parallel.batch_divisible(
+                batch
+            ):
+                log.warning(
+                    "prefetcher dropping batch: size not divisible by "
+                    "the mesh data axis"
+                )
+                return SKIP
+            return self._device_put(batch)
+
+    def _grouped_reader(self):
+        buf: List[Any] = []
+        for raw in self.reader():
+            buf.append(raw)
+            if len(buf) == self.stack_k:
+                yield _Group(buf)
+                buf = []
+        if buf:
+            yield _Group(buf)  # trailing remainder; degrades to singles
+
+    def _prepare_group(self, group: "_Group") -> Any:
+        """A run of stack_k raw items → one StackedBatch (the fast path: one
+        np.stack + one device put covering K steps), or _Singles/SKIP when
+        the group cannot stack as a whole."""
+        batches = [self._feed(raw) for raw in group]
+        if self.parallel is not None:
+            keep = [b for b in batches if self.parallel.batch_divisible(b)]
+            if len(keep) < len(batches):
+                log.warning(
+                    "prefetcher dropping %d batch(es): size not divisible "
+                    "by the mesh data axis", len(batches) - len(keep),
+                )
+            batches = keep
+        if not batches:
+            return SKIP
+        stackable = (
+            len(batches) == self.stack_k
+            and len({stats.batch_signature(b) for b in batches}) == 1
+        )
+        with stats.timer("h2d"):
+            if not stackable:
+                return _Singles(self._device_put(b) for b in batches)
+            stacked = {
+                k: np.stack([np.asarray(b[k]) for b in batches])
+                for k in batches[0]
+            }
+            out = self._device_put(stacked, stacked=True)
+        sb = StackedBatch(out)
+        sb.k = self.stack_k
+        return sb
 
     def __iter__(self):
-        return iter_async(
-            self.reader, self._prepare, self.prefetch_depth,
+        if self.stack_k <= 1:
+            return iter_async(
+                self.reader, self._prepare, self.prefetch_depth,
+                name="paddle-tpu-device-prefetch", retries=self.feed_retries,
+            )
+        return self._iter_stacked()
+
+    def _iter_stacked(self):
+        for item in iter_async(
+            self._grouped_reader, self._prepare_group, self.prefetch_depth,
             name="paddle-tpu-device-prefetch", retries=self.feed_retries,
-        )
+        ):
+            if isinstance(item, _Singles):
+                # degraded group: hand the batches over one by one — the
+                # trainer re-buffers or single-steps them as appropriate
+                for b in item:
+                    yield b
+            else:
+                yield item

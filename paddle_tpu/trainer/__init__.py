@@ -10,4 +10,5 @@ from paddle_tpu.trainer.trainer import (  # noqa: F401
     SGDTrainer,
     TrainState,
 )
+from paddle_tpu.trainer.checkpoint import AsyncCheckpointer  # noqa: F401
 from paddle_tpu.trainer import checkpoint as checkpoint  # noqa: F401

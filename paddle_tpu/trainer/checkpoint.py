@@ -6,7 +6,15 @@ pserver checkpoints that additionally persist optimizer state with integrity
 checks (go/pserver/service.go:146 parameterCheckpoint, CRC + atomic write).
 
 Format: one .npz per pytree (params / states / opt) + manifest.json with
-shapes, dtypes and a CRC of each file; writes are atomic (tmp + rename)."""
+shapes, dtypes and a CRC of each file; writes are atomic (tmp + rename).
+
+Async mode (the zero-stall checkpoint path): `AsyncCheckpointer` owns ONE
+background writer thread; `save_pass_async` flattens the (already
+host-resident) trees on the caller's thread and hands the npz/CRC/v1-format/
+manifest/retention work to the writer, double-buffered so at most one
+snapshot is in flight. `wait()` is the durability barrier — the trainer
+invokes it on train() exit, before load(), and in the preemption drain, so a
+checkpoint path handed to a supervisor always names a completed write."""
 
 from __future__ import annotations
 
@@ -15,13 +23,14 @@ import logging
 import os
 import shutil
 import tempfile
+import threading
 import zlib
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-from paddle_tpu.core import faults
+from paddle_tpu.core import faults, stats
 
 log = logging.getLogger("paddle_tpu.checkpoint")
 
@@ -95,21 +104,51 @@ def save_pass(
     keep_last_n (None/0 = keep all): after a successful write, delete the
     oldest pass dirs beyond the newest N — never the one just written. The
     dir is renamed aside first, so a reader never sees a half-deleted pass."""
+    flats = _flatten_pass_trees(params, states, opt_state)
+    return _write_pass_files(
+        save_dir, pass_id, flats, extra_meta, v1_binary, keep_last_n
+    )
+
+
+def _flatten_pass_trees(
+    params: Dict[str, Any],
+    states: Optional[Dict[str, Any]],
+    opt_state: Optional[Any],
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Flatten the three checkpoint trees to {name: {path: ndarray}} — the
+    only step of a save that must see the caller's (possibly device) arrays;
+    everything after it is pure file I/O."""
+    flats: Dict[str, Dict[str, np.ndarray]] = {}
+    for name, tree in [("params", params), ("states", states), ("opt", opt_state)]:
+        if tree is None or (isinstance(tree, dict) and not tree):
+            continue
+        flats[name] = _to_numpy_tree(tree)
+    return flats
+
+
+def _write_pass_files(
+    save_dir: str,
+    pass_id: int,
+    flats: Dict[str, Dict[str, np.ndarray]],
+    extra_meta: Optional[Dict[str, Any]],
+    v1_binary: bool,
+    keep_last_n: Optional[int],
+) -> str:
+    """The file-I/O body of save_pass, runnable on an AsyncCheckpointer
+    writer thread: npz + CRC + v1-format + manifest + latest pointer +
+    retention. Input arrays must already be host numpy."""
     if keep_last_n is not None and keep_last_n < 0:
         raise ValueError(f"keep_last_n must be >= 0, got {keep_last_n}")
     pdir = os.path.join(save_dir, f"pass-{pass_id:05d}")
     os.makedirs(pdir, exist_ok=True)
-    if v1_binary:
+    if v1_binary and "params" in flats:
         from paddle_tpu.trainer import v1_format
 
-        v1_format.save_model_dir(pdir, _to_numpy_tree(params))
+        v1_format.save_model_dir(pdir, flats["params"])
     manifest: Dict[str, Any] = {"pass_id": pass_id, "files": {}, "version": 1}
     if extra_meta:
         manifest["extra"] = extra_meta
-    for name, tree in [("params", params), ("states", states), ("opt", opt_state)]:
-        if tree is None or (isinstance(tree, dict) and not tree):
-            continue
-        flat = _to_numpy_tree(tree)
+    for name, flat in flats.items():
         path = os.path.join(pdir, f"{name}.npz")
         crc = _save_npz_atomic(path, flat)
         if faults.get().fire("ckpt_truncate"):
@@ -129,6 +168,106 @@ def save_pass(
     _write_latest(save_dir, pass_id)
     if keep_last_n:
         _prune_old_passes(save_dir, keep=keep_last_n, just_written=pdir)
+    return pdir
+
+
+class AsyncCheckpointer:
+    """Single background writer for zero-stall checkpointing.
+
+    Double-buffered: at most one snapshot is in flight; submitting a second
+    blocks (before any new work starts) until the first lands. A writer
+    failure is remembered and re-raised on the NEXT submit()/wait() so disk
+    errors surface on the training thread instead of dying silently with a
+    daemon thread. The thread is a daemon on purpose: a kill mid-write is
+    exactly the torn-write case the manifest CRCs exist to catch."""
+
+    def __init__(self, name: str = "paddle-tpu-ckpt-writer"):
+        self._cond = threading.Condition()
+        self._job: Optional[Tuple[Callable[[], Any], str]] = None
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name=self._name
+            )
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._job is None:
+                    self._cond.wait()
+                fn, desc = self._job
+            err: Optional[BaseException] = None
+            try:
+                with stats.timer("ckptWrite"):
+                    fn()
+            except BaseException as e:  # surfaces at the next submit()/wait()
+                err = e
+                log.error("async checkpoint write (%s) failed: %s", desc, e)
+            with self._cond:
+                if err is not None:
+                    self._error = err
+                self._job = None
+                self._cond.notify_all()
+
+    @property
+    def in_flight(self) -> bool:
+        with self._cond:
+            return self._job is not None
+
+    def submit(self, fn: Callable[[], Any], desc: str = "checkpoint") -> None:
+        """Queue one write job; blocks while a previous one is in flight."""
+        self._ensure_thread()
+        with self._cond:
+            while self._job is not None:
+                self._cond.wait()
+            self._raise_pending_locked()
+            self._job = (fn, desc)
+            self._cond.notify_all()
+
+    def wait(self) -> None:
+        """Durability barrier: returns once no write is in flight, re-raising
+        the writer's exception (once) if the last write failed."""
+        with self._cond:
+            while self._job is not None:
+                self._cond.wait()
+            self._raise_pending_locked()
+
+    def _raise_pending_locked(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+
+def save_pass_async(
+    writer: AsyncCheckpointer,
+    save_dir: str,
+    pass_id: int,
+    params: Dict[str, Any],
+    states: Optional[Dict[str, Any]] = None,
+    opt_state: Optional[Any] = None,
+    extra_meta: Optional[Dict[str, Any]] = None,
+    v1_binary: bool = True,
+    keep_last_n: Optional[int] = None,
+) -> str:
+    """save_pass, minus the stall: trees are flattened on the calling thread
+    (pass host-resident numpy trees — the trainer pre-fetches device arrays
+    with copy_to_host_async), all file I/O happens on `writer`'s thread.
+    Returns the pass dir path that is durable once writer.wait() returns."""
+    if keep_last_n is not None and keep_last_n < 0:
+        raise ValueError(f"keep_last_n must be >= 0, got {keep_last_n}")
+    flats = _flatten_pass_trees(params, states, opt_state)
+    pdir = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    writer.submit(
+        lambda: _write_pass_files(
+            save_dir, pass_id, flats, extra_meta, v1_binary, keep_last_n
+        ),
+        desc=pdir,
+    )
     return pdir
 
 

@@ -32,9 +32,24 @@ class BeginIteration:
 
 
 class EndIteration:
-    """End-of-batch event. `cost` and `metrics` are fetched from the device
-    on first access (and cached), so installing a handler is free unless the
-    handler reads the values."""
+    """End-of-dispatch event (one per device dispatch: a single batch, or the
+    whole K-batch window under train(steps_per_dispatch=K), with batch_id the
+    window's LAST batch and cost its final step's cost).
+
+    `cost` and `metrics` are fetched from the device on first access (and
+    cached), so installing a handler is free unless the handler reads the
+    values. Reading `.cost` is NOT free: it blocks the host until the step
+    that produced it has actually executed — one read per batch re-creates
+    exactly the per-step pipeline stall the lazy event exists to avoid. Read
+    it sparingly (every N dispatches, or only at EndPass), and prefer the
+    pass-level `EndPass.metrics["avg_cost"]`, which costs one sync per pass.
+
+    With a divergence policy and guard_check_every > 1, a poisoned batch's
+    event IS delivered (the host only learns of the divergence at the next
+    guard poll) and its `.cost` reads NaN/Inf — handlers aggregating `.cost`
+    should guard with isfinite, or rely on `avg_cost`, which the on-device
+    accumulator already masks. At guard_check_every=1 (and unfused dispatch)
+    poisoned batches are suppressed from the event stream, as before."""
 
     __slots__ = ("pass_id", "batch_id", "_cost", "_metrics", "_metrics_np")
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import faults, preempt, stats
+from paddle_tpu.data.pipeline import StackedBatch
 from paddle_tpu.data.pipeline import coerce_batch as _coerce_batch
 from paddle_tpu.data.pipeline import is_device_batch
 from paddle_tpu.nn.graph import Argument, Layer, Network
@@ -87,6 +88,7 @@ class SGDTrainer:
         seed: int = 0,
         remat: Optional[str] = None,  # None | "conv_only" | "full"
         divergence_policy: Optional[str] = None,  # skip_batch|rollback|raise
+        guard_check_every: int = 16,  # steps between divergence-guard polls
     ):
         costs = [cost] if isinstance(cost, Layer) else list(cost)
         self.cost_names = [c.name for c in costs]
@@ -114,20 +116,36 @@ class SGDTrainer:
         self.parallel = parallel
         self.seed = seed
         # Divergence guard (SURVEY §5 failure-as-common-case): with a policy
-        # set, the compiled step checks jnp.isfinite(cost) and hands back the
+        # set, the compiled step checks jnp.isfinite(cost), hands back the
         # PRE-step state on NaN/Inf (donation-safe — the select happens inside
-        # the same program), so one poisoned batch cannot corrupt params/opt;
-        # the host then reacts per policy. None = guard compiled out (the
-        # step program and its async dispatch behavior stay byte-identical).
+        # the same program), and bumps a cumulative `diverged` counter carried
+        # in the train state, so DETECTION is device-resident too. The host
+        # polls that counter only every `guard_check_every` steps (and at pass
+        # end / before a preempt drain) and reacts per policy within that
+        # bounded window — no per-step host sync. guard_check_every=1 restores
+        # the old react-at-the-offending-batch latency. None = guard compiled
+        # out (the step program's async dispatch behavior stays byte-identical).
         if divergence_policy is not None and divergence_policy not in DIVERGENCE_POLICIES:
             raise ValueError(
                 f"divergence_policy must be one of {DIVERGENCE_POLICIES} or "
                 f"None, got {divergence_policy!r}"
             )
         self.divergence_policy = divergence_policy
+        if guard_check_every < 1:
+            raise ValueError(
+                f"guard_check_every must be >= 1, got {guard_check_every}"
+            )
+        self.guard_check_every = guard_check_every
         self.state: Optional[TrainState] = None
         self._step_fn = None
+        self._multi_fn = None  # K-step fused dispatch (make_multi_step), lazy
         self._eval_fn = None
+        # host mirror of state["diverged"] as of the last guard poll — the
+        # delta on each poll is the number of new divergence events
+        self._diverged_seen = 0
+        # background writer for async (zero-stall) checkpointing, created on
+        # the first async save; wait() on it is the durability barrier
+        self._ckpt_writer: Optional[ckpt_mod.AsyncCheckpointer] = None
         # (save_dir, pass_id) of the newest checkpoint this trainer wrote or
         # loaded — lets _rollback skip a full CRC re-scan per divergence event
         self._known_good_pass: Optional[tuple] = None
@@ -148,8 +166,17 @@ class SGDTrainer:
             # host-adjustable LR multiplier: the rollback divergence policy
             # halves it on every restore (the classic diverged-run response)
             "lr_scale": jnp.ones((), jnp.float32),
+            # device-resident divergence flag: cumulative count of steps whose
+            # cost came back NaN/Inf (the step reverts those updates in-place);
+            # the host reads it only at guard-poll boundaries
+            "diverged": jnp.zeros((), jnp.int32),
+            # on-device pass cost accumulator (guard mode): the step adds its
+            # cost here and the divergence revert masks poisoned entries, so
+            # the host never issues eager masking ops — one fetch per pass
+            "cost_acc": jnp.zeros((), jnp.float32),
             "rng": rng,
         }
+        self._diverged_seen = 0
         if self.parallel is not None:
             # hand the discovered per-param attrs (sharding specs) to the
             # parallel plan before placing the state on the mesh
@@ -212,17 +239,26 @@ class SGDTrainer:
                 "avg": new_avg,
                 "samples": state["samples"] + bs,
                 "lr_scale": state["lr_scale"],
+                "diverged": state["diverged"],
+                "cost_acc": state["cost_acc"],
                 "rng": state["rng"],
             }
             if self.divergence_policy is not None:
-                # divergence guard: on a NaN/Inf cost every state leaf —
-                # params, opt slots, BN states, samples counter — reverts to
-                # its pre-step value, so the poisoned update never lands. The
-                # returned (non-finite) cost is the flag the host reads.
+                # divergence guard, fully device-resident: on a NaN/Inf cost
+                # every state leaf — params, opt slots, BN states, samples
+                # counter, and the cost accumulator below — reverts to its
+                # pre-step value, so the poisoned update never lands (and the
+                # poisoned cost never joins the pass sum), while the
+                # cumulative `diverged` counter ticks up. The host learns
+                # about it at the next guard poll; no per-step value fetch.
+                new_state["cost_acc"] = state["cost_acc"] + cost
                 ok = jnp.isfinite(cost)
                 new_state = jax.tree.map(
                     lambda new, old: jnp.where(ok, new, old), new_state, state
                 )
+                new_state["diverged"] = state["diverged"] + jnp.where(
+                    ok, 0, 1
+                ).astype(jnp.int32)
             extras = {n: outs[n].value for n in extra_names}
             return new_state, cost, extras
 
@@ -238,12 +274,16 @@ class SGDTrainer:
         """K train steps per device dispatch: `multi(state, batches)` where
         every batch slot is stacked on a leading K axis, scanned with
         lax.scan inside ONE compiled program. Returns (new_state, costs[K]).
+        On CPU the scan applies bitwise the same updates as K sequential
+        single-step dispatches (tests/test_dispatch.py locks this in).
 
         This amortizes per-dispatch host latency (dominant on remote-tunnel
         or small-step workloads) and lets XLA overlap the tail of step i with
         the head of step i+1 — the TPU-native analog of the reference's
         compute/comm overlap in ConcurrentRemoteParameterUpdater
-        (RemoteParameterUpdater.h:180)."""
+        (RemoteParameterUpdater.h:180). `train(steps_per_dispatch=K)` drives
+        this program over K-batch groups from the reader (stacked by a
+        DevicePrefetcher(stack_k=K) or host-side by the trainer)."""
         step = self._build_step()
 
         def multi(state: TrainState, batches: Dict[str, Any]):
@@ -285,6 +325,8 @@ class SGDTrainer:
         log_period: int = 100,
         auto_resume: bool = False,
         keep_last_n: Optional[int] = None,
+        steps_per_dispatch: int = 1,
+        async_checkpoint: bool = True,
     ) -> TrainState:
         """reader yields batches (lists of samples if feeder given, else dicts
         of arrays). One call = `num_passes` passes (v1 --num_passes).
@@ -295,14 +337,40 @@ class SGDTrainer:
         sample counters from it, and continue with the next pass. A run
         killed mid-pass and restarted this way replays the interrupted pass
         from its boundary and, with a deterministic reader, produces final
-        params bitwise-identical to a never-killed run."""
+        params bitwise-identical to a never-killed run.
+
+        steps_per_dispatch=K (>1): K consecutive same-shape batches are
+        stacked and run through ONE compiled lax.scan dispatch
+        (make_multi_step), amortizing per-dispatch host latency. Batches
+        already stacked by a DevicePrefetcher(stack_k=K) dispatch as-is.
+        Events, the recompile counter, the log line and the chaos sites
+        (kill / preempt / nan_loss) all fire per-DISPATCH, not per batch:
+        BeginIteration carries the first batch id of the window, EndIteration
+        the last (its lazy .cost is the window's final cost; extra outputs
+        are not collected on the fused path). A trailing remainder (pass end,
+        shape change, reader exhaustion) runs through single-step dispatches,
+        so a K-fused pass applies exactly the same updates as K=1.
+
+        async_checkpoint (default on): pass-boundary and preempt-drain saves
+        copy the state to host with non-blocking fetches and hand all file
+        I/O (npz/CRC/v1-format/manifest/retention) to a background writer
+        thread, double-buffered with at most one snapshot in flight. train()
+        waits for the writer before returning (and in its error path), load()
+        and the preempt drain wait too, so every checkpoint path this method
+        reports is durable. Writer failures re-raise on the training thread
+        at the next save/wait."""
         event_handler = event_handler or (lambda e: None)
-        inj = faults.get()
+        if steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}"
+            )
         resume_pass: Optional[int] = None
         resume_pending = False
         resume_mid = False  # checkpoint is a preemption-drain mid-pass save
         resume_skip = 0  # batches of resume_pass already applied (mid-pass drain)
         if auto_resume and save_dir is not None:
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.wait()  # scan must see completed writes
             resume_pass = ckpt_mod.find_latest_valid_pass(save_dir)
             if resume_pass is not None:
                 extra = ckpt_mod.pass_manifest(save_dir, resume_pass).get(
@@ -324,180 +392,394 @@ class SGDTrainer:
                     self._known_good_pass = (save_dir, resume_pass)
                 else:  # state shapes unknown until the first batch arrives
                     resume_pending = True
-        for pass_id in range(num_passes):
-            if resume_pass is not None and (
-                pass_id < resume_pass
-                or (pass_id == resume_pass and not resume_mid)
-            ):
-                continue  # completed by the run we are resuming
-            event_handler(BeginPass(pass_id))
-            self.updater.start_pass()
-            stats.RECOMPILES.start_pass()
-            t0 = time.time()
-            cost_sum_dev, n_batches, n_diverged = None, 0, 0
-            for batch_id, raw in enumerate(reader()):
-                if preempt.requested():
-                    # batch boundary: the previous step completed; drain —
-                    # checkpoint (mid-pass) and raise Preempted. The current
-                    # raw batch is unprocessed and replays after resume.
-                    # Inside a replayed prefix the restored state already
-                    # holds resume_skip batches — never report fewer, or the
-                    # next resume would re-apply some of them.
-                    done = batch_id
-                    if resume_mid and pass_id == resume_pass:
-                        done = max(batch_id, resume_skip)
-                    self._drain_preempt(save_dir, pass_id, done, keep_last_n)
-                if (
-                    resume_skip
-                    and pass_id == resume_pass
-                    and batch_id < resume_skip
+        flushed = False
+        try:
+            for pass_id in range(num_passes):
+                if resume_pass is not None and (
+                    pass_id < resume_pass
+                    or (pass_id == resume_pass and not resume_mid)
                 ):
-                    # replayed prefix of the preempted pass: these batches are
-                    # already folded into the restored state — consume the
-                    # (deterministic) reader past them without stepping
-                    continue
-                # device batches (from a DevicePrefetcher) arrive fed, sharded
-                # and resident — skip the whole host prep leg; dict batches
-                # are already feed-ready (e.g. from a DoubleBuffer that ran
-                # the feeder on its prefetch thread). Under DataParallel the
-                # fast path additionally requires the mesh batch sharding —
-                # device-resident but unsharded arrays still go through
-                # shard_batch below.
-                on_device = is_device_batch(raw) and (
-                    self.parallel is None or self.parallel.is_sharded_batch(raw)
+                    continue  # completed by the run we are resuming
+                resume_pending = self._train_one_pass(
+                    reader, pass_id, event_handler, feeder, test_reader,
+                    save_dir, log_period, keep_last_n, steps_per_dispatch,
+                    async_checkpoint, resume_pass, resume_mid, resume_skip,
+                    resume_pending,
                 )
-                if on_device:
-                    batch = raw  # hostFeed/h2d were stamped by the prefetcher
-                else:
-                    with stats.timer("hostFeed"):
-                        batch = (
-                            feeder(raw)
-                            if feeder is not None and not isinstance(raw, dict)
-                            else _coerce_batch(raw)
-                        )
-                if self.parallel is not None and not on_device:
-                    if not self.parallel.batch_divisible(batch):
-                        # trailing partial batch not divisible by the mesh data
-                        # axis — skip it (drop_last semantics), like the
-                        # per-thread batch split in MultiGradientMachine
-                        log.warning(
-                            "skipping batch %d: size not divisible by mesh "
-                            "data axis", batch_id,
-                        )
-                        continue
-                    with stats.timer("h2d"):
+            if resume_pending:
+                # every requested pass was already checkpointed — nothing ran,
+                # so state was never initialized; pull one batch just for
+                # shapes and load the final checkpoint for the caller
+                raw = next(iter(reader()), None)
+                if raw is not None:
+                    if isinstance(raw, StackedBatch):
+                        raw = {k: v[0] for k, v in raw.items()}
+                    on_device = is_device_batch(raw) and (
+                        self.parallel is None
+                        or self.parallel.is_sharded_batch(raw)
+                    )
+                    batch = (
+                        raw
+                        if on_device
+                        else feeder(raw)
+                        if feeder is not None and not isinstance(raw, dict)
+                        else _coerce_batch(raw)
+                    )
+                    if self.parallel is not None and not on_device:
                         batch = self.parallel.shard_batch(batch)
-                if self.state is None:
                     self.init_state(batch)
+                    self.load(save_dir, resume_pass)
+                    self._known_good_pass = (save_dir, resume_pass)
+            if self._ckpt_writer is not None:
+                # durability barrier on the clean path: surfaces any async
+                # write error and guarantees the final checkpoint is on disk
+                self._ckpt_writer.wait()
+            flushed = True
+        finally:
+            if not flushed and self._ckpt_writer is not None:
+                # error path (incl. InjectedKill chaos): flush the in-flight
+                # snapshot but never mask the propagating exception
+                try:
+                    self._ckpt_writer.wait()
+                except Exception:
+                    log.exception(
+                        "async checkpoint flush failed during error exit"
+                    )
+        return self.state
+
+    def _train_one_pass(
+        self,
+        reader: Callable,
+        pass_id: int,
+        event_handler: Callable,
+        feeder: Optional[Callable],
+        test_reader: Optional[Callable],
+        save_dir: Optional[str],
+        log_period: int,
+        keep_last_n: Optional[int],
+        steps_per_dispatch: int,
+        async_checkpoint: bool,
+        resume_pass: Optional[int],
+        resume_mid: bool,
+        resume_skip: int,
+        resume_pending: bool,
+    ) -> bool:
+        """One training pass of the async execution runtime. Returns the
+        (possibly cleared) resume_pending flag.
+
+        Hot-loop discipline (enforced by tests/test_lint_hotloop.py): nothing
+        in this body fetches a device value per step — cost accumulation is
+        an async on-device add, divergence detection reads the carried
+        `diverged` counter only at guard polls (_poll_guard), the log line is
+        deferred one dispatch behind a non-blocking host copy, and avg_cost
+        syncs once at pass end. Lines that DO fetch carry a `sync-ok` tag."""
+        inj = faults.get()
+        guard_on = self.divergence_policy is not None
+        event_handler(BeginPass(pass_id))
+        self.updater.start_pass()
+        stats.RECOMPILES.start_pass()
+        t0 = time.time()
+        cost_sum_dev = None
+        if guard_on and self.state is not None:
+            # zero the on-device pass cost accumulator (×0 keeps the leaf's
+            # sharding); one tiny dispatch per pass, not per step
+            self.state["cost_acc"] = self.state["cost_acc"] * 0
+        stepped = 0  # batches whose update was dispatched this pass
+        pass_div0 = self._diverged_seen
+        steps_since_poll = 0
+        pending: List[tuple] = []  # [(logical batch id, feed-ready batch)]
+        pending_sig: Optional[tuple] = None  # shared signature of `pending`
+        pending_log: Optional[tuple] = None  # deferred (pass, batch, cost_dev)
+        logical = 0  # reader position in single-batch units
+        boundary = 0  # resolved prefix: every earlier batch applied/skipped
+
+        def flush_log() -> None:
+            nonlocal pending_log
+            if pending_log is not None:
+                p, b, c = pending_log
+                pending_log = None
+                # sync-ok: deferred one dispatch behind; the value was copied
+                # to host asynchronously at stash time, so this float() reads
+                # an (almost always) already-landed buffer instead of
+                # serializing the dispatch pipeline head
+                log.info("pass %d batch %d cost=%.6f", p, b, float(c))
+
+        def dispatch(idx_first: int, idx_last: int, batch, k: int) -> None:
+            """One device dispatch: a single compiled step (k=1) or the
+            K-step fused scan. Chaos sites, events, telemetry and the log
+            line all operate at this granularity."""
+            nonlocal cost_sum_dev, stepped, steps_since_poll, pending_log
+            if inj.active:
+                if inj.fire("kill"):
+                    raise faults.InjectedKill(
+                        f"injected kill at pass {pass_id} batch {idx_first}"
+                    )
+                if inj.fire("preempt"):
+                    # simulated preemption notice (SIGTERM analog): only sets
+                    # the drain flag — this dispatch still steps, the NEXT
+                    # boundary checkpoints and exits ("finish the step")
+                    preempt.get().request(
+                        f"injected preempt at pass {pass_id} batch {idx_first}"
+                    )
+                if inj.fire("nan_loss"):
+                    batch = _poison_batch(batch)
+            # one distinct signature = one XLA trace+compile (the stacked
+            # [K, B, ...] signature is its own program); churn past the
+            # threshold warns (misconfigured seq_buckets)
+            stats.RECOMPILES.record(stats.batch_signature(batch))
+            event_handler(BeginIteration(pass_id, idx_first))
+            # REGISTER_TIMER_INFO("forwardBackward") parity
+            # (TrainerInternal.cpp:94-152); enable via PADDLE_TPU_TIMER.
+            # Timing is opt-in, so when enabled we sync the device inside
+            # the timer — otherwise it would measure only async dispatch.
+            with stats.timer("forwardBackward"):
+                if k == 1:
+                    self.state, cost, extras = self._step_fn(self.state, batch)
+                    costs = None
+                else:
+                    if self._multi_fn is None:
+                        self._multi_fn = self.make_multi_step()
+                    self.state, costs = self._multi_fn(self.state, batch)
+                    cost, extras = costs[-1], {}
+                if stats.GLOBAL_STATS.enabled:
+                    jax.block_until_ready(cost)  # sync-ok: opt-in timing only
+            # pass-cost accumulation never syncs: in guard mode the compiled
+            # step itself accumulates state["cost_acc"] (with the divergence
+            # revert masking poisoned entries), otherwise accumulate with one
+            # async on-device add per dispatch — the batch-count correction
+            # for masked entries happens at pass end from the guard delta
+            if not guard_on:
+                contrib = costs.sum() if costs is not None else cost
+                cost_sum_dev = (
+                    contrib if cost_sum_dev is None else cost_sum_dev + contrib
+                )
+            stepped += k
+            steps_since_poll += k
+            suppress = False
+            if guard_on and steps_since_poll >= self.guard_check_every:
+                steps_since_poll = 0
+                new = self._poll_guard(pass_id, idx_last, save_dir)
+                # per-step polling of an unfused step: the window IS this
+                # batch, so restore the old event contract — a poisoned batch
+                # joins neither cost nor events nor the log line. Wider
+                # windows still deliver the dispatch's event (its lazy .cost
+                # may read non-finite; see events.EndIteration).
+                suppress = bool(new) and k == 1 and self.guard_check_every == 1
+            if suppress:
+                return
+            event_handler(EndIteration(pass_id, idx_last, cost, extras))
+            if idx_last % log_period < k:  # window crossed a log_period mark
+                flush_log()
+                cost.copy_to_host_async()  # start D2H without blocking
+                pending_log = (pass_id, idx_last, cost)
+
+        def flush_pending() -> None:
+            """Run buffered (ungrouped) batches through single-step
+            dispatches — the trailing-remainder / shape-churn path."""
+            nonlocal boundary, pending_sig
+            for idx, b in pending:
+                dispatch(idx, idx, b, 1)
+            if pending:
+                boundary = pending[-1][0] + 1
+                del pending[:]
+            pending_sig = None
+
+        for raw in reader():
+            k_item = raw.k if isinstance(raw, StackedBatch) else 1
+            idx0 = logical
+            logical += k_item
+            if preempt.requested():
+                # dispatch boundary: the previous step completed; drain —
+                # checkpoint (mid-pass) and raise Preempted. The current raw
+                # batch and any still-buffered ones are unprocessed and
+                # replay after resume. Inside a replayed prefix the restored
+                # state already holds resume_skip batches — never report
+                # fewer, or the next resume would re-apply some of them.
+                done = boundary
+                if resume_mid and pass_id == resume_pass:
+                    done = max(done, resume_skip)
+                self._drain_preempt(
+                    save_dir, pass_id, done, keep_last_n, async_checkpoint
+                )
+            if (
+                resume_skip
+                and pass_id == resume_pass
+                and idx0 + k_item <= resume_skip
+            ):
+                # replayed prefix of the preempted pass: these batches are
+                # already folded into the restored state — consume the
+                # (deterministic) reader past them without stepping
+                boundary = logical
+                continue
+            if isinstance(raw, StackedBatch):
+                # prefetcher-stacked group: device-resident [K, B, ...] slots
+                if self.state is None:
+                    self.init_state({k: v[0] for k, v in raw.items()})
                     if resume_pending:  # deferred auto-resume load
                         self.load(save_dir, resume_pass)
                         self._known_good_pass = (save_dir, resume_pass)
                         resume_pending = False
                 if self._step_fn is None:
                     self._step_fn = self._make_step()
-                if inj.active:
-                    if inj.fire("kill"):
-                        raise faults.InjectedKill(
-                            f"injected kill at pass {pass_id} batch {batch_id}"
+                flush_pending()  # keep update order = reader order
+                skip = 0
+                if resume_skip and pass_id == resume_pass and idx0 < resume_skip:
+                    skip = resume_skip - idx0  # group straddles the boundary
+                if skip:
+                    for j in range(skip, k_item):
+                        dispatch(
+                            idx0 + j, idx0 + j,
+                            {k: v[j] for k, v in raw.items()}, 1,
                         )
-                    if inj.fire("preempt"):
-                        # simulated preemption notice (SIGTERM analog): only
-                        # sets the drain flag — this batch still steps, the
-                        # NEXT boundary checkpoints and exits ("finish the
-                        # step" semantics)
-                        preempt.get().request(
-                            f"injected preempt at pass {pass_id} batch {batch_id}"
-                        )
-                    if inj.fire("nan_loss"):
-                        batch = _poison_batch(batch)
-                # one distinct signature = one XLA trace+compile of the step;
-                # churn past the threshold warns (misconfigured seq_buckets)
-                stats.RECOMPILES.record(stats.batch_signature(batch))
-                event_handler(BeginIteration(pass_id, batch_id))
-                # REGISTER_TIMER_INFO("forwardBackward") parity
-                # (TrainerInternal.cpp:94-152); enable via PADDLE_TPU_TIMER.
-                # Timing is opt-in, so when enabled we sync the device inside
-                # the timer — otherwise it would measure only async dispatch.
-                # "forwardBackward" is the device-step segment; with the
-                # "hostFeed"/"h2d" timers above it gives the input-pipeline
-                # occupancy split without a chip profiler.
-                with stats.timer("forwardBackward"):
-                    self.state, cost, extras = self._step_fn(self.state, batch)
-                    if stats.GLOBAL_STATS.enabled:
-                        jax.block_until_ready(cost)
-                if self.divergence_policy is not None and not np.isfinite(
-                    float(cost)  # forces a per-step sync — the guard's price
-                ):
-                    # the step already handed back the pre-step state; react
-                    n_diverged += 1
-                    stats.FT_EVENTS.incr("divergence")
-                    if self.divergence_policy == "raise":
-                        raise DivergenceError(
-                            f"non-finite cost ({float(cost)}) at pass "
-                            f"{pass_id} batch {batch_id}; state rolled back "
-                            f"to the pre-step values"
-                        )
-                    if self.divergence_policy == "rollback":
-                        self._rollback(save_dir, pass_id, batch_id)
-                    else:
-                        log.warning(
-                            "divergence guard: non-finite cost at pass %d "
-                            "batch %d — batch skipped", pass_id, batch_id,
-                        )
-                    continue  # poisoned batch joins neither cost nor events
-                n_batches += 1
-                # accumulate the pass cost ON DEVICE (async scalar add) and
-                # hand handlers a lazy event — the device is synced only when
-                # a handler reads event.cost or at log_period, so the async
-                # dispatch pipeline keeps running between log lines
-                cost_sum_dev = cost if cost_sum_dev is None else cost_sum_dev + cost
-                event_handler(EndIteration(pass_id, batch_id, cost, extras))
-                if batch_id % log_period == 0:
-                    log.info(
-                        "pass %d batch %d cost=%.6f", pass_id, batch_id, float(cost)
+                else:
+                    # plain dict: the subclass is a marker, not a pytree node
+                    dispatch(idx0, idx0 + k_item - 1, dict(raw), k_item)
+                boundary = logical
+                continue
+            batch_id = idx0
+            # device batches (from a DevicePrefetcher) arrive fed, sharded
+            # and resident — skip the whole host prep leg; dict batches are
+            # already feed-ready (e.g. from a DoubleBuffer that ran the
+            # feeder on its prefetch thread). Under DataParallel the fast
+            # path additionally requires the mesh batch sharding —
+            # device-resident but unsharded arrays still go through
+            # shard_batch below.
+            on_device = is_device_batch(raw) and (
+                self.parallel is None or self.parallel.is_sharded_batch(raw)
+            )
+            if on_device:
+                batch = raw  # hostFeed/h2d were stamped by the prefetcher
+            else:
+                with stats.timer("hostFeed"):
+                    batch = (
+                        feeder(raw)
+                        if feeder is not None and not isinstance(raw, dict)
+                        else _coerce_batch(raw)
                     )
-            metrics: Dict[str, Any] = {
-                "avg_cost": (
-                    float(cost_sum_dev) / n_batches if n_batches else 0.0
-                ),
-                "batches": n_batches,
-                "pass_seconds": time.time() - t0,
-                "shape_signatures": stats.RECOMPILES.pass_signatures(),
-                "divergence_events": n_diverged,
-            }
-            if stats.GLOBAL_STATS.enabled:
-                log.info(
-                    "pass %d %s", pass_id, stats.RECOMPILES.report()
-                )
-            self.updater.finish_pass()
-            if test_reader is not None:
-                metrics["test_cost"] = self.test(test_reader, feeder)["cost"]
-            if save_dir is not None:
-                self.save(save_dir, pass_id, keep_last_n=keep_last_n)
-                self._known_good_pass = (save_dir, pass_id)
-            event_handler(EndPass(pass_id, metrics))
-        if resume_pending:
-            # every requested pass was already checkpointed — nothing ran, so
-            # state was never initialized; pull one batch just for shapes and
-            # load the final checkpoint so the caller still gets it back
-            raw = next(iter(reader()), None)
-            if raw is not None:
-                on_device = is_device_batch(raw) and (
-                    self.parallel is None or self.parallel.is_sharded_batch(raw)
-                )
-                batch = (
-                    raw
-                    if on_device
-                    else feeder(raw)
-                    if feeder is not None and not isinstance(raw, dict)
-                    else _coerce_batch(raw)
-                )
-                if self.parallel is not None and not on_device:
+            if self.parallel is not None and not on_device:
+                if not self.parallel.batch_divisible(batch):
+                    # trailing partial batch not divisible by the mesh data
+                    # axis — skip it (drop_last semantics), like the
+                    # per-thread batch split in MultiGradientMachine
+                    log.warning(
+                        "skipping batch %d: size not divisible by mesh "
+                        "data axis", batch_id,
+                    )
+                    if not pending:
+                        boundary = logical
+                    continue
+                with stats.timer("h2d"):
                     batch = self.parallel.shard_batch(batch)
+            if self.state is None:
                 self.init_state(batch)
-                self.load(save_dir, resume_pass)
-                self._known_good_pass = (save_dir, resume_pass)
-        return self.state
+                if resume_pending:  # deferred auto-resume load
+                    self.load(save_dir, resume_pass)
+                    self._known_good_pass = (save_dir, resume_pass)
+                    resume_pending = False
+            if self._step_fn is None:
+                self._step_fn = self._make_step()
+            if steps_per_dispatch == 1:
+                dispatch(batch_id, batch_id, batch, 1)
+                boundary = logical
+                continue
+            # K-step grouping: buffer same-shape batches until K are ready,
+            # then stack them into one fused scan dispatch. A shape change
+            # flushes the buffer through single steps first (stacking needs
+            # homogeneous shapes, and update order must follow reader order).
+            sig = stats.batch_signature(batch)
+            if pending and sig != pending_sig:
+                flush_pending()
+            pending.append((batch_id, batch))
+            pending_sig = sig
+            if len(pending) == steps_per_dispatch:
+                stacked = _stack_batches([b for _, b in pending])
+                if self.parallel is not None:
+                    stacked = self.parallel.shard_batches(stacked)
+                dispatch(pending[0][0], pending[-1][0], stacked,
+                         steps_per_dispatch)
+                boundary = pending[-1][0] + 1
+                del pending[:]
+                pending_sig = None
+        flush_pending()  # trailing remainder: fewer than K batches left
+        # final guard poll: the bounded reaction window never crosses a pass
+        # boundary (the pass-end checkpoint must not absorb unexamined NaNs)
+        if guard_on and self.state is not None:
+            self._poll_guard(pass_id, max(logical - 1, 0), save_dir)
+        flush_log()
+        n_diverged = self._diverged_seen - pass_div0
+        n_batches = stepped - n_diverged
+        if guard_on and self.state is not None:
+            cost_sum_dev = self.state["cost_acc"]  # step-accumulated, masked
+        metrics: Dict[str, Any] = {
+            "avg_cost": (
+                # sync-ok: the single pass-end fetch of the on-device sum
+                float(cost_sum_dev) / n_batches
+                if n_batches and cost_sum_dev is not None
+                else 0.0
+            ),
+            "batches": n_batches,
+            "pass_seconds": time.time() - t0,
+            "shape_signatures": stats.RECOMPILES.pass_signatures(),
+            "divergence_events": n_diverged,
+        }
+        if stats.GLOBAL_STATS.enabled:
+            log.info("pass %d %s", pass_id, stats.RECOMPILES.report())
+        self.updater.finish_pass()
+        if test_reader is not None:
+            metrics["test_cost"] = self.test(test_reader, feeder)["cost"]
+        if save_dir is not None:
+            self.save(
+                save_dir, pass_id, keep_last_n=keep_last_n,
+                async_=async_checkpoint,
+            )
+            self._known_good_pass = (save_dir, pass_id)
+        event_handler(EndPass(pass_id, metrics))
+        return resume_pending
+
+    def _poll_guard(
+        self,
+        pass_id: int,
+        batch_id: int,
+        save_dir: Optional[str],
+        react: bool = True,
+    ) -> int:
+        """Divergence-guard poll: read the device-resident cumulative
+        `diverged` counter (the ONE sanctioned guard sync) and react to the
+        delta since the last poll. The in-step guard already reverted every
+        poisoned update on device, so by the time the host learns about a
+        window's divergences the state is clean — the reaction here is
+        policy, not protection. Returns the number of new events."""
+        d = int(self.state["diverged"])  # sync-ok: the guard-poll site
+        new = d - self._diverged_seen
+        self._diverged_seen = d
+        if new <= 0:
+            return 0
+        stats.FT_EVENTS.incr("divergence", new)
+        if not react:
+            # preempt drain: record the events but do not rollback/raise —
+            # the in-step guard already protected the checkpointed state
+            log.warning(
+                "divergence guard: %d non-finite step cost(s) detected while "
+                "draining at pass %d batch %d — updates were reverted on "
+                "device; no policy reaction during the drain",
+                new, pass_id, batch_id,
+            )
+            return new
+        if self.divergence_policy == "raise":
+            raise DivergenceError(
+                f"non-finite cost in {new} step(s) within the guard window "
+                f"ending at pass {pass_id} batch {batch_id}; every poisoned "
+                f"update was rolled back to its pre-step state on device"
+            )
+        if self.divergence_policy == "rollback":
+            self._rollback(save_dir, pass_id, batch_id)
+        else:
+            log.warning(
+                "divergence guard: non-finite cost in %d step(s) in the "
+                "window ending at pass %d batch %d — poisoned updates were "
+                "skipped on device", new, pass_id, batch_id,
+            )
+        return new
 
     def _drain_preempt(
         self,
@@ -505,13 +787,20 @@ class SGDTrainer:
         pass_id: int,
         batches_done: int,
         keep_last_n: Optional[int],
+        async_checkpoint: bool = False,
     ) -> None:
-        """Preemption drain at a batch boundary: persist a mid-pass checkpoint
-        (CRC-valid, `latest`-pointed) unless the grace budget is already
-        spent, then raise Preempted. save() syncs the device, so the
-        checkpoint holds the state AFTER the just-finished step."""
+        """Preemption drain at a dispatch boundary: persist a mid-pass
+        checkpoint (CRC-valid, `latest`-pointed) unless the grace budget is
+        already spent, then raise Preempted. The save syncs the device, so
+        the checkpoint holds the state AFTER the just-finished step; with
+        async_checkpoint the writer is waited on before raising, so the
+        exit-77 checkpoint is durable before the process dies."""
         guard = preempt.get()
         saved: Optional[str] = None
+        if self.state is not None and self.divergence_policy is not None:
+            # fold any unexamined guard window into telemetry before the
+            # state is persisted (no policy reaction mid-drain)
+            self._poll_guard(pass_id, batches_done, save_dir, react=False)
         if self.state is not None and save_dir is not None:
             if guard.deadline_passed():
                 log.warning(
@@ -523,8 +812,10 @@ class SGDTrainer:
             else:
                 saved = self.save(
                     save_dir, pass_id, keep_last_n=keep_last_n,
-                    mid_pass_batches=batches_done,
+                    mid_pass_batches=batches_done, async_=async_checkpoint,
                 )
+                if self._ckpt_writer is not None:
+                    self._ckpt_writer.wait()  # durable before exit 77
                 self._known_good_pass = (save_dir, pass_id)
         stats.FT_EVENTS.incr("preempt_drain")
         log.warning(
@@ -539,6 +830,10 @@ class SGDTrainer:
         the LR multiplier; with no checkpoint to return to, degrade to
         skip_batch (the in-step guard already protected the state)."""
         latest: Optional[int] = None
+        if save_dir is not None and self._ckpt_writer is not None:
+            # an async save of THIS trainer may still be in flight — the scan
+            # and the load below must only ever see completed writes
+            self._ckpt_writer.wait()
         if save_dir is not None:
             # last checkpoint this trainer wrote/loaded needs no CRC re-scan
             # (a stream of NaN batches would otherwise re-read the whole
@@ -613,6 +908,7 @@ class SGDTrainer:
         pass_id: int,
         keep_last_n: Optional[int] = None,
         mid_pass_batches: Optional[int] = None,
+        async_: bool = False,
     ) -> str:
         """Raw params + optimizer + averaging state are all persisted so
         load() is a true resume; deployment-time averaged weights are
@@ -620,7 +916,15 @@ class SGDTrainer:
 
         mid_pass_batches marks a preemption-drain save: the pass is only
         applied through that many batches, and auto-resume replays the rest
-        of it instead of skipping to the next pass."""
+        of it instead of skipping to the next pass.
+
+        async_=True is the zero-stall path: the state is copied to host with
+        non-blocking fetches (copy_to_host_async per leaf, so the D2H
+        transfers overlap each other), then npz/CRC/v1-format/manifest/
+        retention run on a background writer thread, double-buffered with at
+        most one snapshot in flight. The returned path is durable only after
+        checkpoint_wait(); train()/load()/the preempt drain invoke that
+        barrier themselves."""
         assert self.state is not None
         opt_tree = {"opt": self.state["opt"]}
         if self.state["avg"]:
@@ -632,15 +936,39 @@ class SGDTrainer:
         if mid_pass_batches is not None:
             extra_meta["mid_pass"] = True
             extra_meta["batches_done"] = int(mid_pass_batches)
-        return ckpt_mod.save_pass(
+        if not async_:
+            return ckpt_mod.save_pass(
+                save_dir,
+                pass_id,
+                self.state["params"],
+                self.state["states"],
+                opt_tree,
+                extra_meta=extra_meta,
+                keep_last_n=keep_last_n,
+            )
+        if self._ckpt_writer is None:
+            self._ckpt_writer = ckpt_mod.AsyncCheckpointer()
+        with stats.timer("ckptFetch"):
+            params_np = _fetch_host_tree(self.state["params"])
+            states_np = _fetch_host_tree(self.state["states"])
+            opt_np = _fetch_host_tree(opt_tree)
+        return ckpt_mod.save_pass_async(
+            self._ckpt_writer,
             save_dir,
             pass_id,
-            self.state["params"],
-            self.state["states"],
-            opt_tree,
+            params_np,
+            states_np,
+            opt_np,
             extra_meta=extra_meta,
             keep_last_n=keep_last_n,
         )
+
+    def checkpoint_wait(self) -> None:
+        """Durability barrier for async saves: returns once no checkpoint
+        write is in flight, re-raising any writer failure. No-op when async
+        checkpointing was never used."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()
 
     def load(self, save_dir: str, pass_id: Optional[int] = None) -> None:
         """Resume values, optimizer slots (when the structure matches) and the
@@ -648,6 +976,7 @@ class SGDTrainer:
         reference which checkpoints only parameter values (SURVEY §5
         'Optimizer state ... is not checkpointed in v1')."""
         assert self.state is not None, "init_state() with a sample batch first"
+        self.checkpoint_wait()  # never read a checkpoint that is mid-write
         params, states, opt_flat, manifest = ckpt_mod.load_pass(
             save_dir, pass_id, params_template=self.state["params"]
         )
@@ -672,6 +1001,33 @@ class SGDTrainer:
             # re-establish mesh placement (sharded head weights, replicated
             # slots) — plain asarray loads land unsharded otherwise
             self.state = self.parallel.shard_state(self.state)
+
+
+def _stack_batches(batches: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Stack K same-shape feed-ready batches on a new leading K axis for one
+    fused scan dispatch. Host batches stack with numpy; device-resident ones
+    (e.g. singles from a prefetcher) with jnp so the stack stays on device."""
+    first = batches[0]
+    stack = jnp.stack if is_device_batch(first) else np.stack
+    return {k: stack([b[k] for b in batches]) for k in first}
+
+
+def _fetch_host_tree(tree: Any) -> Any:
+    """Device tree → numpy tree with overlapped D2H: every leaf's transfer is
+    started non-blocking first, then the results are gathered — the training
+    thread waits only for the DMA, never for file I/O.
+
+    The gather must be a REAL copy (np.array, not np.asarray): on the CPU
+    backend asarray can alias the device buffer, and the next pass's donated
+    step would overwrite the "snapshot" under the async writer's feet."""
+    for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, jax.Array):
+            leaf.copy_to_host_async()
+    return jax.tree.map(
+        lambda leaf: np.array(leaf) if isinstance(leaf, jax.Array)
+        else np.asarray(leaf),
+        tree,
+    )
 
 
 def _batch_size(batch: Dict[str, Any]) -> int:

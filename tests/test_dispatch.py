@@ -1,0 +1,305 @@
+"""Async execution runtime tests: K-step fused dispatch equivalence, the
+device-resident divergence guard's bounded-window reaction, and zero-stall
+async checkpointing semantics.
+
+The load-bearing contract: `train(steps_per_dispatch=K)` — whether the
+stacking happens host-side in the trainer or on a DevicePrefetcher(stack_k=K)
+worker — applies EXACTLY the updates of K single-step dispatches, bitwise on
+the CPU oracle, including a trailing remainder that does not divide by K."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import faults, stats
+from paddle_tpu.data.feeder import DataFeeder, dense_vector, integer_value
+from paddle_tpu.data.pipeline import DevicePrefetcher, StackedBatch
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.graph import reset_name_scope
+from paddle_tpu.optim import SGD
+from paddle_tpu.trainer import DivergenceError, EndIteration, EndPass, SGDTrainer
+from paddle_tpu.trainer import checkpoint as ckpt
+
+DIM, CLASSES = 6, 3
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    reset_name_scope()
+    stats.FT_EVENTS.reset()
+    yield
+
+
+def _trainer(policy=None, guard_every=16, lr=0.2, seed=11):
+    reset_name_scope()
+    x = L.Data("x", shape=(DIM,))
+    lbl = L.Data("label", shape=())
+    logits = L.Fc(L.Fc(x, 16, act="relu"), CLASSES, act=None)
+    cost = C.ClassificationCost(logits, lbl)
+    return SGDTrainer(
+        cost, SGD(learning_rate=lr), seed=seed,
+        divergence_policy=policy, guard_check_every=guard_every,
+    )
+
+
+def _dict_batches(n, bs=8, seed=0):
+    rs = np.random.RandomState(seed)
+    return [
+        {
+            "x": rs.randn(bs, DIM).astype(np.float32),
+            "label": (rs.randint(0, CLASSES, bs)).astype(np.int64),
+        }
+        for _ in range(n)
+    ]
+
+
+def _params(t):
+    return {k: np.asarray(v) for k, v in t.state["params"].items()}
+
+
+def _assert_bitwise(a, b, what=""):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=f"{what}:{k}")
+
+
+# ---------------------------------------------------------------------------
+# K-step fused dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_steps_per_dispatch_bitwise_with_remainder():
+    """7 batches, K=3: two fused scans + one single-step remainder must land
+    bitwise on the K=1 run — params, sample counter AND the on-device pass
+    cost sum (avg_cost syncs the same accumulated scalar)."""
+    batches = _dict_batches(7)
+    passes1, passes3 = [], []
+
+    t1 = _trainer()
+    t1.train(
+        lambda: iter(batches), num_passes=2,
+        event_handler=lambda e: passes1.append(e.metrics)
+        if isinstance(e, EndPass) else None,
+    )
+    t3 = _trainer()
+    t3.train(
+        lambda: iter(batches), num_passes=2, steps_per_dispatch=3,
+        event_handler=lambda e: passes3.append(e.metrics)
+        if isinstance(e, EndPass) else None,
+    )
+    _assert_bitwise(_params(t1), _params(t3), "K=3 vs K=1")
+    assert int(t1.state["samples"]) == int(t3.state["samples"])
+    assert [m["batches"] for m in passes1] == [m["batches"] for m in passes3]
+    for m1, m3 in zip(passes1, passes3):
+        assert m1["avg_cost"] == pytest.approx(m3["avg_cost"], rel=1e-6)
+
+
+def test_steps_per_dispatch_through_prefetcher_stacking():
+    """The production path: DevicePrefetcher(stack_k=K) stacks on its worker
+    thread and the trainer dispatches the StackedBatch directly — still
+    bitwise against the unfused run, remainder included."""
+    raws = _dict_batches(7, seed=3)
+    t1 = _trainer()
+    t1.train(lambda: iter(raws), num_passes=2)
+
+    seen = []
+
+    def spy_reader():
+        for b in DevicePrefetcher(
+            lambda: iter(raws), prefetch_depth=2, stack_k=3
+        ):
+            seen.append(b)
+            yield b
+
+    tk = _trainer()
+    tk.train(spy_reader, num_passes=2, steps_per_dispatch=3)
+    _assert_bitwise(_params(t1), _params(tk), "prefetcher stack_k")
+    assert int(t1.state["samples"]) == int(tk.state["samples"])
+    # the prefetcher really did the stacking: 2 stacked groups + 1 single
+    stacked = [b for b in seen if isinstance(b, StackedBatch)]
+    singles = [b for b in seen if not isinstance(b, StackedBatch)]
+    assert len(stacked) == 4 and all(b.k == 3 for b in stacked)  # 2 passes
+    assert len(singles) == 2
+    assert all(v.shape[0] == 3 for b in stacked for v in b.values())
+
+
+def test_fused_dispatch_events_fire_per_dispatch():
+    """Documented per-dispatch granularity: BeginIteration carries the first
+    batch id of the window, EndIteration the last, one pair per dispatch."""
+    batches = _dict_batches(7, seed=5)
+    ends = []
+    t = _trainer()
+    t.train(
+        lambda: iter(batches), num_passes=1, steps_per_dispatch=3,
+        event_handler=lambda e: ends.append(e.batch_id)
+        if isinstance(e, EndIteration) else None,
+    )
+    assert ends == [2, 5, 6]  # two fused windows + the remainder single
+
+
+def test_shape_churn_flushes_group_to_singles():
+    """A batch-size change mid-group must not break stacking — the buffered
+    run flushes through single steps and the result still matches K=1."""
+    batches = _dict_batches(3, bs=8) + _dict_batches(2, bs=4, seed=9)
+    t1 = _trainer()
+    t1.train(lambda: iter(batches), num_passes=1)
+    t2 = _trainer()
+    t2.train(lambda: iter(batches), num_passes=1, steps_per_dispatch=2)
+    _assert_bitwise(_params(t1), _params(t2), "shape churn")
+
+
+def test_steps_per_dispatch_rejects_bad_value():
+    t = _trainer()
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        t.train(lambda: iter(_dict_batches(2)), steps_per_dispatch=0)
+    with pytest.raises(ValueError, match="guard_check_every"):
+        _trainer(policy="skip_batch", guard_every=0)
+
+
+# ---------------------------------------------------------------------------
+# device-resident divergence guard: bounded-window reaction
+# ---------------------------------------------------------------------------
+
+
+def test_guard_window_skip_reacts_within_bound(caplog):
+    """NaN at batch 1, guard_check_every=4: the host learns about it at the
+    poll after batch 3 (bounded window), the poisoned update never landed,
+    and the pass metrics carry the event."""
+    passes = []
+    with faults.inject("nan_loss:step=1") as inj:
+        t = _trainer(policy="skip_batch", guard_every=4)
+        with caplog.at_level("WARNING", logger="paddle_tpu.trainer"):
+            t.train(
+                lambda: iter(_dict_batches(8)), num_passes=1,
+                event_handler=lambda e: passes.append(e.metrics)
+                if isinstance(e, EndPass) else None,
+            )
+        assert inj.fired["nan_loss"] == 1
+    assert all(np.isfinite(v).all() for v in _params(t).values())
+    assert passes[0]["divergence_events"] == 1
+    assert passes[0]["batches"] == 7  # 8 stepped - 1 diverged
+    assert np.isfinite(passes[0]["avg_cost"])
+    assert stats.FT_EVENTS.get("divergence") == 1
+    # the reaction happened at the window poll (batch 3), not at batch 1
+    msgs = [r.message for r in caplog.records if "divergence guard" in r.message]
+    assert any("batch 3" in m for m in msgs), msgs
+
+
+def test_guard_check_every_one_restores_exact_batch_reaction():
+    """guard_check_every=1 = the old latency: raise names the offending
+    batch itself."""
+    with faults.inject("nan_loss:step=2"):
+        t = _trainer(policy="raise", guard_every=1)
+        with pytest.raises(DivergenceError, match="pass 0 batch 2"):
+            t.train(lambda: iter(_dict_batches(6)), num_passes=1)
+    assert all(np.isfinite(v).all() for v in _params(t).values())
+
+
+def test_guard_window_covers_fused_dispatch():
+    """The guard composes with K-step fusion: a NaN inside a fused scan is
+    reverted on device and shows up in the window poll's delta."""
+    passes = []
+    with faults.inject("nan_loss:step=1"):  # poisons the SECOND dispatch
+        t = _trainer(policy="skip_batch", guard_every=16)
+        t.train(
+            lambda: iter(_dict_batches(8)), num_passes=1,
+            steps_per_dispatch=4,
+            event_handler=lambda e: passes.append(e.metrics)
+            if isinstance(e, EndPass) else None,
+        )
+    assert all(np.isfinite(v).all() for v in _params(t).values())
+    # _poison_batch NaNs the whole stacked slot → all 4 scanned steps diverge
+    assert passes[0]["divergence_events"] == 4
+    assert passes[0]["batches"] == 4
+    assert np.isfinite(passes[0]["avg_cost"])
+
+
+def test_guard_every_one_suppresses_poisoned_event():
+    """guard_check_every=1 restores the full old contract: the poisoned
+    batch joins neither cost nor the event stream; wider windows deliver the
+    event (with a non-finite lazy cost) because the host learns too late."""
+    ends1, ends4 = [], []
+    with faults.inject("nan_loss:step=1"):
+        t = _trainer(policy="skip_batch", guard_every=1)
+        t.train(
+            lambda: iter(_dict_batches(4)), num_passes=1,
+            event_handler=lambda e: ends1.append(e.batch_id)
+            if isinstance(e, EndIteration) else None,
+        )
+    assert ends1 == [0, 2, 3]  # batch 1 suppressed, like the old guard
+    with faults.inject("nan_loss:step=1"):
+        t = _trainer(policy="skip_batch", guard_every=4)
+        t.train(
+            lambda: iter(_dict_batches(4)), num_passes=1,
+            event_handler=lambda e: ends4.append(e)
+            if isinstance(e, EndIteration) else None,
+        )
+    assert [e.batch_id for e in ends4] == [0, 1, 2, 3]  # windowed: delivered
+    assert not np.isfinite(ends4[1].cost)  # ...with the truthful NaN cost
+
+
+def test_guard_poll_counter_is_device_resident():
+    """The carry holds the cumulative diverged count; the host mirror only
+    advances at polls."""
+    with faults.inject("nan_loss:step=0"):
+        t = _trainer(policy="skip_batch", guard_every=16)
+        t.train(lambda: iter(_dict_batches(3)), num_passes=1)
+    assert int(t.state["diverged"]) == 1
+    assert t._diverged_seen == 1  # pass-end poll caught up
+
+
+# ---------------------------------------------------------------------------
+# zero-stall async checkpointing
+# ---------------------------------------------------------------------------
+
+
+def test_async_checkpoint_files_valid_and_resumable(tmp_path):
+    """Async saves land CRC-valid with the same contents a sync save would
+    persist, and a fresh trainer resumes from them bitwise."""
+    batches = _dict_batches(4)
+    d_async = str(tmp_path / "a")
+    d_sync = str(tmp_path / "s")
+    ta = _trainer()
+    ta.train(lambda: iter(batches), num_passes=2, save_dir=d_async,
+             async_checkpoint=True)
+    ts = _trainer()
+    ts.train(lambda: iter(batches), num_passes=2, save_dir=d_sync,
+             async_checkpoint=False)
+    for d in (d_async, d_sync):
+        for p in (0, 1):
+            assert ckpt.verify_pass(os.path.join(d, f"pass-{p:05d}"))
+    pa, _, _, ma = ckpt.load_pass(d_async, 1)
+    ps, _, _, ms = ckpt.load_pass(d_sync, 1)
+    _assert_bitwise(pa, ps, "async vs sync checkpoint")
+    assert ma["extra"] == ms["extra"]
+
+    t2 = _trainer()
+    t2.train(lambda: iter(batches), num_passes=2, save_dir=d_async,
+             auto_resume=True)
+    _assert_bitwise(_params(ta), _params(t2), "resume from async ckpt")
+
+
+def test_async_checkpoint_wait_surfaces_writer_error(tmp_path):
+    """A writer failure (save_dir ripped out mid-run) must re-raise on the
+    training thread at the durability barrier, not die silently."""
+    import shutil
+
+    t = _trainer()
+    batches = _dict_batches(2)
+    t.train(lambda: iter(batches), num_passes=1)  # init state
+    doomed = tmp_path / "doomed"
+    doomed.mkdir()
+    # make the writer fail deterministically: directory becomes a file
+    shutil.rmtree(doomed)
+    doomed.write_text("not a directory")
+    t.save(str(doomed / "ckpts"), 0, async_=True)
+    with pytest.raises((OSError, NotADirectoryError, FileExistsError)):
+        t.checkpoint_wait()
+    # the error is raised ONCE, then the writer is usable again
+    t.checkpoint_wait()
+    ok_dir = str(tmp_path / "ok")
+    t.save(ok_dir, 0, async_=True)
+    t.checkpoint_wait()
+    assert ckpt.verify_pass(os.path.join(ok_dir, "pass-00000"))

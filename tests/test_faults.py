@@ -221,6 +221,89 @@ def test_keep_last_n_retention_and_latest_pointer(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# async (zero-stall) checkpointing under chaos
+# ---------------------------------------------------------------------------
+
+
+def test_async_ckpt_truncate_plus_kill_leaves_valid_older(tmp_path, caplog):
+    """Crash-safety of the background writer: pass-1's async save is torn
+    (ckpt_truncate fires on the writer thread), then the process 'dies'
+    (injected kill) early in pass 2 while writes may still be in flight.
+    auto_resume must skip the corrupt pass-1 dir, land on the CRC-valid
+    pass-0 checkpoint, and finish bitwise-identical to a clean run."""
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)  # 2 batches/pass
+
+    t_ref = _trainer()
+    t_ref.train(batches, num_passes=3, feeder=feeder)
+    ref = _params(t_ref)
+
+    d = str(tmp_path / "chaos")
+    # each pass writes params.npz then opt.npz (states empty for this net):
+    # truncate hit 2 = pass-1 params.npz; kill hit 4 = pass 2 batch 0
+    with faults.inject("ckpt_truncate:step=2,kill:step=4") as inj:
+        t1 = _trainer()
+        with pytest.raises(faults.InjectedKill):
+            t1.train(batches, num_passes=3, feeder=feeder, save_dir=d,
+                     async_checkpoint=True)
+        assert inj.fired["ckpt_truncate"] == 1 and inj.fired["kill"] == 1
+    assert not ckpt.verify_pass(os.path.join(d, "pass-00001"))  # torn
+    with caplog.at_level("WARNING", logger="paddle_tpu.checkpoint"):
+        assert ckpt.find_latest_valid_pass(d) == 0  # older one still trusted
+    assert any("corrupt" in r.message for r in caplog.records)
+
+    t2 = _trainer()
+    t2.train(batches, num_passes=3, feeder=feeder, save_dir=d,
+             auto_resume=True, async_checkpoint=True)
+    got = _params(t2)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=0, atol=0, err_msg=k)
+
+
+def test_async_ckpt_keep_last_n_retention_out_of_band(tmp_path):
+    """keep_last_n runs on the writer thread, after saves that complete out
+    of band — retention and the latest pointer must still be exact once the
+    durability barrier returns."""
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)
+    d = str(tmp_path / "keep")
+    t = _trainer()
+    t.train(batches, num_passes=5, feeder=feeder, save_dir=d,
+            keep_last_n=2, async_checkpoint=True)
+    dirs = sorted(x for x in os.listdir(d) if x.startswith("pass-"))
+    assert dirs == ["pass-00003", "pass-00004"]
+    assert not [x for x in os.listdir(d) if x.startswith(".trash")]
+    with open(os.path.join(d, ckpt.LATEST_FILE)) as f:
+        assert f.read().strip() == "pass-00004"
+    assert ckpt.find_latest_valid_pass(d) == 4
+
+
+def test_preempt_drain_checkpoint_durable_with_async_writer(tmp_path):
+    """The exit-77 contract with async checkpointing on: by the time
+    Preempted propagates, the mid-pass checkpoint named in it passes CRC —
+    the drain's wait() barrier ran before the raise."""
+    from paddle_tpu.core import preempt
+    from paddle_tpu.trainer import Preempted
+
+    feeder = _feeder()
+    batches = rd.batch(_reader(), 32, drop_last=True)
+    d = str(tmp_path / "drain")
+    try:
+        with faults.inject("preempt:step=2"):
+            t = _trainer()
+            with pytest.raises(Preempted) as ei:
+                t.train(batches, num_passes=3, feeder=feeder, save_dir=d,
+                        async_checkpoint=True)
+        assert ei.value.checkpoint_dir is not None
+        assert ckpt.verify_pass(ei.value.checkpoint_dir)
+        man = ckpt.pass_manifest(d, ei.value.pass_id)
+        assert man["extra"]["mid_pass"] is True
+        assert man["extra"]["batches_done"] == ei.value.batches_done
+    finally:
+        preempt.reset()
+
+
+# ---------------------------------------------------------------------------
 # divergence guard
 # ---------------------------------------------------------------------------
 
