@@ -266,6 +266,8 @@ def run_seq2seq(cpu_fallback: bool, peak: float, n_dev: int) -> dict:
         "unit": "tokens/sec/chip",
         "mfu": round(mfu, 4),
         "vs_baseline": round(mfu / 0.50, 4),
+        # per-metric platform tag: fallback rounds are excludable per metric
+        "platform": jax.devices()[0].platform,
         "batch_size": bs,
         "seq_len": src_len,
         "vocab": vocab,
@@ -368,6 +370,12 @@ def run_bench(cpu_fallback: bool) -> dict:
         remat=chosen_remat,
     )
     trainer.init_state(dp.shard_batch(batch))
+    # memory/comms accounting for the data-parallel step (ISSUE 5): per-chip
+    # resident opt-state bytes from sharding metadata and the updater's
+    # modeled collective bytes/step — benchmarks/shard_update_bench.py sweeps
+    # these across replicated/sharded x compression
+    opt_state_bytes = stats.per_chip_tree_bytes(trainer.state["opt"])
+    collective_bytes = trainer.updater.collective_bytes_per_step()
 
     if scan_k > 1:
         # K distinct stacked batches per dispatch, scanned inside one
@@ -422,6 +430,8 @@ def run_bench(cpu_fallback: bool) -> dict:
         "ms_per_step": round(1000 * dt / steps, 2),
         "scan_k": scan_k,
         "remat": chosen_remat or "none",
+        "opt_state_bytes": opt_state_bytes,
+        "collective_bytes_per_step": collective_bytes,
         # BASELINE.json's north-star names v5p hardware; vs_baseline here is
         # MFU/0.50 against THIS chip's peak (device_kind above) — the target
         # is redefined to the available chip, not silently met on v5p
@@ -436,9 +446,13 @@ def run_bench(cpu_fallback: bool) -> dict:
             "misses": stats.RECOMPILES.cache_misses,
         }
     try:
+        # "platform" rides inside EVERY per-metric entry (not just top-level):
+        # trajectory tooling excludes CPU-fallback rounds per metric, and the
+        # fallback-relay path (accelerator died mid-run, child re-ran on CPU)
+        # only preserves per-entry fields (BENCH_r05 `error` postmortem)
         out["metrics"] = [
             {k: out[k] for k in ("metric", "value", "unit", "mfu", "vs_baseline",
-                                 "batch_size", "ms_per_step")},
+                                 "batch_size", "ms_per_step", "platform")},
             run_seq2seq(cpu_fallback, peak, n_dev),
         ]
     except Exception as exc:  # noqa: BLE001 — seq2seq must not kill the headline

@@ -1,0 +1,218 @@
+"""Sharded-update + compressed-collective benchmark (ISSUE 5 acceptance).
+
+Sweeps the data-parallel step's update strategy on a forced-host-device CPU
+mesh: {replicated, shard_update} x {none, bf16, int8} compression, at each
+requested device count (each count needs its own process — the XLA host
+device count is fixed at backend init, so the parent re-execs itself per N).
+
+Per cell it reports:
+  * steps_per_sec          (CPU wall clock — a smoke number, not the claim)
+  * opt_state_bytes        per-chip resident optimizer-state bytes, measured
+                           from sharding metadata (stats.per_chip_tree_bytes)
+  * collective_bytes_per_step  the updater's modeled bytes/chip crossing
+                           collectives (ring convention; see
+                           ParameterUpdater.collective_bytes_per_step)
+  * final cost             (convergence smoke for the quantized modes)
+
+and per device count it verifies the acceptance gates:
+  * sharded SGD params are BITWISE-equal to replicated after a full pass
+    (lr/momentum are powers of two so the scale products are exact — XLA
+    freely FMA-contracts them otherwise and arbitrary lr agrees only to
+    1-2 ULP; see tests/test_shard_update.py)
+  * per-chip opt-state bytes shrink ~N x under shard_update
+  * collective bytes/step shrink >= 2x under bf16 compression
+
+Usage:
+  JAX_PLATFORMS=cpu python benchmarks/shard_update_bench.py
+      [--devices 1,2,4] [--batches N] [--batch_size N] [--dim N] [--hidden N]
+
+Output: one JSON line {"metric": "shard_update_bench", ...} with the grid
+plus "gates" booleans.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_trainer(args, n_dev, shard, compression):
+    from paddle_tpu.nn import costs as C
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn.graph import reset_name_scope
+    from paddle_tpu.optim import SGD
+    from paddle_tpu.parallel import DataParallel, make_mesh
+    from paddle_tpu.trainer import SGDTrainer
+
+    reset_name_scope()
+    x = L.Data("x", shape=(args.dim,))
+    lbl = L.Data("label", shape=())
+    h = L.Fc(x, args.hidden, act="relu", name="h1")
+    h = L.Fc(h, args.hidden, act="relu", name="h2")
+    logits = L.Fc(h, args.classes, act=None, name="out")
+    cost = C.ClassificationCost(logits, lbl, name="cost")
+    dp = DataParallel(make_mesh({"data": n_dev}))
+    # power-of-two scales: exact products keep the sharded-vs-replicated
+    # comparison bitwise (momentum exercises a real optimizer slot)
+    return SGDTrainer(
+        cost, SGD(learning_rate=0.125, momentum=0.5), parallel=dp, seed=0,
+        shard_update=shard,
+        grad_compression=None if compression == "none" else compression,
+    )
+
+
+def run_cell(args, n_dev, shard, compression):
+    import numpy as np
+
+    from paddle_tpu.core import stats
+
+    tr = build_trainer(args, n_dev, shard, compression)
+    rs = np.random.RandomState(0)
+    x = rs.randn(args.batches * args.batch_size, args.dim).astype(np.float32)
+    y = rs.randint(0, args.classes, len(x))
+
+    def reader():
+        for i in range(0, len(x), args.batch_size):
+            yield {"x": x[i:i + args.batch_size], "label": y[i:i + args.batch_size]}
+
+    costs = []
+    from paddle_tpu.trainer.events import EndPass
+
+    def handler(e):
+        if isinstance(e, EndPass):
+            costs.append(e.metrics["avg_cost"])
+
+    tr.train(reader, num_passes=1, event_handler=handler)  # warmup+compile
+    t0 = time.time()
+    tr.train(reader, num_passes=1, event_handler=handler)
+    dt = time.time() - t0
+    return {
+        "mode": ("sharded" if shard else "replicated"),
+        "compression": compression,
+        "devices": n_dev,
+        "steps_per_sec": round(args.batches / dt, 1),
+        "opt_state_bytes": stats.per_chip_tree_bytes(tr.state["opt"]),
+        "param_bytes": stats.per_chip_tree_bytes(tr.state["params"]),
+        "collective_bytes_per_step": tr.updater.collective_bytes_per_step(),
+        "final_cost": round(float(costs[-1]), 6),
+    }, {k: np.asarray(v) for k, v in tr.state["params"].items()}
+
+
+def run_one_device_count(args, n_dev):
+    import numpy as np
+
+    cells = []
+    params = {}
+    grid = [(False, "none"), (True, "none"), (True, "bf16"), (True, "int8")]
+    for shard, comp in grid:
+        cell, p = run_cell(args, n_dev, shard, comp)
+        cells.append(cell)
+        params[(cell["mode"], comp)] = p
+    rep = params[("replicated", "none")]
+    sh = params[("sharded", "none")]
+    bitwise = all(
+        np.array_equal(
+            rep[k].view(np.uint32), sh[k].view(np.uint32)
+        )
+        for k in rep
+    )
+    by = {(c["mode"], c["compression"]): c for c in cells}
+    rep_c, sh_c = by[("replicated", "none")], by[("sharded", "none")]
+    bf_c = by[("sharded", "bf16")]
+    gates = {
+        "sgd_bitwise_equal": bool(bitwise),
+        # ~N x: padding/alignment costs a little, require >= 0.6*N
+        "opt_bytes_reduction": round(
+            rep_c["opt_state_bytes"] / max(sh_c["opt_state_bytes"], 1), 2
+        ),
+        "opt_bytes_reduced_enough": bool(
+            n_dev == 1
+            or rep_c["opt_state_bytes"] / max(sh_c["opt_state_bytes"], 1)
+            >= 0.6 * n_dev
+        ),
+        "bf16_collective_reduction": round(
+            rep_c["collective_bytes_per_step"]
+            / max(bf_c["collective_bytes_per_step"], 1), 2
+        ),
+        "bf16_collective_halved": bool(
+            n_dev == 1
+            or rep_c["collective_bytes_per_step"]
+            >= 2 * bf_c["collective_bytes_per_step"]
+        ),
+    }
+    return {"devices": n_dev, "cells": cells, "gates": gates}
+
+
+def child_main(args):
+    result = run_one_device_count(args, args._n_dev)
+    print("SHARD_BENCH_JSON " + json.dumps(result))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", default="1,2,4")
+    ap.add_argument("--batches", type=int, default=24)
+    ap.add_argument("--batch_size", type=int, default=32)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--classes", type=int, default=8)
+    ap.add_argument("--_child_devices", type=int, default=0, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args._child_devices:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "").replace(
+                "--xla_force_host_platform_device_count=8", ""
+            )
+            + f" --xla_force_host_platform_device_count={args._child_devices}"
+        ).strip()
+        args._n_dev = args._child_devices
+        child_main(args)
+        return
+
+    results = []
+    for n in [int(d) for d in args.devices.split(",") if d.strip()]:
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            f"--_child_devices={n}",
+            f"--batches={args.batches}", f"--batch_size={args.batch_size}",
+            f"--dim={args.dim}", f"--hidden={args.hidden}",
+            f"--classes={args.classes}",
+        ]
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=1200,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        line = next(
+            (l for l in out.stdout.splitlines() if l.startswith("SHARD_BENCH_JSON ")),
+            None,
+        )
+        if line is None:
+            results.append({"devices": n, "error": (out.stderr or out.stdout)[-500:]})
+        else:
+            results.append(json.loads(line[len("SHARD_BENCH_JSON "):]))
+
+    all_gates = [r["gates"] for r in results if "gates" in r]
+    ok = bool(all_gates) and all(
+        g["sgd_bitwise_equal"] and g["opt_bytes_reduced_enough"]
+        and g["bf16_collective_halved"]
+        for g in all_gates
+    )
+    print(json.dumps({
+        "metric": "shard_update_bench",
+        "value": 1.0 if ok else 0.0,
+        "unit": "acceptance",
+        "all_gates_pass": ok,
+        "results": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,55 @@ class EventCounter:
 # drains, standby takeovers)
 FT_EVENTS = EventCounter()
 
+# data-path events that are normal but worth counting: `padded_batches`
+# (trailing batches padded to the mesh data-axis multiple instead of
+# dropped — trainer + DevicePrefetcher increment it per padded batch)
+DATA_EVENTS = EventCounter()
+
+
+# -- memory / collective byte accounting (ISSUE 5 observability) -------------
+#
+# The sharded-update claims ("opt state 1/N per chip", "collective bytes cut
+# 2-4x") are backed by numbers, not vibes: per-chip resident bytes come from
+# sharding metadata (no device sync, usable at pass end inside the hot-loop
+# discipline), HBM peaks from the backend's memory_stats() where the platform
+# exposes it (TPU; CPU returns None and callers fall back to tree sizes).
+
+
+def per_chip_tree_bytes(tree) -> int:
+    """Bytes one chip holds for `tree`: per-leaf shard size from sharding
+    metadata (replicated leaves count fully, P('data')-sharded leaves count
+    1/N). Pure metadata — never fetches or syncs device buffers."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                shard = leaf.sharding.shard_shape(leaf.shape)
+            except Exception:  # uncommitted/fully-replicated fallback
+                shard = leaf.shape
+            total += int(np.prod(shard, dtype=np.int64)) * leaf.dtype.itemsize
+        else:
+            total += np.asarray(leaf).nbytes
+    return total
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """`jax.local_devices()[0].memory_stats()` where the backend implements
+    it (TPU: bytes_in_use / peak_bytes_in_use / ...), else {} — callers use
+    per_chip_tree_bytes as the portable fallback."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return {}
+    if not stats:
+        return {}
+    return {k: int(v) for k, v in stats.items() if isinstance(v, (int, float))}
+
 # Timer names stamped by the async execution runtime (PADDLE_TPU_TIMER):
 #   hostFeed / h2d        input-pipeline legs (trainer or prefetcher worker)
 #   forwardBackward       the device-step segment (syncs only when timing on)

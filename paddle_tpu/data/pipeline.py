@@ -190,9 +190,11 @@ class DevicePrefetcher:
         `feeder` is given, else feed-ready dict batches — e.g. a DoubleBuffer).
     feeder: optional DataFeeder applied on the worker thread.
     parallel: optional parallel.DataParallel — batches are placed with its
-        committed batch sharding (indivisible trailing batches are dropped,
-        matching the trainer's drop_last semantics); without it, batches go to
-        `device` (default: jax's default device) via plain device_put.
+        committed batch sharding (indivisible trailing batches are padded to
+        the shard multiple with a row mask — DataParallel.pad_batch — so the
+        sample stream matches the unsharded reader; only unpaddable ragged
+        batches are dropped); without it, batches go to `device` (default:
+        jax's default device) via plain device_put.
     prefetch_depth: how many device-resident batches to run ahead (N+1 are in
         flight counting the one the consumer holds). 2 hides a feeder that is
         as slow as the step; deeper only buys burst tolerance at the cost of
@@ -268,14 +270,15 @@ class DevicePrefetcher:
         """Raw reader item → device-resident batch (SKIP = drop)."""
         batch = self._feed(raw)
         with stats.timer("h2d"):
-            if self.parallel is not None and not self.parallel.batch_divisible(
-                batch
-            ):
-                log.warning(
-                    "prefetcher dropping batch: size not divisible by "
-                    "the mesh data axis"
-                )
-                return SKIP
+            if self.parallel is not None:
+                # pad to the shard multiple with a row mask instead of
+                # dropping (cost layers zero pad rows; see
+                # DataParallel.pad_batch) — the sample stream now matches
+                # the unsharded reader exactly; only unpaddable ragged
+                # batches drop
+                batch = self.parallel.maybe_pad_batch(batch, where="prefetcher")
+                if batch is None:
+                    return SKIP
             return self._device_put(batch)
 
     def _grouped_reader(self):
@@ -294,13 +297,16 @@ class DevicePrefetcher:
         the group cannot stack as a whole."""
         batches = [self._feed(raw) for raw in group]
         if self.parallel is not None:
-            keep = [b for b in batches if self.parallel.batch_divisible(b)]
-            if len(keep) < len(batches):
-                log.warning(
-                    "prefetcher dropping %d batch(es): size not divisible "
-                    "by the mesh data axis", len(batches) - len(keep),
+            # a padded batch gains a mask slot → its signature differs →
+            # the group degrades to singles below
+            batches = [
+                b
+                for b in (
+                    self.parallel.maybe_pad_batch(b, where="prefetcher group")
+                    for b in batches
                 )
-            batches = keep
+                if b is not None
+            ]
         if not batches:
             return SKIP
         stackable = (

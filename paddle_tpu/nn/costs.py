@@ -32,6 +32,23 @@ def _flatten_seq(value: Array, lengths: Optional[Array]):
     return flat, mask
 
 
+def _masked_mean(ctx: Context, cost: Array, batch_rows: int, timesteps=None):
+    """Mean over examples honoring Context.sample_mask — the [B] 0/1 row
+    validity from a mesh-divisibility-padded batch (graph.SAMPLE_MASK_KEY).
+    Padded rows weigh 0 and the denominator is the REAL row count, so the
+    padded batch reproduces the unpadded batch's cost (and, through the
+    backward, its gradients). Without a mask this is the plain sum/B the
+    trainer always used — bitwise-unchanged for unpadded batches."""
+    smask = getattr(ctx, "sample_mask", None)
+    if smask is None:
+        return jnp.sum(cost) / batch_rows
+    w = smask.astype(cost.dtype).reshape(-1)
+    if timesteps is not None:  # sequence costs flatten to [(B*T)]
+        w = jnp.repeat(w, timesteps)
+    denom = jnp.maximum(jnp.sum(smask.astype(jnp.float32)), 1.0)
+    return jnp.sum(cost * w) / denom
+
+
 class CostLayer(Layer):
     """Base for costs: handles sequence flattening + per-example weighting."""
 
@@ -70,8 +87,10 @@ class CostLayer(Layer):
             cost = cost * w
         # mean over examples (sequences count each timestep, like the reference's
         # per-instance sum normalized by batch size in Argument::sum semantics).
-        denom = pred_arg.value.shape[0]
-        total = self.coeff * jnp.sum(cost) / denom
+        t = pred_arg.value.shape[1] if pred_arg.lengths is not None else None
+        total = self.coeff * _masked_mean(
+            ctx, cost, pred_arg.value.shape[0], timesteps=t
+        )
         return Argument(total)
 
 
@@ -198,7 +217,7 @@ class RankCost(Layer):
         cost = jax.nn.softplus(o) - t * o  # log(1+e^o) - t*o
         if self.has_weight:
             cost = cost * ins[3].value.reshape(-1)
-        return Argument(self.coeff * jnp.mean(cost))
+        return Argument(self.coeff * _masked_mean(ctx, cost, cost.shape[0]))
 
 
 @LAYERS.register("multi_binary_label_cross_entropy")
@@ -227,7 +246,10 @@ class SumCost(Layer):
 
     def forward(self, ctx, ins):
         v = ins[0].value
-        return Argument(self.coeff * jnp.sum(v) / v.shape[0])
+        if getattr(ctx, "sample_mask", None) is None:
+            return Argument(self.coeff * jnp.sum(v) / v.shape[0])
+        per_row = jnp.sum(v.reshape(v.shape[0], -1), axis=-1)
+        return Argument(self.coeff * _masked_mean(ctx, per_row, v.shape[0]))
 
 
 @LAYERS.register("smooth_l1_cost")

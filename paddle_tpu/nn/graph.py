@@ -31,6 +31,13 @@ from paddle_tpu.core import dtypes
 Array = jax.Array
 Initializer = Callable[[jax.Array, Sequence[int], Any], Array]
 
+# Reserved batch slot: [B] float 0/1 row-validity mask attached when a
+# trailing batch is padded up to the mesh data-axis multiple
+# (DataParallel.pad_batch). Network._run strips it into Context.sample_mask;
+# cost layers weight per-example costs by it and normalize by the real row
+# count, so padded rows contribute nothing to cost or gradients.
+SAMPLE_MASK_KEY = "__sample_mask__"
+
 
 # ---------------------------------------------------------------------------
 # Argument: the inter-layer value (paddle/parameter/Argument.h:26)
@@ -158,6 +165,11 @@ class Context:
         # at once (e.g. RecurrentGroup runs one scan shared by all its output
         # nodes); keyed by (id(core), tag)
         self.cache: Dict[Any, Any] = {}
+        # [B] 0/1 weights from a padded batch (SAMPLE_MASK_KEY slot): cost
+        # layers zero padded rows out of the loss and normalize by the REAL
+        # row count, so a mesh-divisibility-padded batch reproduces the
+        # unpadded batch's cost and gradients exactly
+        self.sample_mask: Optional[Array] = None
 
     # -- rng ---------------------------------------------------------------
     def next_rng(self, tag: str) -> Array:
@@ -393,6 +405,11 @@ class Network:
     def _run(self, ctx: Context, batch: Dict[str, Any]) -> Dict[str, Argument]:
         from paddle_tpu.core import stack_trace
 
+        if SAMPLE_MASK_KEY in batch:
+            # reserved slot from a mesh-divisibility-padded batch: it feeds
+            # the cost layers' masking via the context, never a data layer
+            ctx.sample_mask = jnp.asarray(batch[SAMPLE_MASK_KEY])
+            batch = {k: v for k, v in batch.items() if k != SAMPLE_MASK_KEY}
         values: Dict[str, Argument] = {}
         for layer in self.layer_order:
             if layer.type_name == "data":

@@ -33,6 +33,40 @@ Array = jax.Array
 from paddle_tpu.nn.layers import _attr
 
 
+def _mean_over_examples(ctx: Context, per_sample: Array) -> Array:
+    """Mean over a per-example cost vector honoring Context.sample_mask —
+    the [B] 0/1 row validity from a mesh-divisibility-padded batch
+    (nn/costs._masked_mean is the dense-cost counterpart): padded rows weigh
+    0 and the denominator is the real row count, so the padded batch
+    reproduces the unpadded batch's cost and gradients. A per-sample vector
+    that is a per-timestep flattening of [B] rows (e.g. NCE over flattened
+    sequence steps) repeats the mask per step; layouts that don't divide the
+    mask keep the unmasked mean (loudly unmaskable is worse than the old
+    drop-the-batch behavior they replace). Without a mask this is exactly
+    the jnp.mean these layers always used — bitwise-unchanged."""
+    smask = getattr(ctx, "sample_mask", None)
+    if smask is None:
+        return jnp.mean(per_sample)
+    n, b = per_sample.shape[0], smask.shape[0]
+    if not b or n % b != 0:
+        import logging
+
+        logging.getLogger("paddle_tpu.costs").warning(
+            "struct cost cannot apply the pad-row mask: per-sample vector "
+            "of %d rows does not divide the [%d] sample mask — the padded "
+            "rows join this batch's mean unmasked (duplicates of the last "
+            "real row). Size batches divisibly by the mesh data axis to "
+            "avoid the bias.", n, b,
+        )
+        return jnp.mean(per_sample)
+    reps = n // b
+    w = smask.astype(per_sample.dtype)
+    if reps > 1:
+        w = jnp.repeat(w, reps)
+    denom = jnp.maximum(jnp.sum(smask.astype(jnp.float32)) * reps, 1.0)
+    return jnp.sum(per_sample * w) / denom
+
+
 @LAYERS.register("ctc", "warp_ctc")
 class CTCCost(Layer):
     """CTC negative log-likelihood (CTCLayer.cpp; `warp_ctc` is the same math —
@@ -73,7 +107,7 @@ class CTCCost(Layer):
             blank=self.blank,
             norm_by_times=self.norm_by_times,
         )
-        return Argument(self.coeff * jnp.mean(nll))
+        return Argument(self.coeff * _mean_over_examples(ctx, nll))
 
 
 @LAYERS.register("crf")
@@ -109,7 +143,7 @@ class CRFCost(Layer):
         nll = crf_ops.crf_nll(
             emit.value, emit.lengths, labels.value.astype(jnp.int32), w
         )
-        return Argument(self.coeff * jnp.mean(nll))
+        return Argument(self.coeff * _mean_over_examples(ctx, nll))
 
 
 @LAYERS.register("crf_decoding")
@@ -210,7 +244,7 @@ class NCECost(Layer):
         def _reduce(per_sample):
             if sample_w is not None:
                 per_sample = per_sample * sample_w
-            return jnp.mean(per_sample)
+            return _mean_over_examples(ctx, per_sample)
 
         if not ctx.train:
             logits = x @ w.T + (b if b is not None else 0.0)
@@ -313,7 +347,7 @@ class HierarchicalSigmoid(Layer):
         y = bits.astype(s.dtype)
         loss = jnp.maximum(s, 0.0) - s * y + jnp.log1p(jnp.exp(-jnp.abs(s)))
         loss = jnp.where(valid, loss, 0.0)
-        return Argument(jnp.mean(jnp.sum(loss, axis=1)))
+        return Argument(_mean_over_examples(ctx, jnp.sum(loss, axis=1)))
 
 
 @LAYERS.register("lambda_cost")
@@ -387,7 +421,7 @@ class LambdaCost(Layer):
         rel_gt = (g[:, :, None] > g[:, None, :]).astype(s.dtype)
         pmask = mask[:, :, None] * mask[:, None, :]
         loss = jnp.sum(dndcg * pair_loss * rel_gt * pmask, axis=(1, 2))
-        return Argument(self.coeff * jnp.mean(loss))
+        return Argument(self.coeff * _mean_over_examples(ctx, loss))
 
 
 class BeamInput:
@@ -492,4 +526,4 @@ class CrossEntropyOverBeam(Layer):
         per_sample = jnp.take_along_axis(
             cost_mat, first_off[:, None], axis=1
         )[:, 0]
-        return Argument(jnp.mean(per_sample))
+        return Argument(_mean_over_examples(ctx, per_sample))

@@ -62,6 +62,35 @@ class Optimizer:
             "t": jnp.zeros((), jnp.int32),  # step counter (Adam bias correction)
         }
 
+    def update_one(
+        self, name: str, g: Array, s: Tuple[Array, ...], p: Array, lr: Array
+    ) -> Tuple[Array, Tuple[Array, ...]]:
+        """One parameter's update with its ParamAttr semantics (static,
+        clipping, L1/L2 decay, per-param lr scale). Every op here is
+        elementwise, so callers may pass RESHAPED views of the parameter —
+        the ZeRO-style ShardedUpdater (parallel/updaters.py) runs this on the
+        flat [n_shards, chunk] layout and gets the same math per element.
+        Requires `self._t` to be set (bias correction) before the call."""
+        attr = self.param_attrs.get(name) or ParamAttr()
+        if attr.is_static:
+            return p, s
+        g = g.astype(jnp.float32)
+        clip = attr.gradient_clipping_threshold or self.gradient_clipping_threshold
+        if clip:
+            g = jnp.clip(g, -clip, clip)
+        # L2 decay folded into the gradient (Regularizer.h L2Regularizer).
+        l2 = attr.l2_decay if attr.l2_decay is not None else self.l2_rate
+        if l2:
+            g = g + l2 * p
+        plr = lr * attr.learning_rate
+        new_p, new_s = self.apply_param(g, s, p, plr)
+        # L1 decay applied as post-update shrinkage (L1Regularizer::update).
+        l1 = attr.l1_decay if attr.l1_decay is not None else self.l1_rate
+        if l1:
+            shrink = plr * l1
+            new_p = jnp.sign(new_p) * jnp.maximum(jnp.abs(new_p) - shrink, 0.0)
+        return new_p, new_s
+
     def update(
         self, grads: Params, state: Dict[str, Any], params: Params, lr: Array
     ) -> Tuple[Params, Dict[str, Any]]:
@@ -70,29 +99,9 @@ class Optimizer:
         new_slots: Dict[str, Tuple[Array, ...]] = {}
         self._t = t  # visible to apply_param for bias correction
         for k, p in params.items():
-            attr = self.param_attrs.get(k) or ParamAttr()
-            g = grads[k]
-            if attr.is_static:
-                new_params[k] = p
-                new_slots[k] = state["slots"][k]
-                continue
-            g = g.astype(jnp.float32)
-            clip = attr.gradient_clipping_threshold or self.gradient_clipping_threshold
-            if clip:
-                g = jnp.clip(g, -clip, clip)
-            # L2 decay folded into the gradient (Regularizer.h L2Regularizer).
-            l2 = attr.l2_decay if attr.l2_decay is not None else self.l2_rate
-            if l2:
-                g = g + l2 * p
-            plr = lr * attr.learning_rate
-            new_p, new_s = self.apply_param(g, state["slots"][k], p, plr)
-            # L1 decay applied as post-update shrinkage (L1Regularizer::update).
-            l1 = attr.l1_decay if attr.l1_decay is not None else self.l1_rate
-            if l1:
-                shrink = plr * l1
-                new_p = jnp.sign(new_p) * jnp.maximum(jnp.abs(new_p) - shrink, 0.0)
-            new_params[k] = new_p
-            new_slots[k] = new_s
+            new_params[k], new_slots[k] = self.update_one(
+                k, grads[k], state["slots"][k], p, lr
+            )
         return new_params, {"slots": new_slots, "t": t}
 
 

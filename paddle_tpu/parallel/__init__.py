@@ -14,4 +14,6 @@ from paddle_tpu.parallel.updaters import (  # noqa: F401
     IciAllReduceUpdater,
     ParameterUpdater,
     SgdLocalUpdater,
+    ShardedUpdater,
 )
+from paddle_tpu.parallel import compression as compression  # noqa: F401

@@ -15,13 +15,16 @@ ICI — the ring the reference hand-codes is what the hardware collective does."
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddle_tpu.nn.graph import ParamAttr
+from paddle_tpu.nn.graph import SAMPLE_MASK_KEY, ParamAttr
+
+log = logging.getLogger("paddle_tpu.parallel")
 
 
 class DataParallel:
@@ -49,12 +52,74 @@ class DataParallel:
             return NamedSharding(self.mesh, P(*spec))
         return self._replicated
 
+    @property
+    def data_axis_size(self) -> int:
+        return int(self.mesh.shape[self.batch_axis])
+
     def batch_divisible(self, batch: Dict[str, Any]) -> bool:
-        n_shards = self.mesh.shape[self.batch_axis]
+        n_shards = self.data_axis_size
         for v in batch.values():
             if np.shape(v)[0] % n_shards != 0:
                 return False
         return True
+
+    def pad_batch(self, batch: Dict[str, Any]):
+        """Pad an indivisible host batch up to the next data-axis multiple by
+        repeating each slot's last row, and attach a [B_padded] 0/1 validity
+        mask under graph.SAMPLE_MASK_KEY. Cost layers weight rows by the mask
+        and normalize by the real count (nn/costs._masked_mean), so the
+        padded batch reproduces the unpadded batch's cost/gradients — the
+        trailing batch trains instead of being dropped. Returns
+        (padded_batch, n_pad); (batch, 0) when already divisible.
+
+        Caveat: layers that COUPLE rows through batch statistics (batch
+        norm) see the repeated pad rows in their mean/var and moving
+        averages — the mask zeroes cost contributions, not statistic
+        contributions. Repeating real rows (rather than zeros) bounds the
+        distortion to a duplicated-sample bias on ONE trailing batch per
+        pass; size batches divisibly when exact BN statistics matter."""
+        return self._pad_batch(batch)
+
+    def maybe_pad_batch(
+        self, batch: Dict[str, Any], where: str = "batch"
+    ) -> Optional[Dict[str, Any]]:
+        """The single pad-or-drop gate every consumer (trainer train loop,
+        trainer.test, DevicePrefetcher) goes through: divisible batches pass
+        untouched, indivisible ones pad+mask (counted in
+        stats.DATA_EVENTS['padded_batches']), unpaddable ragged ones drop
+        with a warning and return None."""
+        if self.batch_divisible(batch):
+            return batch
+        padded, n_pad = self._pad_batch(batch)
+        if n_pad:
+            from paddle_tpu.core import stats
+
+            stats.DATA_EVENTS.incr("padded_batches")
+            return padded
+        log.warning(
+            "%s: dropping batch — ragged slot sizes not divisible by the "
+            "mesh data axis", where,
+        )
+        return None
+
+    def _pad_batch(self, batch: Dict[str, Any]):
+        n_shards = self.data_axis_size
+        rows = {np.shape(v)[0] for v in batch.values()}
+        if len(rows) != 1:
+            # heterogeneous leading dims (exotic provider): cannot pad safely
+            return batch, 0
+        b = rows.pop()
+        pad = (-b) % n_shards
+        if pad == 0:
+            return batch, 0
+        out = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            out[k] = np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+        mask = np.ones(b + pad, np.float32)
+        mask[b:] = 0.0
+        out[SAMPLE_MASK_KEY] = mask
+        return out, pad
 
     def _put(self, batch: Dict[str, Any], sharding: NamedSharding) -> Dict[str, Any]:
         out = {}
@@ -93,21 +158,48 @@ class DataParallel:
             batches, NamedSharding(self.mesh, P(None, self.batch_axis))
         )
 
-    def shard_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
+    def shard_state(
+        self, state: Dict[str, Any], opt_sharding=None
+    ) -> Dict[str, Any]:
+        """Place a train state on the mesh. `opt_sharding(param_name, leaf)`
+        (from ParameterUpdater.opt_leaf_sharding) overrides the placement of
+        optimizer slot/EF leaves — the ZeRO ShardedUpdater returns its
+        data-axis sharding for flat leaves so they go STRAIGHT to their 1/n
+        resident placement (a replicated intermediate would momentarily cost
+        the full optimizer state per chip at init/resume, exactly the peak
+        shard_update exists to avoid)."""
         params = {
             k: jax.device_put(v, self.param_sharding(k, v.ndim))
             for k, v in state["params"].items()
         }
-        # optimizer slots follow their parameter's sharding
+        # optimizer slots follow their parameter's sharding unless the
+        # updater dictates its own layout for them
         slots = {
             k: tuple(
-                jax.device_put(s, self.param_sharding(k, s.ndim)) for s in ss
+                jax.device_put(
+                    s,
+                    (opt_sharding and opt_sharding(k, s))
+                    or self.param_sharding(k, s.ndim),
+                )
+                for s in ss
             )
             for k, ss in state["opt"]["slots"].items()
         }
         opt = dict(state["opt"])
         opt["slots"] = slots
         opt["t"] = jax.device_put(opt["t"], self._replicated)
+        if "ef" in opt:
+            # compression error-feedback residuals share the flat layout;
+            # placed unconditionally like every other leaf (a caller without
+            # the seam still gets a committed replicated placement, never an
+            # unplaced array that reshards on every step)
+            opt["ef"] = {
+                k: jax.device_put(
+                    e,
+                    (opt_sharding and opt_sharding(k, e)) or self._replicated,
+                )
+                for k, e in opt["ef"].items()
+            }
         rest = {
             k: jax.tree.map(lambda v: jax.device_put(v, self._replicated), state[k])
             for k in state

@@ -27,7 +27,7 @@ from paddle_tpu.core import faults, preempt, stats
 from paddle_tpu.data.pipeline import StackedBatch
 from paddle_tpu.data.pipeline import coerce_batch as _coerce_batch
 from paddle_tpu.data.pipeline import is_device_batch
-from paddle_tpu.nn.graph import Argument, Layer, Network
+from paddle_tpu.nn.graph import SAMPLE_MASK_KEY, Argument, Layer, Network
 from paddle_tpu.optim.optimizers import Optimizer
 from paddle_tpu.optim.average import ModelAverage
 from paddle_tpu.optim import schedules
@@ -89,6 +89,8 @@ class SGDTrainer:
         remat: Optional[str] = None,  # None | "conv_only" | "full"
         divergence_policy: Optional[str] = None,  # skip_batch|rollback|raise
         guard_check_every: int = 16,  # steps between divergence-guard polls
+        shard_update: bool = False,  # ZeRO-1 sharded update over the data axis
+        grad_compression: Optional[str] = None,  # None/none | bf16 | int8
     ):
         costs = [cost] if isinstance(cost, Layer) else list(cost)
         self.cost_names = [c.name for c in costs]
@@ -101,15 +103,46 @@ class SGDTrainer:
         # inside the compiled step goes through updater.apply, and host-side
         # pass boundaries go through start_pass/finish_pass (barriers on
         # multi-host). Default: local updater, or the ICI all-reduce updater
-        # when a DataParallel mesh is configured.
-        if updater is None:
-            from paddle_tpu.parallel import IciAllReduceUpdater, SgdLocalUpdater
-
-            updater = (
-                IciAllReduceUpdater(optimizer, parallel)
-                if parallel is not None
-                else SgdLocalUpdater(optimizer)
+        # when a DataParallel mesh is configured; shard_update=True swaps in
+        # the ZeRO-1 ShardedUpdater (reduce-scatter grads over the mesh data
+        # axis → shard-local optimizer step on 1/N of the optimizer state →
+        # all-gather of updated params), optionally with a compressed
+        # collective payload (--grad_compression; parallel/compression.py).
+        if (shard_update or grad_compression not in (None, "none")) and (
+            parallel is None and updater is None
+        ):
+            raise ValueError(
+                "shard_update/grad_compression need a DataParallel mesh "
+                "(SGDTrainer(parallel=...)): there is no data axis to shard "
+                "the update over"
             )
+        if grad_compression not in (None, "none") and not shard_update:
+            raise ValueError(
+                "grad_compression wraps the sharded update's reduce-scatter "
+                "— pass shard_update=True with it"
+            )
+        if updater is not None and (
+            shard_update or grad_compression not in (None, "none")
+        ):
+            raise ValueError(
+                "shard_update/grad_compression select the built-in "
+                "ShardedUpdater and cannot combine with an explicit "
+                "updater= — construct ShardedUpdater(optimizer, parallel, "
+                "compression=...) yourself instead"
+            )
+        if updater is None:
+            from paddle_tpu.parallel import (
+                IciAllReduceUpdater, SgdLocalUpdater, ShardedUpdater,
+            )
+
+            if parallel is not None and shard_update:
+                updater = ShardedUpdater(
+                    optimizer, parallel, compression=grad_compression or "none"
+                )
+            elif parallel is not None:
+                updater = IciAllReduceUpdater(optimizer, parallel)
+            else:
+                updater = SgdLocalUpdater(optimizer)
         self.updater = updater
         self.schedule = schedule or schedules.build(optimizer.learning_rate)
         self.model_average = model_average or ModelAverage(0.0)
@@ -136,6 +169,24 @@ class SGDTrainer:
                 f"guard_check_every must be >= 1, got {guard_check_every}"
             )
         self.guard_check_every = guard_check_every
+        # Persistent-compile-cache opt-out for MESH step programs: jax
+        # 0.4.37's CPU backend can SEGFAULT executing a DESERIALIZED
+        # (persistent-cache-hit) donated multi-device program once other
+        # collective-using donated programs have run in the process
+        # (repro: two identical DataParallel trainings in one process with
+        # jax_compilation_cache_dir set — the second dies inside the
+        # deserialized executable; cache-free or donation-free runs are
+        # fine). A per-trainer constant folded into the traced step changes
+        # the cache key, so mesh steps always compile fresh; the in-memory
+        # executable cache still amortizes within the trainer, and
+        # single-device programs keep the full persistent-cache benefit.
+        import os as _os
+
+        self._cache_salt = (
+            (int.from_bytes(_os.urandom(4), "big") & 0x7FFFFFFF) | 1
+            if parallel is not None
+            else 0
+        )
         self.state: Optional[TrainState] = None
         self._step_fn = None
         self._multi_fn = None  # K-step fused dispatch (make_multi_step), lazy
@@ -157,7 +208,10 @@ class SGDTrainer:
         self.optimizer.param_attrs = self.network.param_attrs
         state: TrainState = {
             "params": params,
-            "opt": self.optimizer.init_state(params),
+            # the updater owns the opt-state LAYOUT: canonical per-param
+            # slots by default, flat [n, chunk] data-axis-sharded slots
+            # (+ error-feedback residuals) under shard_update
+            "opt": self.updater.init_opt_state(params),
             "states": states,
             "avg": self.model_average.init_state(params),
             # int32 (not float32): float32 absorbs small increments past 2^24
@@ -182,7 +236,11 @@ class SGDTrainer:
             # parallel plan before placing the state on the mesh
             if not self.parallel.param_attrs:
                 self.parallel.param_attrs = self.network.param_attrs
-            state = self.parallel.shard_state(state)
+            # ZeRO-sharded slot/EF leaves land DIRECTLY on their 1/n-per-chip
+            # resident placement via the updater's opt_leaf_sharding rule
+            state = self.parallel.shard_state(
+                state, opt_sharding=self.updater.opt_leaf_sharding
+            )
         self.state = state
         return state
 
@@ -198,7 +256,20 @@ class SGDTrainer:
         avg = self.model_average
 
         def step(state: TrainState, batch: Dict[str, Any]):
-            bs = _batch_size(batch)
+            mask = batch.get(SAMPLE_MASK_KEY)
+            # padded trailing batch: the samples counter advances by the REAL
+            # row count (mask sum), so LR schedules and the per-step rng match
+            # the unpadded run sample-for-sample
+            bs = (
+                _batch_size(batch)
+                if mask is None
+                else jnp.sum(mask).astype(jnp.int32)
+            )
+            if self._cache_salt:
+                # dead term, folded to 0 by XLA AFTER the compile-cache key
+                # is taken: embeds the per-trainer salt in mesh programs
+                # (see __init__ — persistent-cache opt-out)
+                bs = bs + jnp.asarray(self._cache_salt, jnp.int32) * 0
             lr = schedule(state["samples"].astype(jnp.float32)) * state["lr_scale"]
             step_rng = jax.random.fold_in(state["rng"], state["samples"])
 
@@ -485,6 +556,11 @@ class SGDTrainer:
             # sharding); one tiny dispatch per pass, not per step
             self.state["cost_acc"] = self.state["cost_acc"] * 0
         stepped = 0  # batches whose update was dispatched this pass
+        # per-pass padded-batch count as a DATA_EVENTS delta (same pattern as
+        # divergence_events/FT_EVENTS): padding happens EITHER on this host
+        # path or on a DevicePrefetcher worker — a local counter would read 0
+        # whenever the prefetcher does the padding
+        pass_pad0 = stats.DATA_EVENTS.get("padded_batches")
         pass_div0 = self._diverged_seen
         steps_since_poll = 0
         pending: List[tuple] = []  # [(logical batch id, feed-ready batch)]
@@ -657,14 +733,16 @@ class SGDTrainer:
                         else _coerce_batch(raw)
                     )
             if self.parallel is not None and not on_device:
-                if not self.parallel.batch_divisible(batch):
-                    # trailing partial batch not divisible by the mesh data
-                    # axis — skip it (drop_last semantics), like the
-                    # per-thread batch split in MultiGradientMachine
-                    log.warning(
-                        "skipping batch %d: size not divisible by mesh "
-                        "data axis", batch_id,
-                    )
+                # trailing partial batch not divisible by the mesh data axis
+                # pads to the next shard multiple with a 0/1 row mask (cost
+                # layers zero the pad rows and normalize by the real count),
+                # so the batch TRAINS and pass averages/sample counts match
+                # the unsharded run — the old drop_last skip lost those
+                # samples every pass; only unpaddable ragged batches drop
+                batch = self.parallel.maybe_pad_batch(
+                    batch, where=f"train batch {batch_id}"
+                )
+                if batch is None:
                     if not pending:
                         boundary = logical
                     continue
@@ -721,7 +799,28 @@ class SGDTrainer:
             "pass_seconds": time.time() - t0,
             "shape_signatures": stats.RECOMPILES.pass_signatures(),
             "divergence_events": n_diverged,
+            "padded_batches": (
+                stats.DATA_EVENTS.get("padded_batches") - pass_pad0
+            ),
         }
+        if self.parallel is not None and self.state is not None:
+            # memory/comms observability for the sharded update: per-chip
+            # resident bytes from sharding METADATA (no device sync — hot-loop
+            # discipline holds, this is pass-end bookkeeping), modeled
+            # collective bytes from the updater, HBM peak where the backend
+            # reports it (TPU memory_stats; {} on CPU)
+            metrics["param_bytes"] = stats.per_chip_tree_bytes(
+                self.state["params"]
+            )
+            metrics["opt_state_bytes"] = stats.per_chip_tree_bytes(
+                self.state["opt"]
+            )
+            metrics["collective_bytes_per_step"] = (
+                self.updater.collective_bytes_per_step()
+            )
+            hbm = stats.device_memory_stats()
+            if hbm.get("peak_bytes_in_use"):
+                metrics["peak_hbm_bytes"] = hbm["peak_bytes_in_use"]
         if stats.GLOBAL_STATS.enabled:
             log.info("pass %d %s", pass_id, stats.RECOMPILES.report())
         self.updater.finish_pass()
@@ -895,9 +994,22 @@ class SGDTrainer:
                 else _coerce_batch(raw)
             )
             if self.parallel is not None and not on_device:
+                # same pad+mask treatment as training: the masked cost is
+                # the mean over REAL rows only
+                batch = self.parallel.maybe_pad_batch(batch, where="test batch")
+                if batch is None:
+                    continue
                 batch = self.parallel.shard_batch(batch)
             cost, _ = self._eval_fn(self.state, batch)
-            bs = _batch_size(batch)
+            if SAMPLE_MASK_KEY in batch:
+                # padded batch (here or on a prefetcher worker): real rows
+                # only — the masked cost is already the mean over them. The
+                # sum runs as an eager device op so a mesh-sharded mask works
+                # on multi-host too (the result is a replicated, addressable
+                # scalar; np.asarray on the global mask would raise there)
+                bs = int(jnp.sum(jnp.asarray(batch[SAMPLE_MASK_KEY])))
+            else:
+                bs = _batch_size(batch)
             total += float(cost) * bs
             n += bs
         return {"cost": total / max(n, 1), "samples": n}
@@ -926,7 +1038,11 @@ class SGDTrainer:
         checkpoint_wait(); train()/load()/the preempt drain invoke that
         barrier themselves."""
         assert self.state is not None
-        opt_tree = {"opt": self.state["opt"]}
+        # checkpoints always store the optimizer's CANONICAL per-param layout:
+        # a ShardedUpdater gathers its flat [n, chunk] slot/EF shards back to
+        # parameter shapes here, so the same pass dir resumes under
+        # shard_update on or off (and across device counts) bitwise
+        opt_tree = {"opt": self.updater.to_canonical(self.state["opt"])}
         if self.state["avg"]:
             opt_tree["avg"] = self.state["avg"]
         extra_meta = {
@@ -984,11 +1100,13 @@ class SGDTrainer:
         if states:
             self.state["states"] = {k: jnp.asarray(v) for k, v in states.items()}
         if opt_flat:
-            template = {"opt": self.state["opt"]}
+            # restore against the canonical layout (what save() wrote), then
+            # re-flatten for a ShardedUpdater — identity for the others
+            template = {"opt": self.updater.to_canonical(self.state["opt"])}
             if self.state["avg"]:
                 template["avg"] = self.state["avg"]
             restored = ckpt_mod.restore_tree(template, opt_flat)
-            self.state["opt"] = restored["opt"]
+            self.state["opt"] = self.updater.from_canonical(restored["opt"])
             if "avg" in restored:
                 self.state["avg"] = restored["avg"]
         samples = manifest.get("extra", {}).get("samples")
@@ -999,8 +1117,11 @@ class SGDTrainer:
             self.state["lr_scale"] = jnp.asarray(float(lr_scale), jnp.float32)
         if self.parallel is not None:
             # re-establish mesh placement (sharded head weights, replicated
-            # slots) — plain asarray loads land unsharded otherwise
-            self.state = self.parallel.shard_state(self.state)
+            # or ZeRO-flat slots) — plain asarray loads land unsharded
+            # otherwise
+            self.state = self.parallel.shard_state(
+                self.state, opt_sharding=self.updater.opt_leaf_sharding
+            )
 
 
 def _stack_batches(batches: List[Dict[str, Any]]) -> Dict[str, Any]:

@@ -111,8 +111,13 @@ class SGD:
                     params[k] = jnp.asarray(self.parameters.get(k))
             self._trainer.state["params"] = params
             if self._trainer.parallel is not None:
+                # pass the updater's placement seam so ZeRO flat optimizer
+                # slots stay resident-sharded (a bare shard_state would
+                # re-place them replicated — the full-opt-state peak
+                # shard_update exists to avoid)
                 self._trainer.state = self._trainer.parallel.shard_state(
-                    self._trainer.state
+                    self._trainer.state,
+                    opt_sharding=self._trainer.updater.opt_leaf_sharding,
                 )
 
     def _sync_parameters_out(self) -> None:

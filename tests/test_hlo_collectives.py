@@ -1,0 +1,110 @@
+"""HLO collective-count lint for the data-parallel train step (ISSUE 5).
+
+Compiles the trainer's step on a 4-device slice of the CPU host mesh and
+counts the collective ops XLA emitted — the same way test_lint_hotloop.py
+pins host syncs. A silent regression to chattier collectives (e.g. an
+updater change that makes XLA emit per-parameter gathers where it combined
+them, or an extra all-reduce from a stray unsharded reduction) changes these
+counts and fails the build.
+
+The counts are pinned for THIS model (3 Fc layers → 6 parameters) on the
+CPU partitioner of the jax build in the container. On CPU the partitioner
+realizes the sharded update's scatter leg as all-reduce + dynamic-slice
+(the TPU weight-update-sharding pass forms a true reduce-scatter — PAPERS.md
+"Automatic Cross-Replica Sharding of Weight Update..."), so the invariants
+checked here are: the replicated path has NO gathers, the sharded path adds
+a bounded number of all-gathers, and neither path's collective count scales
+with batch or silently doubles."""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.graph import reset_name_scope
+from paddle_tpu.optim import SGD
+from paddle_tpu.parallel import DataParallel, make_mesh
+from paddle_tpu.trainer import SGDTrainer
+
+COLLECTIVES = (
+    "all-reduce", "reduce-scatter", "all-gather", "collective-permute",
+    "all-to-all",
+)
+
+
+def _counts(txt):
+    return {
+        op: len(re.findall(rf"= \S+ {op}\(", txt))
+        + len(re.findall(rf"= \S+ {op}-start\(", txt))
+        for op in COLLECTIVES
+    }
+
+
+def _compiled_step_hlo(shard, compression=None):
+    reset_name_scope()
+    x = L.Data("x", shape=(16,))
+    lbl = L.Data("label", shape=())
+    h = L.Fc(x, 64, act="relu", name="h")
+    h2 = L.Fc(h, 32, act="relu", name="h2")
+    logits = L.Fc(h2, 4, act=None, name="out")
+    cost = C.ClassificationCost(logits, lbl, name="cost")
+    dp = DataParallel(make_mesh({"data": 4}))
+    tr = SGDTrainer(
+        cost, SGD(learning_rate=0.125), parallel=dp, seed=0,
+        shard_update=shard, grad_compression=compression,
+    )
+    rs = np.random.RandomState(0)
+    batch = dp.shard_batch({
+        "x": rs.randn(32, 16).astype(np.float32),
+        "label": rs.randint(0, 4, 32),
+    })
+    tr.init_state(batch)
+    # compile WITHOUT donation so the aliasing config cannot change op
+    # counts between jax point releases; the collectives are identical
+    return jax.jit(tr._build_step()).lower(tr.state, batch).compile().as_text()
+
+
+# measured on the container's jax 0.4.37 CPU partitioner; a changed count
+# means the step's collective structure changed — review and re-pin
+PINNED = {
+    "replicated": {"all-reduce": 7, "reduce-scatter": 0, "all-gather": 0,
+                   "collective-permute": 0, "all-to-all": 0},
+    "sharded": {"all-reduce": 7, "reduce-scatter": 0, "all-gather": 6,
+                "collective-permute": 0, "all-to-all": 0},
+    "sharded_bf16": {"all-reduce": 7, "reduce-scatter": 0, "all-gather": 6,
+                     "collective-permute": 0, "all-to-all": 0},
+}
+
+
+@pytest.mark.parametrize(
+    "tag,shard,compression",
+    [("replicated", False, None), ("sharded", True, None),
+     ("sharded_bf16", True, "bf16")],
+)
+def test_collective_counts_pinned(tag, shard, compression):
+    got = _counts(_compiled_step_hlo(shard, compression))
+    assert got == PINNED[tag], (
+        f"{tag} step now emits {got} (pinned {PINNED[tag]}) — the compiled "
+        "train step's collective structure changed. If intentional (updater "
+        "rework, XLA upgrade), re-pin after checking nothing regressed to "
+        "per-parameter collectives; see tests/test_hlo_collectives.py"
+    )
+
+
+def test_replicated_path_has_no_gathers():
+    """The replicated update must never gather/scatter params — its only
+    collectives are gradient all-reduces (+ the cost mean)."""
+    got = _counts(_compiled_step_hlo(False))
+    assert got["all-gather"] == 0 and got["reduce-scatter"] == 0, got
+
+
+def test_sharded_gathers_stay_bounded():
+    """The sharded update concatenates per-param payloads, so its gather
+    count must stay well under 2 collectives per parameter (6 params here;
+    a per-param-per-leg regression would be >= 12)."""
+    got = _counts(_compiled_step_hlo(True))
+    n_params = 6
+    assert 0 < got["all-gather"] <= n_params, got

@@ -96,23 +96,30 @@ def test_prefetcher_rejects_bad_depth_and_ragged_batches():
         list(DevicePrefetcher(lambda: iter([ragged]), prefetch_depth=1))
 
 
-def test_prefetcher_applies_parallel_sharding_and_drops_indivisible():
+def test_prefetcher_applies_parallel_sharding_and_pads_indivisible():
+    import numpy as np
+
+    from paddle_tpu.nn.graph import SAMPLE_MASK_KEY
     from paddle_tpu.parallel import DataParallel, make_mesh
 
     dp = DataParallel(make_mesh({"data": 8}))
     feeder = _feeder()
     good = feeder(_raw_batches(n=1, bs=16)[0])
-    bad = feeder(_raw_batches(n=1, bs=9)[0])  # 9 % 8 != 0 → dropped
+    odd = feeder(_raw_batches(n=1, bs=9)[0])  # 9 % 8 != 0 → padded to 16
     got = list(
-        DevicePrefetcher(lambda: iter([good, bad, good]), parallel=dp,
+        DevicePrefetcher(lambda: iter([good, odd, good]), parallel=dp,
                          prefetch_depth=2)
     )
-    assert len(got) == 2
+    assert len(got) == 3, "indivisible batch must pad+mask, not drop (ISSUE 5)"
     for b in got:
         assert is_device_batch(b)
         assert b["x"].sharding.is_equivalent_to(
             dp._batch_sharding, b["x"].ndim
         )
+    padded = got[1]
+    assert padded["x"].shape[0] == 16
+    mask = np.asarray(padded[SAMPLE_MASK_KEY])
+    assert mask.sum() == 9 and (mask[9:] == 0).all()
 
 
 def test_trainer_reshards_device_batch_without_mesh_sharding():

@@ -1,0 +1,505 @@
+"""ISSUE 5: ZeRO-1 sharded weight update + compressed gradient collectives.
+
+Equivalence contract (the paper's point — sharding the update is free):
+  * SGD (plain + momentum) under shard_update=True applies BITWISE the same
+    updates as the replicated updater on the CPU mesh. The tests pin
+    power-of-two lr/momentum so the scale products are IEEE-exact — XLA
+    freely FMA-contracts `p - lr*g` and two structurally different programs
+    may contract differently, which for exact products cannot change a bit.
+  * Adam matches to tight tolerance (sqrt/div chains contract).
+
+Plus: per-chip opt-state bytes shrink ~N x, trailing batches pad+mask
+instead of dropping, checkpoints round-trip across shard_update on/off
+(canonical layout on disk), int8 error-feedback keeps LeNet converging, and
+the sharded update composes with K-step fused dispatch, the device-resident
+divergence guard, and async checkpoint auto-resume."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.core import stats
+from paddle_tpu.nn import costs as C
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.graph import SAMPLE_MASK_KEY, reset_name_scope
+from paddle_tpu.optim import SGD, Adam
+from paddle_tpu.parallel import DataParallel, ShardedUpdater, make_mesh
+from paddle_tpu.parallel import compression as compression_mod
+from paddle_tpu.trainer import SGDTrainer
+from paddle_tpu.trainer.events import EndPass
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    reset_name_scope()
+
+
+DIM, CLASSES = 16, 4
+
+
+def _build():
+    x = L.Data("x", shape=(DIM,))
+    lbl = L.Data("label", shape=())
+    h = L.Fc(x, 48, act="relu", name="h")
+    logits = L.Fc(h, CLASSES, act=None, name="out")
+    return C.ClassificationCost(logits, lbl, name="cost")
+
+
+def _data(n=96, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, DIM).astype(np.float32)
+    y = (x.sum(-1) > 0).astype(np.int32) + 2 * (x[:, 0] > 0).astype(np.int32)
+    return x, y
+
+
+def _reader(x, y, bs=32):
+    def reader():
+        for i in range(0, len(x), bs):
+            yield {"x": x[i:i + bs], "label": y[i:i + bs]}
+
+    return reader
+
+
+def _train(n_dev, shard, optimizer=None, compression=None, passes=2,
+           batch_size=32, n_samples=96, **train_kw):
+    reset_name_scope()
+    cost = _build()
+    dp = DataParallel(make_mesh({"data": n_dev}))
+    tr = SGDTrainer(
+        cost,
+        optimizer or SGD(learning_rate=0.125, momentum=0.5),
+        parallel=dp, seed=5, shard_update=shard, grad_compression=compression,
+    )
+    x, y = _data(n_samples)
+    tr.train(_reader(x, y, batch_size), num_passes=passes, **train_kw)
+    return tr
+
+
+def _params(tr):
+    return {k: np.asarray(v) for k, v in tr.state["params"].items()}
+
+
+def _assert_bitwise(a, b, what=""):
+    for k in a:
+        assert np.array_equal(
+            a[k].view(np.uint32), b[k].view(np.uint32)
+        ), f"{what}: param {k} differs (max abs {np.abs(a[k] - b[k]).max()})"
+
+
+# -- equivalence vs the replicated updater -----------------------------------
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4])
+def test_sgd_bitwise_equal_replicated(n_dev):
+    p_rep = _params(_train(n_dev, shard=False))
+    p_sh = _params(_train(n_dev, shard=True))
+    _assert_bitwise(p_rep, p_sh, f"SGD n_dev={n_dev}")
+
+
+def test_sgd_plain_bitwise_equal():
+    opt = SGD(learning_rate=0.0625)  # no momentum: empty slots path
+    p_rep = _params(_train(4, shard=False, optimizer=opt))
+    reset_name_scope()
+    p_sh = _params(_train(4, shard=True, optimizer=SGD(learning_rate=0.0625)))
+    _assert_bitwise(p_rep, p_sh, "plain SGD")
+
+
+def test_adam_allclose_replicated():
+    tr_rep = _train(4, shard=False, optimizer=Adam(learning_rate=1e-3))
+    tr_sh = _train(4, shard=True, optimizer=Adam(learning_rate=1e-3))
+    p_rep, p_sh = _params(tr_rep), _params(tr_sh)
+    for k in p_rep:
+        np.testing.assert_allclose(p_rep[k], p_sh[k], rtol=1e-5, atol=1e-7)
+    # Adam moments too: compare in the canonical layout
+    opt_rep = tr_rep.updater.to_canonical(tr_rep.state["opt"])
+    opt_sh = tr_sh.updater.to_canonical(tr_sh.state["opt"])
+    for k, slots in opt_rep["slots"].items():
+        for s_rep, s_sh in zip(slots, opt_sh["slots"][k]):
+            np.testing.assert_allclose(
+                np.asarray(s_rep), np.asarray(s_sh), rtol=1e-4, atol=1e-7
+            )
+
+
+def test_opt_state_bytes_shrink_n_times():
+    tr_rep = _train(4, shard=False, passes=1)
+    tr_sh = _train(4, shard=True, passes=1)
+    rep = stats.per_chip_tree_bytes(tr_rep.state["opt"])
+    sh = stats.per_chip_tree_bytes(tr_sh.state["opt"])
+    # ~N x up to flat-chunk padding of small leaves
+    assert rep >= 3.2 * sh, (rep, sh)
+    # and the collective-bytes model: sharded none == replicated all-reduce,
+    # bf16 halves it
+    assert (
+        tr_sh.updater.collective_bytes_per_step()
+        == tr_rep.updater.collective_bytes_per_step()
+    )
+    tr_bf = _train(4, shard=True, compression="bf16", passes=1)
+    assert (
+        2 * tr_bf.updater.collective_bytes_per_step()
+        <= tr_rep.updater.collective_bytes_per_step()
+    )
+
+
+# -- compression --------------------------------------------------------------
+
+
+def test_bf16_compression_close_and_converges():
+    tr = _train(4, shard=True, compression="bf16")
+    p_bf = _params(tr)
+    p_rep = _params(_train(4, shard=False))
+    for k in p_rep:
+        np.testing.assert_allclose(p_bf[k], p_rep[k], rtol=0.05, atol=5e-3)
+
+
+def test_int8_block_quantize_roundtrip():
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.compression import (
+        _block_dequantize, _block_quantize, BLOCK,
+    )
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 2 * BLOCK).astype(np.float32))
+    q, scale = _block_quantize(x)
+    assert q.dtype == jnp.int8 and scale.shape == (4, 2)
+    err = np.abs(np.asarray(_block_dequantize(q, scale)) - np.asarray(x))
+    # block-scaled int8: error bounded by scale/2 per element
+    assert err.max() <= float(np.asarray(scale).max()) * 0.51
+
+
+def test_int8_error_feedback_residual_carried():
+    tr = _train(2, shard=True, compression="int8", passes=1)
+    assert "ef" in tr.state["opt"], "error-feedback residual missing"
+    ef = tr.state["opt"]["ef"]
+    assert any(np.abs(np.asarray(e)).max() > 0 for e in ef.values()), (
+        "EF residual never updated — quantization error is being dropped"
+    )
+
+
+@pytest.mark.slow
+def test_int8_lenet_convergence_smoke():
+    """Error-feedback int8 on the LeNet config: cost must still drop."""
+    from paddle_tpu.models import lenet
+
+    reset_name_scope()
+    _img, _lbl, _logits, cost = lenet(num_classes=4)
+    dp = DataParallel(make_mesh({"data": 2}))
+    tr = SGDTrainer(
+        cost, SGD(learning_rate=0.03125, momentum=0.5), parallel=dp, seed=0,
+        shard_update=True, grad_compression="int8",
+    )
+    rs = np.random.RandomState(1)
+    n = 64
+    x = rs.rand(n, 28, 28, 1).astype(np.float32)
+    y = rs.randint(0, 4, n)
+    # learnable rule: brightness quadrant
+    y = (x.mean(axis=(1, 2, 3)) * 4).astype(np.int32).clip(0, 3)
+    costs = []
+
+    def handler(e):
+        if isinstance(e, EndPass):
+            costs.append(e.metrics["avg_cost"])
+
+    def reader():
+        for i in range(0, n, 16):
+            yield {"pixel": x[i:i + 16], "label": y[i:i + 16]}
+
+    tr.train(reader, num_passes=6, event_handler=handler)
+    assert costs[-1] < costs[0] * 0.9, costs
+
+
+# -- trailing-batch padding ----------------------------------------------------
+
+
+def test_trailing_batch_padded_not_dropped():
+    """88 samples / batch 32 → trailing 24 on a 16-wide mesh... use 4-dev
+    mesh with trailing 24 % 4 == 0? pick sizes so the trailer is indivisible:
+    90 samples → batches 32,32,26; 26 % 4 != 0 → padded to 28."""
+    before = stats.DATA_EVENTS.get("padded_batches")
+    metrics = {}
+
+    def handler(e):
+        if isinstance(e, EndPass):
+            metrics.update(e.metrics)
+
+    tr = _train(4, shard=False, passes=1, n_samples=90,
+                event_handler=handler)
+    assert stats.DATA_EVENTS.get("padded_batches") == before + 1
+    assert metrics["padded_batches"] == 1
+    assert metrics["batches"] == 3, "trailing batch must train, not drop"
+    # samples counter counts REAL rows only (mask-sum, not padded size)
+    assert int(tr.state["samples"]) == 90
+
+
+def test_padded_cost_matches_unsharded():
+    """The padded trailing batch's masked cost equals the unpadded cost the
+    single-device run computes — pass averages match the unsharded run."""
+    x, y = _data(90)
+    costs = {}
+    for tag, n_dev in [("single", 1), ("mesh", 4)]:
+        reset_name_scope()
+        cost = _build()
+        dp = DataParallel(make_mesh({"data": n_dev}))
+        tr = SGDTrainer(cost, SGD(learning_rate=0.125), parallel=dp, seed=5)
+        got = []
+
+        def handler(e):
+            if isinstance(e, EndPass):
+                got.append(e.metrics)
+
+        tr.train(_reader(x, y), num_passes=1, event_handler=handler)
+        costs[tag] = got[0]
+    assert costs["mesh"]["batches"] == costs["single"]["batches"] == 3
+    np.testing.assert_allclose(
+        costs["mesh"]["avg_cost"], costs["single"]["avg_cost"],
+        rtol=2e-5, atol=1e-7,
+    )
+
+
+def test_prefetcher_pads_trailing_batch():
+    """DevicePrefetcher pads the indivisible trailer instead of dropping it
+    — the device-resident sample stream matches the unsharded reader."""
+    x, y = _data(90)  # trailing 26 % 4 != 0 → padded to 28
+    from paddle_tpu.data.pipeline import DevicePrefetcher
+
+    reset_name_scope()
+    dp = DataParallel(make_mesh({"data": 4}))
+    before = stats.DATA_EVENTS.get("padded_batches")
+    pf = DevicePrefetcher(_reader(x, y), parallel=dp, prefetch_depth=2)
+    batches = list(pf())
+    assert stats.DATA_EVENTS.get("padded_batches") == before + 1
+    assert len(batches) == 3
+    assert batches[-1]["x"].shape[0] == 28
+    assert SAMPLE_MASK_KEY in batches[-1]
+    mask = np.asarray(batches[-1][SAMPLE_MASK_KEY])
+    assert mask.sum() == 26
+    # and the trainer consumes the padded device batch end-to-end
+    reset_name_scope()
+    cost = _build()
+    tr = SGDTrainer(cost, SGD(learning_rate=0.125), parallel=dp, seed=5)
+    tr.train(DevicePrefetcher(_reader(x, y), parallel=dp), num_passes=1)
+    assert int(tr.state["samples"]) == 90
+
+
+def test_struct_cost_masked_mean():
+    """Struct costs (CTC/CRF/NCE/...) reduce through _mean_over_examples —
+    padded rows must drop out of the mean exactly like dense costs."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.graph import Context
+    from paddle_tpu.nn.struct_costs import _mean_over_examples
+
+    ctx = Context("apply", {}, {}, None, train=True)
+    per = jnp.asarray([1.0, 2.0, 3.0, 99.0])  # row 3 is padding
+    assert float(_mean_over_examples(ctx, per)) == pytest.approx(105.0 / 4)
+    ctx.sample_mask = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    assert float(_mean_over_examples(ctx, per)) == pytest.approx(2.0)
+    # per-timestep flattening: mask repeats per step
+    per_t = jnp.asarray([1.0, 1.0, 2.0, 2.0, 3.0, 3.0, 99.0, 99.0])
+    assert float(_mean_over_examples(ctx, per_t)) == pytest.approx(2.0)
+    # unmaskable layout (rows don't divide the mask): falls back to plain mean
+    per_odd = jnp.asarray([1.0, 2.0, 3.0])
+    assert float(_mean_over_examples(ctx, per_odd)) == pytest.approx(2.0)
+
+
+def test_pad_batch_helper():
+    dp = DataParallel(make_mesh({"data": 4}))
+    batch = {"x": np.arange(12, dtype=np.float32).reshape(6, 2),
+             "label": np.arange(6, dtype=np.int32)}
+    padded, n_pad = dp.pad_batch(batch)
+    assert n_pad == 2
+    assert padded["x"].shape == (8, 2) and padded["label"].shape == (8,)
+    np.testing.assert_array_equal(padded["x"][6:], [[10, 11], [10, 11]])
+    np.testing.assert_array_equal(
+        padded[SAMPLE_MASK_KEY], [1, 1, 1, 1, 1, 1, 0, 0]
+    )
+    already, n = dp.pad_batch({"x": np.zeros((8, 2), np.float32)})
+    assert n == 0 and SAMPLE_MASK_KEY not in already
+
+
+# -- checkpoint round-trip across updater layouts ------------------------------
+
+
+def _ckpt_roundtrip(tmp_path, save_shard, load_shard, optimizer_fn,
+                    async_=False):
+    x, y = _data(96)
+    reset_name_scope()
+    dp = DataParallel(make_mesh({"data": 4}))
+    tr1 = SGDTrainer(_build(), optimizer_fn(), parallel=dp, seed=5,
+                     shard_update=save_shard)
+    tr1.train(_reader(x, y), num_passes=1, save_dir=str(tmp_path),
+              async_checkpoint=async_)
+    tr1.checkpoint_wait()
+
+    # fresh trainer in the OTHER layout resumes from the same checkpoint
+    reset_name_scope()
+    dp2 = DataParallel(make_mesh({"data": 4}))
+    tr2 = SGDTrainer(_build(), optimizer_fn(), parallel=dp2, seed=5,
+                     shard_update=load_shard)
+    tr2.train(_reader(x, y), num_passes=2, save_dir=str(tmp_path),
+              auto_resume=True, async_checkpoint=async_)
+    tr2.checkpoint_wait()
+
+    # reference: the same two passes straight through in the LOAD layout
+    reset_name_scope()
+    dp3 = DataParallel(make_mesh({"data": 4}))
+    tr3 = SGDTrainer(_build(), optimizer_fn(), parallel=dp3, seed=5,
+                     shard_update=load_shard)
+    tr3.train(_reader(x, y), num_passes=2)
+    return tr2, tr3
+
+
+@pytest.mark.parametrize("save_shard,load_shard", [(True, False), (False, True)])
+def test_checkpoint_roundtrip_across_layouts_sgd(tmp_path, save_shard, load_shard):
+    tr2, tr3 = _ckpt_roundtrip(
+        tmp_path, save_shard, load_shard,
+        lambda: SGD(learning_rate=0.125, momentum=0.5),
+    )
+    _assert_bitwise(_params(tr3), _params(tr2),
+                    f"resume {save_shard}->{load_shard}")
+    # momentum slots too (canonical view)
+    c2 = tr2.updater.to_canonical(tr2.state["opt"])
+    c3 = tr3.updater.to_canonical(tr3.state["opt"])
+    for k, slots in c3["slots"].items():
+        for a, b in zip(slots, c2["slots"][k]):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), k
+
+
+def test_checkpoint_roundtrip_adam_moments(tmp_path):
+    tr2, tr3 = _ckpt_roundtrip(
+        tmp_path, True, False, lambda: Adam(learning_rate=1e-3),
+    )
+    p2, p3 = _params(tr2), _params(tr3)
+    for k in p3:
+        np.testing.assert_allclose(p3[k], p2[k], rtol=1e-5, atol=1e-7)
+    c2 = tr2.updater.to_canonical(tr2.state["opt"])
+    c3 = tr3.updater.to_canonical(tr3.state["opt"])
+    for k, slots in c3["slots"].items():
+        for a, b in zip(slots, c2["slots"][k]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-7
+            )
+
+
+def test_checkpoint_roundtrip_async_sharded(tmp_path):
+    """The async-checkpointer path: sharded opt state is gathered to the
+    canonical layout BEFORE the non-blocking host fetch, and a sharded
+    trainer auto-resumes from it bitwise."""
+    tr2, tr3 = _ckpt_roundtrip(
+        tmp_path, True, True,
+        lambda: SGD(learning_rate=0.125, momentum=0.5), async_=True,
+    )
+    _assert_bitwise(_params(tr3), _params(tr2), "async sharded resume")
+
+
+# -- composition with the async execution runtime ------------------------------
+
+
+def test_k_step_dispatch_composes():
+    """shard_update under steps_per_dispatch=K applies the same updates."""
+    p1 = _params(_train(4, shard=True, passes=1, steps_per_dispatch=1))
+    p4 = _params(_train(4, shard=True, passes=1, steps_per_dispatch=3))
+    _assert_bitwise(p1, p4, "K-fused sharded dispatch")
+
+
+def test_divergence_guard_reverts_on_every_shard():
+    """A poisoned batch under shard_update: the device-resident guard must
+    revert params AND the sharded flat slots to pre-step values on every
+    shard — the clean batches alone determine the result."""
+
+    def run(poison):
+        reset_name_scope()
+        cost = _build()
+        dp = DataParallel(make_mesh({"data": 4}))
+        tr = SGDTrainer(
+            cost, SGD(learning_rate=0.125, momentum=0.5), parallel=dp,
+            seed=5, shard_update=True, divergence_policy="skip_batch",
+            guard_check_every=1,
+        )
+        x, y = _data(96)
+        batches = [
+            {"x": x[i:i + 32].copy(), "label": y[i:i + 32].copy()}
+            for i in range(0, 96, 32)
+        ]
+        if poison:
+            batches.insert(1, {
+                "x": batches[0]["x"] * np.float32("nan"),
+                "label": batches[0]["label"],
+            })
+        tr.train(lambda: iter(batches), num_passes=1)
+        return tr
+
+    tr_clean = run(poison=False)
+    tr_poison = run(poison=True)
+    assert stats.FT_EVENTS.get("divergence") >= 1
+    _assert_bitwise(_params(tr_clean), _params(tr_poison), "guarded shard")
+    # slots reverted too: canonical views must match bitwise
+    c1 = tr_clean.updater.to_canonical(tr_clean.state["opt"])
+    c2 = tr_poison.updater.to_canonical(tr_poison.state["opt"])
+    for k, slots in c1["slots"].items():
+        for a, b in zip(slots, c2["slots"][k]):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), k
+
+
+# -- API validation ------------------------------------------------------------
+
+
+def test_shard_update_requires_parallel():
+    with pytest.raises(ValueError, match="DataParallel"):
+        SGDTrainer(_build(), SGD(), shard_update=True)
+
+
+def test_compression_requires_shard_update():
+    reset_name_scope()
+    dp = DataParallel(make_mesh({"data": 2}))
+    with pytest.raises(ValueError, match="shard_update"):
+        SGDTrainer(_build(), SGD(), parallel=dp, grad_compression="bf16")
+
+
+def test_shard_update_rejects_explicit_updater():
+    """shard_update selects the built-in ShardedUpdater; combining it with
+    an explicit updater= must fail loudly, not silently run replicated."""
+    reset_name_scope()
+    dp = DataParallel(make_mesh({"data": 2}))
+    opt = SGD()
+    from paddle_tpu.parallel import IciAllReduceUpdater
+
+    with pytest.raises(ValueError, match="updater"):
+        SGDTrainer(_build(), opt, parallel=dp,
+                   updater=IciAllReduceUpdater(opt, dp), shard_update=True)
+
+
+def test_flat_slots_never_placed_replicated():
+    """init_state must place ZeRO flat slots DIRECTLY on their data-axis
+    sharding (opt_leaf_sharding) — a replicated intermediate would cost the
+    full optimizer state per chip at init/resume."""
+    tr = _train(4, shard=True, passes=1)
+    sharding = tr.updater.opt_leaf_sharding
+    for k, geom in tr.updater._geom.items():
+        for s in tr.state["opt"]["slots"][k]:
+            want = sharding(k, s)
+            if geom.flat:
+                assert want is not None
+                assert s.sharding.is_equivalent_to(want, s.ndim), (k, s.sharding)
+            else:
+                assert want is None
+
+
+def test_unknown_compression_rejected():
+    with pytest.raises(ValueError, match="grad_compression"):
+        compression_mod.make("fp4")
+
+
+def test_sharded_updater_flat_geometry():
+    reset_name_scope()
+    dp = DataParallel(make_mesh({"data": 4}))
+    tr = _train(4, shard=True, passes=1)
+    assert isinstance(tr.updater, ShardedUpdater)
+    for k, geom in tr.updater._geom.items():
+        if geom.flat:
+            for s in tr.state["opt"]["slots"][k]:
+                assert s.shape == (4, geom.chunk)
+                spec = s.sharding.spec
+                assert tuple(spec)[:1] == ("data",), (k, spec)
