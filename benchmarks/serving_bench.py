@@ -1,0 +1,145 @@
+"""Continuous-batching serving benchmark (ISSUE 6 acceptance).
+
+Measures tokens/sec and p50/p99 request latency at 1/4/16/64 concurrent
+streams against the SAME serving session configuration, where concurrency=1
+is the sequential per-request baseline (one request in flight at a time —
+the `run_generation` serving model: nothing overlaps). Same executables,
+same platform, same fixed shapes at every concurrency, so the measured
+speedup isolates dynamic batching.
+
+The workload is a mixed-length prompt stream spanning two prefill buckets;
+after a warmup pass that touches every bucket, the decode-recompile count
+must stay at ZERO (the PR-1 RecompileStats assertion — variable-length
+sequences of different ages share one compiled decode program through the
+paged KV cache).
+
+Acceptance gates (printed in the JSON line):
+  * speedup_16 >= 3.0      tokens/sec at 16 streams vs sequential
+  * decode_recompiles_after_warmup == 0 over the mixed-length stream
+
+Usage:
+  JAX_PLATFORMS=cpu python benchmarks/serving_bench.py
+      [--streams 1,4,16,64] [--requests N] [--max_new N]
+      [--vocab V --n_layers L --d_model D --n_heads H]
+
+Output: one JSON line {"metric": "serving_bench", ...} with a per-stream-
+count entry (each carrying its own "platform" tag, like shard_update_bench).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_one(args, concurrency: int, prompts):
+    """Fresh session per concurrency so KV pool state and stats are clean;
+    the persistent compile cache makes the repeat compiles cheap."""
+    import jax
+
+    from paddle_tpu.serving.session import make_demo_session
+    from paddle_tpu.serving.workload import make_prompts, run_closed_loop
+
+    session = make_demo_session(
+        vocab=args.vocab, n_layers=args.n_layers, d_model=args.d_model,
+        n_heads=args.n_heads, seed=0,
+        max_slots=args.max_slots, page_size=args.page_size,
+        prefill_buckets=(16, 32), max_new_limit=args.max_new,
+    )
+    # warmup: touch EVERY prefill bucket + the decode program (one prompt at
+    # each bucket length), then snapshot the recompile counter —
+    # steady-state serving must add NOTHING to it
+    warm_prompts = make_prompts(
+        len(session.buckets), lengths=session.buckets, vocab=args.vocab,
+        bos_id=1, seed=7,
+    )
+    warm = run_closed_loop(
+        session, warm_prompts, args.max_new, concurrency=len(warm_prompts)
+    )
+    sigs_after_warmup = session.decode_shape_signatures()
+    res = run_closed_loop(session, prompts, args.max_new, concurrency)
+    recompiles = session.decode_shape_signatures() - sigs_after_warmup
+    tokens = res.pop("results")
+    res.update({
+        "platform": jax.devices()[0].platform,
+        "decode_recompiles_after_warmup": recompiles,
+        "decode_shape_signatures": session.decode_shape_signatures(),
+        "warmup_tokens": warm["tokens"],
+    })
+    return res, tokens
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--streams", default="1,4,16,64")
+    ap.add_argument("--requests", type=int, default=48,
+                    help="total requests per concurrency level")
+    ap.add_argument("--max_new", type=int, default=24)
+    ap.add_argument("--max_slots", type=int, default=16)
+    ap.add_argument("--page_size", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--n_layers", type=int, default=2)
+    ap.add_argument("--d_model", type=int, default=64)
+    ap.add_argument("--n_heads", type=int, default=2)
+    args = ap.parse_args()
+
+    from paddle_tpu.serving.model import LMConfig
+    from paddle_tpu.serving.workload import make_prompts
+
+    cfg = LMConfig(vocab=args.vocab)
+    # mixed lengths across BOTH buckets (16 and 32): the zero-recompile gate
+    # is only meaningful on a shape-diverse stream
+    prompts = make_prompts(
+        args.requests, lengths=(5, 11, 16, 23, 32), vocab=args.vocab,
+        bos_id=cfg.bos_id, seed=0,
+    )
+
+    results = []
+    token_sets = {}
+    for n in [int(x) for x in args.streams.split(",") if x.strip()]:
+        res, tokens = run_one(args, n, prompts)
+        results.append(res)
+        token_sets[n] = tokens
+        print(
+            f"[serving_bench] streams={n}: {res['tokens_per_sec']} tok/s "
+            f"p50={res['p50_latency_ms']}ms p99={res['p99_latency_ms']}ms "
+            f"recompiles={res['decode_recompiles_after_warmup']}",
+            file=sys.stderr,
+        )
+
+    by_n = {r["concurrency"]: r for r in results}
+    base = by_n.get(1)
+    for r in results:
+        if base is not None and base["tokens_per_sec"] > 0:
+            r["speedup_vs_sequential"] = round(
+                r["tokens_per_sec"] / base["tokens_per_sec"], 2
+            )
+    # continuous batching must be RESULT-transparent, not just fast: every
+    # concurrency level produced identical tokens for every request
+    consistent = all(t == token_sets[min(token_sets)] for t in token_sets.values())
+    speedup_16 = by_n.get(16, {}).get("speedup_vs_sequential", 0.0)
+    gates = {
+        "speedup_16_vs_sequential": speedup_16,
+        "speedup_16_ge_3x": bool(speedup_16 >= 3.0),
+        "zero_decode_recompiles": all(
+            r["decode_recompiles_after_warmup"] == 0 for r in results
+        ),
+        "batching_bitwise_transparent": bool(consistent),
+    }
+    ok = gates["speedup_16_ge_3x"] and gates["zero_decode_recompiles"] and consistent
+    print(json.dumps({
+        "metric": "serving_bench",
+        "value": speedup_16,
+        "unit": "x tokens/sec vs sequential @16 streams",
+        "all_gates_pass": bool(ok),
+        "gates": gates,
+        "results": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import os
 import tempfile
-from typing import Any, Dict, List, Optional
+import threading
+from typing import Any, Dict, List, Optional, Union
 
 import numpy as np
 
@@ -28,6 +29,11 @@ class InferenceMachine:
         self._apply = jax.jit(
             lambda p, s, b: self.network.apply(p, s, b, train=False)[0]
         )
+        # "one machine serves any number of threads" (module docstring) —
+        # the compiled executables are reentrant, but the lazily-populated
+        # per-layer compile cache below is plain dict mutation and needs this
+        self._layer_apply: Dict[str, Any] = {}
+        self._layer_lock = threading.Lock()
 
     @classmethod
     def from_merged(cls, path: str) -> "InferenceMachine":
@@ -54,9 +60,10 @@ class InferenceMachine:
     # -- forward (capi/gradient_machine.h:73) -------------------------------
     def forward(
         self, batch: Any, output_layer: Optional[str] = None
-    ) -> Dict[str, np.ndarray]:
+    ) -> Union[Dict[str, np.ndarray], np.ndarray]:
         """batch: dict of arrays, or list of sample tuples (fed through the
-        config's data layers in declaration order)."""
+        config's data layers in declaration order). Returns the bare array
+        when `output_layer` is given, else {name: array} for all outputs."""
         if not isinstance(batch, dict):
             batch = self.feeder(batch)
         outs = self._apply(self.params, self.states, batch)
@@ -77,14 +84,18 @@ class InferenceMachine:
 
         from paddle_tpu.nn.graph import Network
 
-        if not hasattr(self, "_layer_apply"):
-            self._layer_apply = {}
-        if layer_name not in self._layer_apply:
-            layer = self.topology.network.layers_by_name[layer_name]
-            sub = Network([layer])
-            self._layer_apply[layer_name] = jax.jit(
-                lambda p, s, b: sub.apply(p, s, b, train=False)[0][layer_name].value
-            )
+        with self._layer_lock:
+            # double-checked under the lock: concurrent first calls for the
+            # same layer must not race the dict insert (the jit itself is
+            # cheap here — tracing happens at first call, which is reentrant)
+            if layer_name not in self._layer_apply:
+                layer = self.topology.network.layers_by_name[layer_name]
+                sub = Network([layer])
+                self._layer_apply[layer_name] = jax.jit(
+                    lambda p, s, b: sub.apply(p, s, b, train=False)[0][
+                        layer_name
+                    ].value
+                )
         if not isinstance(batch, dict):
             batch = self.feeder(batch)
         return np.asarray(self._layer_apply[layer_name](self.params, self.states, batch))

@@ -16,6 +16,11 @@ Usage:
         [--master_endpoints=a:p1,b:p2] [--preempt_grace_s=S]
     python -m paddle_tpu dump_config --config=conf.py
     python -m paddle_tpu merge_model --config=conf.py --model_dir=DIR --output=FILE
+    python -m paddle_tpu serve [--port=N] [--demo | --load=model.npz]
+        [--config=conf.py --model_dir=DIR] [--max_slots=N] [--page_size=N]
+        [--prefill_buckets=16,32,64] [--max_new_limit=N] [--max_queue=N]
+        [--tenant_tokens=CAP] [--tenant_tokens_per_s=R] [--tenant_concurrent=N]
+        [--lease_s=S] [--require_register=0|1]
     python -m paddle_tpu version
 """
 
@@ -642,6 +647,143 @@ def cmd_merge_model(args: argparse.Namespace) -> int:
     return 0
 
 
+def _serve_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0, help="0 picks a free port")
+    p.add_argument(
+        "--demo", action="store_true",
+        help="serve the built-in seeded demo LM (smoke/bench mode)",
+    )
+    p.add_argument("--load", default=None, help="ServableLM .npz to serve")
+    p.add_argument(
+        "--config", default=None,
+        help="v1 config script: serve whole-request generation through a "
+             "long-lived GenerationSession (RPC method generate_config)",
+    )
+    p.add_argument("--model_dir", default=None, help="params for --config")
+    p.add_argument("--config_args", default="")
+    p.add_argument("--max_slots", type=int, default=8,
+                   help="concurrent decode slots = the continuous batch width")
+    p.add_argument("--page_size", type=int, default=16,
+                   help="tokens per KV page")
+    p.add_argument("--num_pages", type=int, default=0,
+                   help="KV page pool size (0 = worst case for max_slots)")
+    p.add_argument("--prefill_buckets", default="16,32,64",
+                   help="padded prompt lengths; one prefill compile each")
+    p.add_argument("--max_new_limit", type=int, default=64)
+    p.add_argument("--max_queue", type=int, default=256)
+    p.add_argument("--tenant_tokens", type=float, default=0.0,
+                   help="per-tenant token-bucket capacity (0 = unlimited)")
+    p.add_argument("--tenant_tokens_per_s", type=float, default=0.0)
+    p.add_argument("--tenant_concurrent", type=int, default=0,
+                   help="per-tenant concurrent-request cap (0 = unlimited)")
+    p.add_argument("--lease_s", type=float, default=30.0,
+                   help="tenant lease; silent clients are evicted and their "
+                        "queued requests cancelled")
+    p.add_argument("--require_register", type=_str2bool, default=False,
+                   help="reject requests without a registered tenant lease")
+    # demo model shape knobs (ignored with --load)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--n_layers", type=int, default=2)
+    p.add_argument("--d_model", type=int, default=32)
+    p.add_argument("--n_heads", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Long-lived serving process: load once, serve until SIGTERM/SIGINT."""
+    import signal as _signal
+    import threading
+
+    from paddle_tpu.serving.quota import TenantQuotas
+    from paddle_tpu.serving.server import ServingServer
+
+    quotas = None
+    if args.tenant_tokens_per_s > 0 and args.tenant_tokens <= 0:
+        # a refill rate without a bucket capacity is a no-op; saying nothing
+        # would leave the operator believing rate limiting is on
+        print(
+            "--tenant_tokens_per_s needs --tenant_tokens (the bucket "
+            "capacity); no token quota will be enforced", file=sys.stderr,
+        )
+    elif args.tenant_tokens > 0 and args.tenant_tokens_per_s <= 0:
+        # the inverse surprise: a bucket that never refills is a LIFETIME
+        # cap, not the documented rate limit — permanent lockout once drained
+        print(
+            "--tenant_tokens without --tenant_tokens_per_s never refills: "
+            "each tenant gets a one-time lifetime budget of "
+            f"{args.tenant_tokens:.0f} tokens", file=sys.stderr,
+        )
+    if args.tenant_tokens > 0 or args.tenant_concurrent > 0:
+        quotas = TenantQuotas(
+            token_capacity=args.tenant_tokens or None,
+            tokens_per_s=args.tenant_tokens_per_s,
+            max_concurrent=args.tenant_concurrent or None,
+        )
+
+    session = None
+    if args.demo or args.load:
+        from paddle_tpu.serving.session import ServingSession, make_demo_session
+
+        buckets = tuple(
+            int(b) for b in args.prefill_buckets.split(",") if b.strip()
+        )
+        session_kw = dict(
+            max_slots=args.max_slots,
+            page_size=args.page_size,
+            num_pages=args.num_pages or None,
+            prefill_buckets=buckets,
+            max_new_limit=args.max_new_limit,
+            max_queue=args.max_queue,
+            quotas=quotas,
+        )
+        if args.load:
+            from paddle_tpu.serving.model import ServableLM
+
+            model, params = ServableLM.load(args.load)
+            session = ServingSession(model, params, **session_kw)
+        else:
+            session = make_demo_session(
+                vocab=args.vocab, n_layers=args.n_layers,
+                d_model=args.d_model, n_heads=args.n_heads, seed=args.seed,
+                **session_kw,
+            )
+
+    gen_session = None
+    if args.config:
+        from paddle_tpu.config import parse_config
+        from paddle_tpu.trainer.generation import GenerationSession
+
+        pc = parse_config(args.config, args.config_args, emit_proto=False)
+        gen_session = GenerationSession(
+            pc, model_dir=args.model_dir,
+            base_dir=os.path.dirname(os.path.abspath(args.config)),
+        )
+
+    if session is None and gen_session is None:
+        print(
+            "serve needs a model: --demo, --load=model.npz, or "
+            "--config=conf.py [--model_dir=DIR]", file=sys.stderr,
+        )
+        return 2
+
+    server = ServingServer(
+        session=session, gen_session=gen_session,
+        host=args.host, port=args.port, lease_s=args.lease_s,
+        require_register=args.require_register,
+    ).start()
+    stop_evt = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_: stop_evt.set())
+    _signal.signal(_signal.SIGINT, lambda *_: stop_evt.set())
+    print(json.dumps({"role": "serve", "address": list(server.address)}),
+          flush=True)
+    stop_evt.wait()
+    server.stop()
+    if session is not None:
+        print(json.dumps({"final_stats": session.stats()}), flush=True)
+    return 0
+
+
 def cmd_version(_args: argparse.Namespace) -> int:
     from paddle_tpu import __version__
 
@@ -668,6 +810,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_merge.add_argument("--output", required=True)
     p_merge.add_argument("--config_args", default="")
     p_merge.set_defaults(fn=cmd_merge_model)
+
+    p_serve = sub.add_parser(
+        "serve", help="continuous-batching inference server"
+    )
+    _serve_args(p_serve)
+    p_serve.set_defaults(fn=cmd_serve)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

@@ -1,0 +1,126 @@
+"""Paged KV cache: one physical page pool + per-slot block tables.
+
+The device layout follows the TPU paged-attention kernel convention
+(jax.experimental.pallas.ops.tpu.paged_attention; "Ragged Paged Attention",
+PAPERS.md): every sequence shares ONE pool
+
+    k_pages, v_pages : [n_layers, num_pages, page_size, kv_dim]
+
+and each decode slot owns a row of the block table
+[max_slots, max_pages_per_seq] mapping logical page j -> physical page id.
+Page 0 is reserved as the dump page: inactive slots write their (discarded)
+step KV there and unused block-table entries point there, so the compiled
+decode program always runs at one fixed shape — which slots are live and how
+long each sequence is are pure *data*, never *shape*. That is what lets a
+mixed-age, mixed-length batch share a single executable with zero recompiles
+(asserted via stats.RecompileStats in the serving session).
+
+Allocation is a host-side free list. A request reserves
+ceil((prompt_len + max_new_tokens) / page_size) pages at admission — worst
+case up front, so a running sequence can never hit page exhaustion mid-flight
+(admission control is the only place that says no). Retirement returns the
+pages for reuse; recycling is tested (tests/test_serving.py)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+class PagedKVCache:
+    """Host-side page allocator + device-resident page pool.
+
+    The device arrays are created lazily (jax import deferred) and are
+    *owned by the serving session* once handed out: the compiled decode/commit
+    steps donate and replace them, so this class only tracks the host-side
+    free list and block tables."""
+
+    def __init__(
+        self,
+        n_layers: int,
+        kv_dim: int,
+        num_pages: int,
+        page_size: int,
+        max_slots: int,
+        max_pages_per_seq: int,
+    ):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is the dump page)")
+        self.n_layers = n_layers
+        self.kv_dim = kv_dim
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.max_pages_per_seq = max_pages_per_seq
+        # pop() hands out ascending ids; page 0 is never allocatable
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
+        # the block table rides to the device as step *data* each decode —
+        # same shape every step, so it never perturbs the executable cache
+        self._table = np.zeros((max_slots, max_pages_per_seq), np.int32)
+
+    # -- device pool --------------------------------------------------------
+    def make_pools(self, dtype=None):
+        """Fresh zeroed (k_pages, v_pages) device arrays."""
+        import jax.numpy as jnp
+
+        shape = (self.n_layers, self.num_pages, self.page_size, self.kv_dim)
+        dtype = dtype or jnp.float32
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    # -- accounting ---------------------------------------------------------
+    def pages_needed(self, total_len: int) -> int:
+        return -(-int(total_len) // self.page_size)  # ceil div
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def can_reserve(self, total_len: int) -> bool:
+        n = self.pages_needed(total_len)
+        return n <= self.max_pages_per_seq and n <= len(self._free)
+
+    # -- reserve / release --------------------------------------------------
+    def reserve(self, slot: int, total_len: int) -> List[int]:
+        """Reserve pages covering `total_len` tokens for `slot`; returns the
+        physical page ids. Raises if the slot is occupied or pages are short —
+        callers gate on can_reserve (admission control)."""
+        if self._slot_pages[slot]:
+            raise RuntimeError(f"slot {slot} already holds pages")
+        n = self.pages_needed(total_len)
+        if n > self.max_pages_per_seq:
+            raise ValueError(
+                f"sequence of {total_len} tokens needs {n} pages > "
+                f"max_pages_per_seq={self.max_pages_per_seq}"
+            )
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: need {n} pages, {len(self._free)} free"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._slot_pages[slot] = pages
+        self._table[slot, :] = 0
+        self._table[slot, : len(pages)] = pages
+        return pages
+
+    def release(self, slot: int) -> int:
+        """Return the slot's pages to the free list (KV recycling); returns
+        how many were freed. Idempotent for an empty slot."""
+        pages = self._slot_pages[slot]
+        self._slot_pages[slot] = []
+        self._free.extend(pages)
+        self._table[slot, :] = 0
+        return len(pages)
+
+    def slot_pages(self, slot: int) -> List[int]:
+        return list(self._slot_pages[slot])
+
+    def block_table(self) -> np.ndarray:
+        """The [max_slots, max_pages_per_seq] int32 table (live view — copy
+        is taken by the device transfer itself)."""
+        return self._table

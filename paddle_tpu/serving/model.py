@@ -1,0 +1,260 @@
+"""ServableLM: a decode-oriented causal LM with paged attention.
+
+The serving runtime is split the way TPU inference engines split it
+("Ragged Paged Attention", PAPERS.md):
+
+  * `prefill`     — full-context forward over a *bucket-padded* prompt
+                    [B, T_bucket]; returns the first sampled token plus the
+                    per-position K/V to commit into the page pool. One
+                    executable per bucket (a handful, fixed up front).
+  * `commit_prefill` — scatters the prompt K/V into the slot's pages.
+  * `decode_step` — ONE token for ALL slots at the fixed [max_slots] shape:
+                    write the step K/V into each slot's current page, gather
+                    each slot's pages through its block-table row, masked
+                    attention up to its own position. Sequence length, batch
+                    occupancy and sequence age are data, not shape — the
+                    whole serving lifetime runs this single executable.
+
+Per-slot computation is strictly batched-independent (every einsum keeps the
+slot dimension; no cross-slot reduction), which is what makes continuous
+batching *bitwise* transparent: a request's tokens are identical whether it
+ran alone or joined a full batch mid-stream (tests/test_serving.py).
+
+All methods are pure functions of (params, inputs) — the serving session owns
+jit + donation. The model is deliberately small-config-friendly (the repo's
+CPU oracle discipline) but structurally a real transformer LM: pre-RMSNorm,
+multi-head causal attention, GELU MLP, learned positions, tied nothing."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    vocab: int
+    n_layers: int = 2
+    d_model: int = 64
+    n_heads: int = 2
+    max_len: int = 512
+    bos_id: int = 1
+    eos_id: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def _rms(x: Array, scale: Array) -> Array:
+    return x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * scale
+
+
+class ServableLM:
+    def __init__(self, cfg: LMConfig):
+        self.cfg = cfg
+        self.scale = 1.0 / float(np.sqrt(cfg.head_dim))
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, rng: Array) -> Dict[str, Array]:
+        cfg = self.cfg
+        d, v = cfg.d_model, cfg.vocab
+        # per-tensor keys derived by name-stable fold_in so adding a tensor
+        # never reshuffles the others (checkpoint/test determinism)
+        p: Dict[str, Array] = {
+            "embed": 0.1 * jax.random.normal(
+                jax.random.fold_in(rng, 1), (v, d), jnp.float32
+            ),
+            "pos": 0.02 * jax.random.normal(
+                jax.random.fold_in(rng, 2), (cfg.max_len, d), jnp.float32
+            ),
+            "lnf": jnp.ones((d,)),
+            "unembed": 0.1 * jax.random.normal(
+                jax.random.fold_in(rng, 3), (d, v), jnp.float32
+            ),
+        }
+        for i in range(cfg.n_layers):
+            for j, (name, shape) in enumerate((
+                ("wq", (d, d)), ("wk", (d, d)), ("wv", (d, d)), ("wo", (d, d)),
+                ("w1", (d, 4 * d)), ("w2", (4 * d, d)),
+            )):
+                k = jax.random.fold_in(jax.random.fold_in(rng, 1000 + i), j)
+                p[f"l{i}.{name}"] = 0.1 * jax.random.normal(k, shape, jnp.float32)
+            p[f"l{i}.b1"] = jnp.zeros((4 * d,))
+            p[f"l{i}.b2"] = jnp.zeros((d,))
+            p[f"l{i}.ln1"] = jnp.ones((d,))
+            p[f"l{i}.ln2"] = jnp.ones((d,))
+        return p
+
+    def save(self, path: str, params: Dict[str, Array]) -> None:
+        np.savez(path, __vocab__=self.cfg.vocab, __n_layers__=self.cfg.n_layers,
+                 __d_model__=self.cfg.d_model, __n_heads__=self.cfg.n_heads,
+                 __max_len__=self.cfg.max_len, __bos__=self.cfg.bos_id,
+                 __eos__=self.cfg.eos_id,
+                 **{k: np.asarray(v) for k, v in params.items()})
+
+    @classmethod
+    def load(cls, path: str) -> Tuple["ServableLM", Dict[str, Array]]:
+        with np.load(path) as z:
+            cfg = LMConfig(
+                vocab=int(z["__vocab__"]), n_layers=int(z["__n_layers__"]),
+                d_model=int(z["__d_model__"]), n_heads=int(z["__n_heads__"]),
+                max_len=int(z["__max_len__"]), bos_id=int(z["__bos__"]),
+                eos_id=int(z["__eos__"]),
+            )
+            params = {
+                k: jnp.asarray(z[k]) for k in z.files if not k.startswith("__")
+            }
+        return cls(cfg), params
+
+    # -- shared block body --------------------------------------------------
+    def _mlp(self, params, i: int, x: Array) -> Array:
+        h = _rms(x, params[f"l{i}.ln2"])
+        return x + (
+            jax.nn.gelu(h @ params[f"l{i}.w1"] + params[f"l{i}.b1"])
+            @ params[f"l{i}.w2"] + params[f"l{i}.b2"]
+        )
+
+    # -- full-context forward (prefill + the sequential reference path) -----
+    def _context_forward(self, params, tokens: Array) -> Tuple[Array, Array, Array]:
+        """The ONE causal-forward implementation: padded [B, T] tokens ->
+        (logits [B, T, V], kc, vc [L, B, T, kv_dim]). Both the sequential
+        reference path (forward_logits) and the serving prefill call this,
+        so the attention math the equivalence tests compare against cannot
+        drift between them. Unused outputs are DCE'd under jit."""
+        cfg = self.cfg
+        b, t = tokens.shape
+        h_, hd = cfg.n_heads, cfg.head_dim
+        x = params["embed"][tokens] + params["pos"][:t][None]
+        causal = jnp.tril(jnp.ones((t, t), bool))
+        kcs, vcs = [], []
+        for i in range(cfg.n_layers):
+            h = _rms(x, params[f"l{i}.ln1"])
+            q = (h @ params[f"l{i}.wq"]).reshape(b, t, h_, hd)
+            kf = h @ params[f"l{i}.wk"]
+            vf = h @ params[f"l{i}.wv"]
+            kcs.append(kf)
+            vcs.append(vf)
+            k = kf.reshape(b, t, h_, hd)
+            v = vf.reshape(b, t, h_, hd)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * self.scale
+            s = jnp.where(causal[None, None], s, NEG_INF)
+            w = jax.nn.softmax(s.astype(jnp.float32), -1).astype(x.dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, t, -1)
+            x = x + ctx @ params[f"l{i}.wo"]
+            x = self._mlp(params, i, x)
+        logits = _rms(x, params["lnf"]) @ params["unembed"]
+        return logits, jnp.stack(kcs), jnp.stack(vcs)
+
+    def forward_logits(self, params, tokens: Array, lengths: Array) -> Array:
+        """Causal forward over padded [B, T] prompts -> logits [B, T, V].
+        Padding positions produce garbage logits but cannot leak into valid
+        ones: causal masking means position t only sees positions <= t, all
+        of which are real tokens whenever t is. (`lengths` kept for API
+        symmetry; masking is positional.)"""
+        del lengths
+        return self._context_forward(params, tokens)[0]
+
+    def prefill(
+        self, params, tokens: Array, lengths: Array
+    ) -> Tuple[Array, Array, Array]:
+        """Bucket-padded prompt forward.
+
+        tokens [B, T_bucket] int32, lengths [B] -> (first_tok [B] int32 —
+        greedy argmax at each prompt's last valid position, so the host never
+        fetches a logits tensor — kc, vc [L, B, T, kv_dim] to commit)."""
+        logits, kc, vc = self._context_forward(params, tokens)
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0]  # [B, V]
+        first_tok = jnp.argmax(last, -1).astype(jnp.int32)
+        return first_tok, kc, vc
+
+    # -- page pool plumbing -------------------------------------------------
+    def commit_prefill(
+        self,
+        k_pages: Array,  # [L, NP, PS, KD] (donated)
+        v_pages: Array,
+        kc: Array,  # [L, B, T, KD] from prefill
+        vc: Array,
+        lengths: Array,  # [B]
+        block_rows: Array,  # [B, max_pages_per_seq] int32
+    ) -> Tuple[Array, Array]:
+        """Scatter prompt K/V into the slots' pages. Positions past a
+        prompt's length land in dump page 0 (never read unmasked)."""
+        ps = k_pages.shape[2]
+        l, b, t, kd = kc.shape
+        pos = jnp.arange(t)
+        valid = pos[None, :] < lengths[:, None]  # [B, T]
+        logical = pos // ps  # [T]
+        page = jnp.take_along_axis(
+            block_rows, jnp.broadcast_to(logical[None, :], (b, t)), axis=1
+        )
+        page = jnp.where(valid, page, 0).reshape(-1)  # [B*T]
+        offs = jnp.broadcast_to((pos % ps)[None, :], (b, t)).reshape(-1)
+        kf = kc.reshape(l, b * t, kd)
+        vf = vc.reshape(l, b * t, kd)
+        return (
+            k_pages.at[:, page, offs].set(kf),
+            v_pages.at[:, page, offs].set(vf),
+        )
+
+    # -- the ONE decode executable ------------------------------------------
+    def decode_step(
+        self,
+        params,
+        k_pages: Array,  # [L, NP, PS, KD] (donated)
+        v_pages: Array,
+        tokens: Array,  # [S] int32: each slot's last token
+        positions: Array,  # [S] int32: that token's position
+        active: Array,  # [S] bool
+        block_table: Array,  # [S, max_pages_per_seq] int32
+    ) -> Tuple[Array, Array, Array]:
+        """One decode step for all slots at the fixed [max_slots] shape.
+
+        Writes each active slot's step K/V into its current page (inactive
+        slots dump into page 0), then attends over the slot's own gathered
+        pages masked to positions <= its own. Returns (k_pages, v_pages,
+        next_tok [S] int32 — greedy). Every op keeps the slot dimension
+        batched (no cross-slot reduction), so a slot's result is bitwise
+        independent of the rest of the batch."""
+        cfg = self.cfg
+        s = tokens.shape[0]
+        h_, hd = cfg.n_heads, cfg.head_dim
+        ps = k_pages.shape[2]
+        x = params["embed"][tokens] + params["pos"][positions]
+        cur_page = jnp.take_along_axis(
+            block_table, (positions // ps)[:, None], axis=1
+        )[:, 0]
+        cur_page = jnp.where(active, cur_page, 0)
+        offs = positions % ps
+        ctx_idx = jnp.arange(block_table.shape[1] * ps)
+        att_mask = ctx_idx[None, :] <= positions[:, None]  # [S, T_ctx]
+        for i in range(cfg.n_layers):
+            h = _rms(x, params[f"l{i}.ln1"])
+            q = (h @ params[f"l{i}.wq"]).reshape(s, h_, hd)
+            k_new = h @ params[f"l{i}.wk"]  # [S, KD]
+            v_new = h @ params[f"l{i}.wv"]
+            k_pages = k_pages.at[i, cur_page, offs].set(k_new)
+            v_pages = v_pages.at[i, cur_page, offs].set(v_new)
+            # gather this slot's pages: [S, P, PS, KD] -> [S, T_ctx, H, hd]
+            k_seq = k_pages[i][block_table].reshape(s, -1, h_, hd)
+            v_seq = v_pages[i][block_table].reshape(s, -1, h_, hd)
+            sc = jnp.einsum("shd,sthd->sht", q, k_seq) * self.scale
+            sc = jnp.where(att_mask[:, None, :], sc, NEG_INF)
+            w = jax.nn.softmax(sc.astype(jnp.float32), -1).astype(x.dtype)
+            ctx = jnp.einsum("sht,sthd->shd", w, v_seq).reshape(s, -1)
+            x = x + ctx @ params[f"l{i}.wo"]
+            x = self._mlp(params, i, x)
+        logits = _rms(x, params["lnf"]) @ params["unembed"]
+        next_tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        return k_pages, v_pages, next_tok

@@ -1,0 +1,137 @@
+"""Admission control: per-tenant token quotas + concurrency caps.
+
+The front door says no *before* any device work is queued ("admission
+control" in ISSUE 6): a request is charged its worst case
+(prompt + max_new_tokens) against its tenant's token bucket at submit time,
+and rejected — never silently queued forever — when the tenant is over
+budget, over its concurrency cap, or the global queue is full. The token
+bucket refills continuously (tokens_per_s up to a burst capacity), the
+standard shape for "heavy traffic from millions of users" fairness; the
+clock is injectable so tests are deterministic."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class QuotaExceeded(Exception):
+    """Rejected by admission control; `reason` is machine-readable
+    ('tokens' | 'concurrency' | 'queue')."""
+
+    def __init__(self, msg: str, reason: str):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class _Bucket:
+    __slots__ = ("capacity", "rate", "level", "last", "in_flight")
+
+    def __init__(self, capacity: float, rate: float, now: float):
+        self.capacity = capacity
+        self.rate = rate
+        self.level = capacity
+        self.last = now
+        self.in_flight = 0
+
+
+class TenantQuotas:
+    """Per-tenant token buckets + concurrency caps.
+
+    `token_capacity` is the burst size and `tokens_per_s` the refill rate;
+    either may be None (unlimited). Unknown tenants get the defaults, so a
+    fleet-wide cap needs no per-tenant config."""
+
+    def __init__(
+        self,
+        token_capacity: Optional[float] = None,
+        tokens_per_s: float = 0.0,
+        max_concurrent: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._default = (token_capacity, float(tokens_per_s), max_concurrent)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, _Bucket] = {}
+        self._caps: Dict[str, Optional[int]] = {}
+        # concurrency holds for tenants with no token bucket
+        self._hold_counts: Dict[str, int] = {}
+
+    def set_quota(
+        self,
+        tenant: str,
+        token_capacity: Optional[float] = None,
+        tokens_per_s: float = 0.0,
+        max_concurrent: Optional[int] = None,
+    ) -> None:
+        with self._lock:
+            if token_capacity is not None:
+                b = _Bucket(token_capacity, float(tokens_per_s), self._clock())
+                self._buckets[tenant] = b
+            self._caps[tenant] = max_concurrent
+
+    def _bucket(self, tenant: str) -> Optional[_Bucket]:
+        b = self._buckets.get(tenant)
+        if b is None and self._default[0] is not None:
+            b = _Bucket(self._default[0], self._default[1], self._clock())
+            self._buckets[tenant] = b
+        return b
+
+    def _cap(self, tenant: str) -> Optional[int]:
+        return self._caps.get(tenant, self._default[2])
+
+    def admit(self, tenant: str, tokens: int) -> None:
+        """Charge `tokens` against the tenant or raise QuotaExceeded. The
+        concurrency hold is released by release(); the tokens are consumed."""
+        with self._lock:
+            b = self._bucket(tenant)
+            cap = self._cap(tenant)
+            # concurrency first: a capped tenant must not drain its bucket
+            # with requests that would be refused anyway
+            holds = b.in_flight if b is not None else self._holds(tenant)
+            if cap is not None and holds >= cap:
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} at max_concurrent={cap}", "concurrency"
+                )
+            if b is not None:
+                now = self._clock()
+                b.level = min(b.capacity, b.level + (now - b.last) * b.rate)
+                b.last = now
+                if tokens > b.level:
+                    raise QuotaExceeded(
+                        f"tenant {tenant!r} over token quota: wanted {tokens}, "
+                        f"{b.level:.0f} of {b.capacity:.0f} available",
+                        "tokens",
+                    )
+                b.level -= tokens
+                b.in_flight += 1
+            else:
+                self._hold_counts[tenant] = self._holds(tenant) + 1
+
+    def _holds(self, tenant: str) -> int:
+        return self._hold_counts.get(tenant, 0)
+
+    def release(self, tenant: str, unused_tokens: int = 0) -> None:
+        """Drop the concurrency hold; refund tokens the request reserved but
+        never generated (a request that stops at EOS early should not keep
+        paying for its worst case)."""
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is not None:
+                b.in_flight = max(0, b.in_flight - 1)
+                if unused_tokens:
+                    b.level = min(b.capacity, b.level + unused_tokens)
+            elif self._holds(tenant):
+                self._hold_counts[tenant] -= 1
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                t: {
+                    "level": round(b.level, 1),
+                    "capacity": b.capacity,
+                    "in_flight": b.in_flight,
+                }
+                for t, b in self._buckets.items()
+            }

@@ -1,0 +1,241 @@
+"""Continuous-batching scheduler: requests, slots, and step-boundary joins.
+
+The host-side half of the serving runtime. A request's life:
+
+    submit -> admission control (queue bound + tenant quota) -> waiting
+    -> [step boundary] slot + KV pages reserved, prefill -> decoding
+    -> EOS / token budget -> retired (pages recycled, handle completed)
+
+The defining property of continuous batching is that admissions and
+retirements happen at *decode step boundaries*, never inside one: a new
+request joins the very next step after a slot frees up, and a finished
+sequence stops occupying its slot immediately — the batch never stalls
+waiting for its longest member (the per-request RPC round-trip model this
+replaces is the fleet-size cap named in "RPC Considered Harmful", PAPERS.md).
+
+This module is pure host bookkeeping (deterministic, unit-testable); the
+device work lives in session.ServingSession."""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Deque, List, Optional, Sequence, Tuple
+
+from paddle_tpu.serving.kv_cache import PagedKVCache
+from paddle_tpu.serving.quota import QuotaExceeded, TenantQuotas
+
+
+class FinishReason:
+    EOS = "eos"
+    LENGTH = "length"
+    CANCELLED = "cancelled"
+
+
+class RequestHandle:
+    """Caller-facing future for one generation request.
+
+    `result()` blocks until the request finishes and returns the generated
+    token ids; a cancelled request raises. Timing fields feed the latency
+    bench (t_submit/t_first_token/t_done, all time.monotonic)."""
+
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    CANCELLED = "cancelled"
+
+    def __init__(self, request_id: int, tenant: str, prompt_len: int,
+                 max_new_tokens: int):
+        self.request_id = request_id
+        self.tenant = tenant
+        self.prompt_len = prompt_len
+        self.max_new_tokens = max_new_tokens
+        self.status = self.QUEUED
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.t_submit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self._event = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not done after {timeout}s"
+            )
+        if self.status == self.CANCELLED:
+            raise RuntimeError(
+                f"request {self.request_id} cancelled ({self.finish_reason})"
+            )
+        return self.tokens
+
+    def _complete(self, status: str, reason: str) -> None:
+        self.status = status
+        self.finish_reason = reason
+        self.t_done = time.monotonic()
+        self._event.set()
+
+
+class _Waiting:
+    __slots__ = ("handle", "prompt")
+
+    def __init__(self, handle: RequestHandle, prompt: List[int]):
+        self.handle = handle
+        self.prompt = prompt
+
+
+class ActiveSeq:
+    """One occupied decode slot: the sequence's last token + position ride
+    into every decode step; everything else is retained host-side."""
+
+    __slots__ = ("handle", "prompt", "last_token", "next_pos", "generated")
+
+    def __init__(self, handle: RequestHandle, prompt: List[int]):
+        self.handle = handle
+        self.prompt = prompt
+        self.last_token: int = -1  # set by prefill
+        self.next_pos: int = len(prompt)  # position the last token occupies
+        self.generated: int = 0
+
+    def append(self, token: int) -> None:
+        self.handle.tokens.append(int(token))
+        self.generated += 1
+        if self.generated == 1:
+            self.handle.t_first_token = time.monotonic()
+        else:
+            self.next_pos += 1
+        self.last_token = int(token)
+
+    def finished(self, eos_id: int) -> Optional[str]:
+        if self.generated and self.last_token == eos_id:
+            return FinishReason.EOS
+        if self.generated >= self.handle.max_new_tokens:
+            return FinishReason.LENGTH
+        return None
+
+
+class Scheduler:
+    """Slot + queue management; thread-safe against concurrent submits."""
+
+    def __init__(
+        self,
+        cache: PagedKVCache,
+        max_queue: int = 256,
+        quotas: Optional[TenantQuotas] = None,
+    ):
+        self.cache = cache
+        self.max_queue = max_queue
+        self.quotas = quotas
+        self.lock = threading.Lock()
+        self.waiting: Deque[_Waiting] = collections.deque()
+        self.slots: List[Optional[ActiveSeq]] = [None] * cache.max_slots
+        self._ids = itertools.count()
+        # counters surfaced through session.stats()
+        self.completed = 0
+        self.rejected = 0
+        self.cancelled = 0
+
+    # -- intake -------------------------------------------------------------
+    def submit(
+        self, prompt: Sequence[int], max_new_tokens: int, tenant: str
+    ) -> RequestHandle:
+        """Admission control happens HERE, synchronously: the caller learns
+        'no' at the front door, not by timing out in a silent queue."""
+        prompt = [int(t) for t in prompt]
+        with self.lock:
+            if len(self.waiting) >= self.max_queue:
+                self.rejected += 1
+                raise QuotaExceeded(
+                    f"request queue full ({self.max_queue})", "queue"
+                )
+            if self.quotas is not None:
+                try:
+                    self.quotas.admit(tenant, len(prompt) + max_new_tokens)
+                except QuotaExceeded:
+                    self.rejected += 1
+                    raise
+            handle = RequestHandle(
+                next(self._ids), tenant, len(prompt), max_new_tokens
+            )
+            self.waiting.append(_Waiting(handle, prompt))
+            return handle
+
+    # -- step-boundary transitions ------------------------------------------
+    def pop_admissions(self) -> List[Tuple[int, ActiveSeq]]:
+        """Move waiting requests into free slots while KV pages allow —
+        called once per engine step, so joins land exactly at step
+        boundaries. Returns [(slot, ActiveSeq)] needing prefill."""
+        admitted: List[Tuple[int, ActiveSeq]] = []
+        with self.lock:
+            for slot in range(len(self.slots)):
+                if not self.waiting:
+                    break
+                if self.slots[slot] is not None:
+                    continue
+                w = self.waiting[0]
+                total = w.handle.prompt_len + w.handle.max_new_tokens
+                if not self.cache.can_reserve(total):
+                    break  # FIFO: do not starve the head by skipping it
+                self.waiting.popleft()
+                self.cache.reserve(slot, total)
+                act = ActiveSeq(w.handle, w.prompt)
+                act.handle.status = RequestHandle.RUNNING
+                self.slots[slot] = act
+                admitted.append((slot, act))
+        return admitted
+
+    def retire(self, slot: int, reason: str) -> None:
+        act = self.slots[slot]
+        assert act is not None
+        with self.lock:
+            self.slots[slot] = None
+            self.cache.release(slot)
+            self.completed += 1
+        if self.quotas is not None:
+            unused = act.handle.max_new_tokens - act.generated
+            self.quotas.release(act.handle.tenant, max(0, unused))
+        act.handle._complete(RequestHandle.DONE, reason)
+
+    def cancel_tenant(self, tenant: str) -> int:
+        """Drop a (evicted/deregistered) tenant's QUEUED requests; running
+        sequences finish — their pages are already committed and retiring
+        them early would waste the work. Returns how many were cancelled."""
+        n = 0
+        with self.lock:
+            keep: Deque[_Waiting] = collections.deque()
+            for w in self.waiting:
+                if w.handle.tenant == tenant:
+                    n += 1
+                    if self.quotas is not None:
+                        self.quotas.release(
+                            tenant,
+                            w.handle.prompt_len + w.handle.max_new_tokens,
+                        )
+                    w.handle._complete(
+                        RequestHandle.CANCELLED, FinishReason.CANCELLED
+                    )
+                else:
+                    keep.append(w)
+            self.waiting = keep
+            self.cancelled += n
+        return n
+
+    # -- views --------------------------------------------------------------
+    def active_slots(self) -> List[Tuple[int, ActiveSeq]]:
+        return [(i, a) for i, a in enumerate(self.slots) if a is not None]
+
+    def has_work(self) -> bool:
+        with self.lock:
+            return bool(self.waiting) or any(
+                a is not None for a in self.slots
+            )
+
+    def queue_depth(self) -> int:
+        with self.lock:
+            return len(self.waiting)

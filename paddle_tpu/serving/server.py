@@ -1,0 +1,396 @@
+"""Serving front-end: the master's request-routing plane, repurposed.
+
+The network layer deliberately reuses `runtime/master.py` machinery instead
+of inventing a second RPC stack (ROADMAP item 1 names the master as the
+request-routing plane):
+
+  * transport — the same newline-delimited line-JSON TCP protocol;
+    `ServingClient` wraps `MasterClient`, inheriting reconnect, endpoint
+    failover, bounded backoff + jitter, and the `conn_reset` chaos site.
+  * tenancy — `_Membership` register/heartbeat leases: a client `register`s
+    for a tenant lease and renews it implicitly on every RPC; a tenant
+    silent past the lease is evicted by the reaper and its QUEUED requests
+    are cancelled (running sequences finish — their KV work is paid for).
+  * quotas — per-tenant token buckets + concurrency caps (quota.py) checked
+    at `submit`/`generate` time; a rejection is an RPC-level error naming
+    the reason, not a timeout.
+
+Methods: register | heartbeat | deregister | submit | poll | generate
+(blocking submit+wait) | stats. A config-driven `GenerationSession` can ride
+alongside the token engine (method `generate_config`) so v1-config golden
+models are served by the same long-lived process."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socketserver
+import tempfile
+import threading
+import uuid
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from paddle_tpu.core import stats
+from paddle_tpu.runtime.master import (
+    EndpointsLike,
+    MasterClient,
+    _Membership,
+)
+from paddle_tpu.serving.quota import QuotaExceeded
+from paddle_tpu.serving.scheduler import RequestHandle
+from paddle_tpu.serving.session import ServingSession
+
+log = logging.getLogger("paddle_tpu.serving")
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        srv: ServingServer = self.server.ctx  # type: ignore[attr-defined]
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError:
+                self._reply({"err": "bad json"})
+                continue
+            tenant_id = req.get("tenant_id")
+            srv.membership.note_seen(tenant_id)
+            try:
+                resp = srv.dispatch(req.get("method"), req, tenant_id)
+            except QuotaExceeded as e:
+                resp = {"err": str(e), "rejected": e.reason}
+            except Exception as e:  # a bad request must not kill the server
+                log.warning("serving RPC failed: %r", e)
+                resp = {"err": f"{type(e).__name__}: {e}"}
+            self._reply(resp)
+
+    def _reply(self, obj: Any) -> None:
+        try:
+            self.wfile.write(json.dumps(obj).encode() + b"\n")
+            self.wfile.flush()
+        except (OSError, ValueError):
+            pass  # peer vanished; its retry path handles it
+
+
+class ServingServer:
+    """Threaded TCP wrapper around a ServingSession (and optionally a
+    config-driven GenerationSession). start()/stop(); port 0 picks a free
+    port — the master's in-process-localhost idiom."""
+
+    def __init__(
+        self,
+        session: Optional[ServingSession] = None,
+        gen_session=None,  # trainer.generation.GenerationSession
+        host: str = "127.0.0.1",
+        port: int = 0,
+        lease_s: float = 30.0,
+        require_register: bool = False,
+        handle_ttl_s: float = 600.0,
+    ):
+        if session is None and gen_session is None:
+            raise ValueError("need a ServingSession and/or a GenerationSession")
+        self.session = session
+        self.gen_session = gen_session
+        self.membership = _Membership(lease_s)
+        self.require_register = require_register
+        # ids THIS server minted via register: require_register must check
+        # against these, not membership — note_seen adopts any id on sight
+        # (the master's retry-exact discipline), so a fabricated tenant_id
+        # would otherwise pass as registered and mint itself a fresh quota
+        # bucket per request
+        self._minted: set = set()
+        self._minted_lock = threading.Lock()
+        # finished handles are garbage-collected this long after completion
+        # (submit-and-vanish clients must not grow a long-lived server; poll
+        # is deliberately NON-destructive so the retrying transport can
+        # re-read a completion whose response was lost on the wire)
+        self.handle_ttl_s = float(handle_ttl_s)
+        self._handles: Dict[int, RequestHandle] = {}
+        # client-supplied idempotency keys, scoped (tenant, key): a retried
+        # submit/generate with the same client_req_id reattaches to the
+        # ORIGINAL request instead of queueing (and quota-charging) a
+        # duplicate — the transport is MasterClient, whose whole contract is
+        # retry-with-reconnect
+        self._by_client_id: Dict[tuple, int] = {}
+        self._handles_lock = threading.Lock()
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True
+        )
+        self._srv.daemon_threads = True
+        self._srv.ctx = self  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+        self._reaper: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._gen_lock = threading.Lock()
+
+    @property
+    def address(self) -> tuple:
+        return self._srv.server_address
+
+    # -- RPC dispatch -------------------------------------------------------
+    def dispatch(self, method: str, req: dict, tenant_id: Optional[str]) -> dict:
+        if method == "register":
+            tid = self.membership.register()
+            with self._minted_lock:
+                self._minted.add(tid)
+            return {"tenant_id": tid, "lease_s": self.membership.lease_s}
+        if method == "heartbeat":
+            return {"ok": bool(tenant_id)}
+        if method == "deregister":
+            if tenant_id:
+                self._forget_tenant(tenant_id)
+            return {"ok": bool(tenant_id)}
+        if method == "stats":
+            out = dict(self.session.stats()) if self.session else {}
+            out["live_tenants"] = self.membership.live
+            out["evicted_tenants"] = self.membership.evicted
+            return out
+        if method in ("submit", "generate"):
+            if self.session is None:
+                return {
+                    "err": "no token engine on this server (started with "
+                    "--config only); use generate_config"
+                }
+            tenant = self._tenant_for(tenant_id)
+            # idempotency keys are scoped PER TENANT: two tenants using the
+            # same key must not alias (that would hand one tenant the other's
+            # tokens — the same leak the poll tenancy check closes)
+            client_req_id = req.get("client_req_id")
+            req_key = (tenant, str(client_req_id)) if client_req_id else None
+            handle = None
+            if req_key is not None:
+                with self._handles_lock:
+                    rid = self._by_client_id.get(req_key)
+                    handle = self._handles.get(rid) if rid is not None else None
+            if handle is None:
+                handle = self.session.submit(
+                    req["prompt"],
+                    req.get("max_new_tokens"),
+                    tenant=tenant,
+                )
+                with self._handles_lock:
+                    self._handles[handle.request_id] = handle
+                    if req_key is not None:
+                        self._by_client_id[req_key] = handle.request_id
+            if method == "submit":
+                return {"request_id": handle.request_id}
+            try:
+                handle.result(timeout=float(req.get("timeout_s", 120.0)))
+            except TimeoutError:
+                # the request keeps running; the handle stays registered so
+                # the caller can poll for the tokens it already paid for
+                return {
+                    "err": "generate timed out server-side; still running",
+                    "request_id": handle.request_id,
+                    "done": False,
+                }
+            return dict(self._completion(handle),
+                        request_id=handle.request_id)
+        if method == "poll":
+            with self._handles_lock:
+                handle = self._handles.get(int(req["request_id"]))
+            if handle is None:
+                return {"err": f"unknown request_id {req['request_id']}"}
+            # request ids are sequential — poll must enforce the SAME tenancy
+            # as submit, or guessing ids reads other tenants' tokens
+            if handle.tenant != self._tenant_for(tenant_id):
+                return {"err": "request belongs to another tenant"}
+            if not handle.done:
+                return {"done": False, "tokens_so_far": len(handle.tokens)}
+            # non-destructive: a lost response must be re-readable; the
+            # reaper GCs finished handles after handle_ttl_s
+            return self._completion(handle)
+        if method == "generate_config":
+            return self._generate_config(req)
+        return {"err": f"unknown method {method!r}"}
+
+    def _tenant_for(self, tenant_id: Optional[str]) -> str:
+        if self.require_register:
+            with self._minted_lock:
+                known = tenant_id in self._minted
+            if not known:
+                # a fabricated or expired id must not pass: each unknown id
+                # would mint itself a fresh full quota bucket
+                raise QuotaExceeded(
+                    "register first: this server requires a live tenant "
+                    "lease (unknown or expired tenant_id)",
+                    "unregistered",
+                )
+            return tenant_id
+        return tenant_id or "default"
+
+    def _forget_tenant(self, tid: str) -> int:
+        """Drop a tenant's lease + minted id and cancel its queued work
+        (deregister and lease-expiry share this path)."""
+        self.membership.drop(tid)
+        with self._minted_lock:
+            self._minted.discard(tid)
+        return self.session.cancel_tenant(tid) if self.session else 0
+
+    @staticmethod
+    def _completion(handle: RequestHandle) -> dict:
+        return {
+            "done": True,
+            "tokens": handle.tokens,
+            "finish_reason": handle.finish_reason,
+            "cancelled": handle.status == RequestHandle.CANCELLED,
+        }
+
+    def _generate_config(self, req: dict) -> dict:
+        """Whole-request generation against the long-lived GenerationSession
+        (built/loaded once at server start — the reentrant capi contract).
+        The batch arrives as {name: nested lists}; printer outputs return
+        inline as {evaluator: text}."""
+        if self.gen_session is None:
+            return {"err": "no --config generation session on this server"}
+        batch = {k: np.asarray(v) for k, v in req["batch"].items()}
+        fd, dest = tempfile.mkstemp(suffix=".gen.txt")
+        os.close(fd)
+        written: Dict[str, str] = {}
+        try:
+            # the session is not reentrant per-request (printer result files);
+            # serialize — throughput callers use the token engine instead
+            with self._gen_lock:
+                written = self.gen_session.generate(batch, result_file=dest)
+            out = {}
+            for name, path in written.items():
+                with open(path) as f:
+                    out[name] = f.read()
+            return {"files": out}
+        finally:
+            # multi-printer configs fan out to per-evaluator files next to
+            # `dest` — clean those up too
+            for path in {dest, *written.values()}:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    # -- lifecycle ----------------------------------------------------------
+    def _reap_loop(self) -> None:
+        import time as _time
+
+        period = max(0.05, min(1.0, self.membership.lease_s / 4.0))
+        while not self._stop_evt.wait(period):
+            for tid in self.membership.expired():
+                self.membership.evicted += 1
+                stats.FT_EVENTS.incr("tenant_evicted")
+                n = self._forget_tenant(tid)
+                log.warning(
+                    "tenant %s lease expired (%gs); evicted, %d queued "
+                    "request(s) cancelled", tid, self.membership.lease_s, n,
+                )
+            # GC handles whose client submitted and never polled — a
+            # long-lived server must not retain every completion forever
+            cutoff = _time.monotonic() - self.handle_ttl_s
+            with self._handles_lock:
+                stale = [
+                    rid for rid, h in self._handles.items()
+                    if h.done and (h.t_done or 0) < cutoff
+                ]
+                for rid in stale:
+                    del self._handles[rid]
+                if stale:
+                    dead = set(stale)
+                    self._by_client_id = {
+                        k: v for k, v in self._by_client_id.items()
+                        if v not in dead
+                    }
+            if stale:
+                log.info("GC'd %d unpolled finished request handle(s)", len(stale))
+
+    def start(self) -> "ServingServer":
+        if self.session is not None and self.session._thread is None:
+            self.session.serve_forever()
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+        self._reaper.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._srv.shutdown()
+        self._srv.server_close()
+        if self._reaper is not None:
+            self._reaper.join(timeout=5.0)
+        if self.session is not None:
+            self.session.stop()
+
+
+class ServingClient:
+    """Ergonomic wrapper over MasterClient (which supplies reconnect,
+    failover lists, backoff and the conn_reset chaos site for free).
+
+    MasterClient's contract is retry-with-reconnect, so every mutating call
+    carries a client-generated idempotency key (`client_req_id`): a retry
+    whose original DID reach the server reattaches to the same request
+    instead of queueing and quota-charging a duplicate. `generate` is
+    implemented as submit + poll — short retry-exact RPCs — rather than one
+    long blocking read that would trip the socket timeout on a loaded
+    server."""
+
+    def __init__(self, address: EndpointsLike, **client_kw):
+        self._client = MasterClient(address, **client_kw)
+        self.tenant_id: Optional[str] = None
+        self.lease_s: float = 30.0
+
+    def register(self) -> str:
+        resp = self._client.call("register")
+        self.tenant_id = resp["tenant_id"]
+        self.lease_s = float(resp.get("lease_s", 30.0))
+        return self.tenant_id
+
+    def _id_kw(self) -> dict:
+        return {"tenant_id": self.tenant_id} if self.tenant_id else {}
+
+    def generate(
+        self,
+        prompt,
+        max_new_tokens: Optional[int] = None,
+        timeout_s: float = 120.0,
+        poll_interval_s: float = 0.02,
+    ) -> dict:
+        import time as _time
+
+        rid = self.submit(prompt, max_new_tokens)
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            resp = self.poll(rid)
+            if "err" in resp:
+                raise RuntimeError(f"generate failed: {resp['err']}")
+            if resp.get("done"):
+                return resp
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"generate: request {rid} not done after {timeout_s}s "
+                    f"({resp.get('tokens_so_far', 0)} tokens so far); poll "
+                    f"request_id {rid} to retrieve it later"
+                )
+            _time.sleep(poll_interval_s)
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None) -> int:
+        resp = self._client.call(
+            "submit", prompt=list(prompt), max_new_tokens=max_new_tokens,
+            client_req_id=uuid.uuid4().hex, **self._id_kw(),
+        )
+        if "err" in resp:
+            raise RuntimeError(f"submit rejected: {resp['err']}")
+        return int(resp["request_id"])
+
+    def poll(self, request_id: int) -> dict:
+        return self._client.call("poll", request_id=request_id, **self._id_kw())
+
+    def heartbeat(self) -> dict:
+        return self._client.call("heartbeat", **self._id_kw())
+
+    def stats(self) -> dict:
+        return self._client.call("stats", **self._id_kw())
+
+    def close(self) -> None:
+        self._client.close()

@@ -1,0 +1,337 @@
+"""ServingSession: a long-lived serving engine that owns device state.
+
+The anti-pattern this replaces: `run_generation` rebuilt the Network,
+re-initialized params and reloaded the checkpoint on EVERY call, and
+`InferenceMachine.forward` compiled per batch shape and blocked the host per
+request. Here the session loads parameters ONCE, compiles THREE kinds of
+executable ONCE, and then serves any number of requests of any mixed lengths
+against them:
+
+  * decode  — the single fixed-[max_slots] continuous-batching step
+              (pages donated in/out; the only executable in the hot loop)
+  * prefill — one per length bucket (a handful: `prefill_buckets`)
+  * commit  — one per bucket (scatter prompt KV into pages)
+
+Shape discipline is *asserted*, not hoped for: every decode step's input
+signature is recorded into a serving-local stats.RecompileStats (the PR-1
+telemetry) and `decode_shape_signatures()` must stay at 1 over any request
+mix — the zero-recompile gate in tests/test_serving.py and
+benchmarks/serving_bench.py.
+
+Hot-loop discipline matches the trainer's (README "Async execution"): the
+decode loop performs exactly ONE device->host fetch per step — the sampled
+token ids, which the autoregressive loop inherently needs to detect EOS and
+stream results. tests/test_lint_hotloop.py lints this loop body the same way
+it lints the train loop."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.core import stats
+from paddle_tpu.serving.kv_cache import PagedKVCache
+from paddle_tpu.serving.model import LMConfig, ServableLM
+from paddle_tpu.serving.quota import TenantQuotas
+from paddle_tpu.serving.scheduler import RequestHandle, Scheduler
+
+# serving-side counters (sibling of stats.FT_EVENTS/DATA_EVENTS): admissions,
+# retirements, quota rejections, decode steps — unconditional telemetry
+SERVING_EVENTS = stats.EventCounter()
+
+
+def _bucket_for(buckets: Sequence[int], n: int) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt of {n} tokens exceeds largest bucket {buckets[-1]}")
+
+
+class ServingSession:
+    def __init__(
+        self,
+        model: ServableLM,
+        params: Dict,
+        *,
+        max_slots: int = 8,
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        prefill_buckets: Sequence[int] = (16, 32, 64),
+        max_new_limit: int = 64,
+        max_queue: int = 256,
+        quotas: Optional[TenantQuotas] = None,
+    ):
+        import jax
+
+        self.model = model
+        self.cfg = model.cfg
+        self.params = jax.device_put(params)
+        self.buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
+        self.max_new_limit = int(max_new_limit)
+        max_ctx = self.buckets[-1] + self.max_new_limit
+        if max_ctx > self.cfg.max_len:
+            raise ValueError(
+                f"largest bucket + max_new_limit = {max_ctx} exceeds the "
+                f"model's max_len {self.cfg.max_len}"
+            )
+        pages_per_seq = -(-max_ctx // page_size)
+        if num_pages is None:
+            # worst case every slot at full context, plus the dump page
+            num_pages = max_slots * pages_per_seq + 1
+        self.cache = PagedKVCache(
+            n_layers=self.cfg.n_layers,
+            kv_dim=self.cfg.d_model,
+            num_pages=num_pages,
+            page_size=page_size,
+            max_slots=max_slots,
+            max_pages_per_seq=pages_per_seq,
+        )
+        self.scheduler = Scheduler(self.cache, max_queue=max_queue, quotas=quotas)
+        self.k_pages, self.v_pages = self.cache.make_pools()
+
+        # the three executables; jit's shape cache turns the bucket list into
+        # "a few padded lengths" -> a few compiles, and decode into exactly one
+        self._decode = jax.jit(model.decode_step, donate_argnums=(1, 2))
+        self._prefill = jax.jit(model.prefill)
+        self._commit = jax.jit(model.commit_prefill, donate_argnums=(0, 1))
+
+        self.recompiles = stats.RecompileStats(warn_threshold=2)
+        self.decode_steps = 0
+        self.tokens_generated = 0
+        self.engine_error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._work = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- intake -------------------------------------------------------------
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        tenant: str = "default",
+    ) -> RequestHandle:
+        """Queue one generation request; raises QuotaExceeded at the front
+        door when admission control says no. Thread-safe."""
+        if self.engine_error is not None:
+            raise RuntimeError(
+                "serving engine died; no new requests accepted"
+            ) from self.engine_error
+        prompt = list(prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        max_new = min(
+            self.max_new_limit,
+            self.max_new_limit if max_new_tokens is None else int(max_new_tokens),
+        )
+        if max_new <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        _bucket_for(self.buckets, len(prompt))  # validates prompt length
+        need = self.cache.pages_needed(len(prompt) + max_new)
+        if need > min(self.cache.max_pages_per_seq, self.cache.num_pages - 1):
+            # an undersized pool must reject at the front door, not leave the
+            # queue head unadmittable forever
+            raise ValueError(
+                f"request needs {need} KV pages; pool allows "
+                f"{min(self.cache.max_pages_per_seq, self.cache.num_pages - 1)}"
+            )
+        handle = self.scheduler.submit(prompt, max_new, tenant)
+        SERVING_EVENTS.incr("serving_submitted")
+        with self._work:
+            self._work.notify()
+        return handle
+
+    # -- engine steps -------------------------------------------------------
+    def _admit(self) -> None:
+        """Run prefill for every request joining at this step boundary."""
+        import jax.numpy as jnp
+
+        for slot, act in self.scheduler.pop_admissions():
+            bucket = _bucket_for(self.buckets, len(act.prompt))
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, : len(act.prompt)] = act.prompt
+            lengths = np.array([len(act.prompt)], np.int32)
+            first_tok, kc, vc = self._prefill(self.params, toks, lengths)
+            rows = self.cache.block_table()[slot : slot + 1]
+            self.k_pages, self.v_pages = self._commit(
+                self.k_pages, self.v_pages, kc, vc,
+                jnp.asarray(lengths), jnp.asarray(rows),
+            )
+            # one tiny host fetch per ADMISSION (not per decode step): the
+            # prompt's first sampled token — argmax happened on device
+            act.append(int(first_tok[0]))
+            SERVING_EVENTS.incr("serving_prefills")
+            reason = act.finished(self.cfg.eos_id)
+            if reason is not None:
+                self.scheduler.retire(slot, reason)
+
+    def _decode_once(self) -> None:
+        """One continuous-batching decode step: every active slot advances by
+        one token inside the single fixed-shape executable."""
+        active = self.scheduler.active_slots()
+        if not active:
+            return
+        s = self.cache.max_slots
+        tokens = np.zeros(s, np.int32)
+        positions = np.zeros(s, np.int32)
+        act_mask = np.zeros(s, bool)
+        for slot, act in active:
+            tokens[slot] = act.last_token
+            positions[slot] = act.next_pos
+            act_mask[slot] = True
+        bt = self.cache.block_table()
+        # zero-recompile assertion data: the decode signature must be the
+        # same every step no matter the request mix (fixed [max_slots] shape)
+        self.recompiles.record(
+            stats.batch_signature(
+                {"tokens": tokens, "positions": positions, "active": act_mask,
+                 "block_table": bt}
+            )
+        )
+        self.k_pages, self.v_pages, next_tok = self._decode(
+            self.params, self.k_pages, self.v_pages,
+            tokens, positions, act_mask, bt,
+        )
+        # sync-ok: the ONE sanctioned fetch in the serving hot loop — the
+        # sampled token ids, which the autoregressive loop needs on host to
+        # detect EOS/budget and stream tokens; everything else stays device-
+        # resident (pages are donated through, logits never leave the device)
+        toks = np.asarray(next_tok)
+        self.decode_steps += 1
+        SERVING_EVENTS.incr("serving_decode_steps")
+        for slot, act in active:
+            act.append(toks[slot])
+            self.tokens_generated += 1
+            reason = act.finished(self.cfg.eos_id)
+            if reason is not None:
+                self.scheduler.retire(slot, reason)
+
+    def step(self) -> bool:
+        """One engine iteration: retire/admit at the boundary, then one
+        decode step. Returns True when any work was done."""
+        self._admit()
+        before = self.decode_steps
+        self._decode_once()
+        return self.decode_steps != before or bool(self.scheduler.active_slots())
+
+    def run_until_idle(self) -> None:
+        """Drive the engine on the calling thread until queue + slots drain
+        (the single-threaded harness used by tests and the bench)."""
+        while self.scheduler.has_work():
+            self.step()
+
+    # -- background engine thread (server mode) -----------------------------
+    def serve_forever(self) -> "ServingSession":
+        def _loop():
+            while not self._stop.is_set():
+                if not self.scheduler.has_work():
+                    with self._work:
+                        self._work.wait(timeout=0.05)
+                    continue
+                try:
+                    self.step()
+                except Exception as e:  # noqa: BLE001 — a dead engine thread
+                    # must not look like a healthy-but-slow server: record the
+                    # error (new submits raise it), fail every outstanding
+                    # handle so blocked callers wake NOW, and stop. The state
+                    # may be unrecoverable anyway — a failed _decode consumed
+                    # the donated page buffers. (The trainer's precedent:
+                    # AsyncCheckpointer re-raises on the training thread.)
+                    import logging
+
+                    logging.getLogger("paddle_tpu.serving").exception(
+                        "serving engine step failed; failing %d outstanding "
+                        "request(s) and stopping",
+                        len(self.scheduler.active_slots())
+                        + self.scheduler.queue_depth(),
+                    )
+                    self.engine_error = e
+                    self._fail_outstanding()
+                    self._stop.set()
+                    return
+
+        self._thread = threading.Thread(
+            target=_loop, name="serving-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _fail_outstanding(self) -> None:
+        """Complete every waiting + running handle as CANCELLED('engine_error')
+        so result() raises instead of timing out; pages are released for
+        accounting hygiene even though the engine is done."""
+        sch = self.scheduler
+        with sch.lock:
+            waiting = list(sch.waiting)
+            sch.waiting.clear()
+            running = [(i, a) for i, a in enumerate(sch.slots) if a is not None]
+            for slot, _ in running:
+                sch.slots[slot] = None
+                self.cache.release(slot)
+        for w in waiting:
+            if sch.quotas is not None:
+                sch.quotas.release(w.handle.tenant)
+            w.handle._complete(RequestHandle.CANCELLED, "engine_error")
+        for _, act in running:
+            if sch.quotas is not None:
+                sch.quotas.release(act.handle.tenant)
+            act.handle._complete(RequestHandle.CANCELLED, "engine_error")
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._work:
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def cancel_tenant(self, tenant: str) -> int:
+        return self.scheduler.cancel_tenant(tenant)
+
+    # -- telemetry ----------------------------------------------------------
+    def decode_shape_signatures(self) -> int:
+        """Distinct decode-step input signatures seen — 1 means the entire
+        serving lifetime shared one compiled decode program."""
+        return self.recompiles.total_signatures()
+
+    def stats(self) -> Dict:
+        sch = self.scheduler
+        return {
+            "decode_steps": self.decode_steps,
+            "tokens_generated": self.tokens_generated,
+            "decode_shape_signatures": self.decode_shape_signatures(),
+            "queue_depth": sch.queue_depth(),
+            "active_slots": len(sch.active_slots()),
+            "max_slots": self.cache.max_slots,
+            "free_pages": self.cache.free_pages,
+            "pages_in_use": self.cache.pages_in_use,
+            "completed": sch.completed,
+            "rejected": sch.rejected,
+            "cancelled": sch.cancelled,
+            "prefill_buckets": list(self.buckets),
+        }
+
+
+def make_demo_session(
+    vocab: int = 128,
+    n_layers: int = 2,
+    d_model: int = 32,
+    n_heads: int = 2,
+    seed: int = 0,
+    **session_kw,
+) -> ServingSession:
+    """A small seeded model + session (CLI --demo, benches, tests)."""
+    import jax
+
+    buckets = session_kw.pop("prefill_buckets", (16, 32, 64))
+    max_new = session_kw.pop("max_new_limit", 64)
+    max_len = max(buckets) + max_new
+    model = ServableLM(LMConfig(
+        vocab=vocab, n_layers=n_layers, d_model=d_model, n_heads=n_heads,
+        max_len=max_len,
+    ))
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return ServingSession(
+        model, params, prefill_buckets=buckets, max_new_limit=max_new,
+        **session_kw,
+    )

@@ -1,0 +1,80 @@
+"""Closed-loop serving workloads: N concurrent streams vs sequential.
+
+Shared by benchmarks/serving_bench.py and the bench.py serving leg so the
+acceptance numbers and the tracked metric are the same code path.
+
+A "stream" models one user connection: it keeps exactly one request in
+flight, submitting its next request the moment the previous one completes —
+so `concurrency=N` holds N requests live and continuous batching gets to
+fill up to N slots per decode step. `concurrency=1` IS the sequential
+per-request baseline (same executables, same platform, same shapes): the
+measured speedup isolates dynamic batching, not kernel differences."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def make_prompts(
+    n: int,
+    lengths: Sequence[int],
+    vocab: int,
+    bos_id: int,
+    seed: int = 0,
+) -> List[List[int]]:
+    """Deterministic mixed-length prompts (BOS + random ids; never EOS so
+    lengths are workload-controlled, not sampling-controlled)."""
+    rs = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        ln = int(lengths[i % len(lengths)])
+        body = rs.randint(3, vocab, size=ln - 1)
+        out.append([bos_id] + [int(t) for t in body])
+    return out
+
+
+def run_closed_loop(
+    session,
+    prompts: List[List[int]],
+    max_new_tokens: int,
+    concurrency: int,
+    tenant: str = "default",
+) -> Dict:
+    """Drive `session` single-threaded: keep up to `concurrency` requests in
+    flight, stepping the engine until all prompts complete. Returns
+    tokens/sec plus p50/p99 request latency."""
+    pending = list(enumerate(prompts))
+    in_flight = {}  # request_id -> (index, handle)
+    latencies_ms: List[float] = []
+    tokens_out = 0
+    results: List[Optional[List[int]]] = [None] * len(prompts)
+
+    t0 = time.monotonic()
+    while pending or in_flight:
+        while pending and len(in_flight) < concurrency:
+            idx, prompt = pending.pop(0)
+            h = session.submit(prompt, max_new_tokens, tenant=tenant)
+            in_flight[h.request_id] = (idx, h)
+        session.step()
+        done = [rid for rid, (_, h) in in_flight.items() if h.done]
+        for rid in done:
+            idx, h = in_flight.pop(rid)
+            results[idx] = h.tokens
+            tokens_out += len(h.tokens)
+            latencies_ms.append((h.t_done - h.t_submit) * 1e3)
+    dt = time.monotonic() - t0
+
+    lat = np.asarray(latencies_ms)
+    return {
+        "concurrency": concurrency,
+        "requests": len(prompts),
+        "tokens": tokens_out,
+        "wall_s": round(dt, 4),
+        "tokens_per_sec": round(tokens_out / dt, 1) if dt > 0 else 0.0,
+        "p50_latency_ms": round(float(np.percentile(lat, 50)), 2),
+        "p99_latency_ms": round(float(np.percentile(lat, 99)), 2),
+        "results": results,
+    }

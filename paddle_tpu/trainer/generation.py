@@ -9,7 +9,13 @@ The reference resolves the config's relative dict_file/result_file paths
 against its working directory; `base_dir` plays that role here, and
 `result_file` overrides the config's destination (tests write to a tmpdir,
 never next to the read-only reference tree).
-"""
+
+`GenerationSession` is the long-lived form (ISSUE 6): the Network is built
+and the checkpoint loaded ONCE, then `generate` runs any number of batches
+against the same parameters — the serving runtime
+(`paddle_tpu/serving/server.py` method `generate_config`) and the golden
+tests exercise this same path. `run_generation` stays as the one-shot
+wrapper with its original signature."""
 
 from __future__ import annotations
 
@@ -28,6 +34,116 @@ def _resolve(path: str, base_dir: Optional[str]) -> str:
     return path
 
 
+class GenerationSession:
+    """Build once, load once, generate many.
+
+    The per-call rebuild `run_generation` used to do (fresh Network, fresh
+    init, checkpoint reload on EVERY request) is hoisted into the first
+    `generate` call; subsequent calls reuse the same parameter buffers, so a
+    serving process pays model-load cost once per lifetime instead of once
+    per request. Parameter init needs a sample batch for shape discovery,
+    hence lazy build on first generate rather than in __init__."""
+
+    def __init__(
+        self,
+        pc,
+        model_dir: Optional[str] = None,
+        base_dir: Optional[str] = None,
+        rng: Optional[jax.Array] = None,
+    ):
+        self.pc = pc
+        self.net = Network(pc.outputs)
+        self.model_dir = model_dir
+        self.base_dir = base_dir
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._params: Optional[Dict[str, Any]] = None
+        self._states: Optional[Dict[str, Any]] = None
+
+    @property
+    def built(self) -> bool:
+        return self._params is not None
+
+    def _ensure_built(self, batch: Dict[str, Any]) -> None:
+        if self._params is not None:
+            return
+        from paddle_tpu.trainer.checkpoint import load_pass
+
+        params, states = self.net.init(self._rng, batch, train=False)
+        if self.model_dir is not None:
+            import jax.numpy as jnp
+
+            loaded, _, _, _ = load_pass(self.model_dir, params_template=params)
+            params = {k: jnp.asarray(v) for k, v in loaded.items()}
+        self._params, self._states = params, states
+
+    def generate(
+        self, batch: Dict[str, Any], result_file: Optional[str] = None
+    ) -> Dict[str, str]:
+        """Forward one batch and write the declared seq_text_printer outputs.
+
+        Returns {evaluator name: result file written}. The generated node is
+        the config's output (`__beam_search_predict__` resolution); its
+        cached beam payload (scores/all-beam histories) feeds the beam-mode
+        print."""
+        from paddle_tpu.metrics.evaluators import EVALUATORS
+
+        self._ensure_built(batch)
+        ctx = Context("apply", self._params, self._states, None, False)
+        values = self.net._run(ctx, batch)
+
+        printers = [
+            ec for ec in self.pc.context.evaluators
+            if ec.type == "seq_text_printer"
+        ]
+        written: Dict[str, str] = {}
+        for idx, ec in enumerate(printers):
+            out_name = (
+                ec.input_layers[0] if ec.input_layers else self.pc.outputs[0].name
+            )
+            arg = values.get(out_name)
+            if arg is None:
+                continue
+            if result_file and len(printers) > 1:
+                # one override dest + several printers would silently keep
+                # only the last printer's text; fan out per evaluator
+                root, ext = os.path.splitext(result_file)
+                dest = f"{root}.{ec.name or idx}{ext}"
+            else:
+                dest = result_file or _resolve(ec.result_file, self.base_dir)
+            printer = EVALUATORS.get("seq_text_printer")(
+                result_file=dest,
+                dict_file=_resolve(ec.dict_file, self.base_dir),
+                delimited=ec.delimited,
+            )
+            sample_ids = None
+            if len(ec.input_layers) > 1:
+                id_name = ec.input_layers[1]
+                if id_name in batch:
+                    sample_ids = np.asarray(batch[id_name])
+            printer.start()
+            printer.update(
+                output=np.asarray(arg.value),
+                sample_ids=sample_ids,
+                beam=ctx.cache.get(("beam", out_name)),
+                lengths=None if arg.lengths is None else np.asarray(arg.lengths),
+                sub_lengths=(
+                    None
+                    if arg.sub_lengths is None
+                    else np.asarray(arg.sub_lengths)
+                ),
+            )
+            printer.finish()
+            # unnamed printers must not collide in the result map when a
+            # config declares several (the caller reads every entry's file)
+            key = ec.name or (
+                "seq_text_printer"
+                if len(printers) == 1
+                else f"seq_text_printer_{idx}"
+            )
+            written[key] = dest
+        return written
+
+
 def run_generation(
     pc,
     batch: Dict[str, Any],
@@ -36,57 +152,9 @@ def run_generation(
     result_file: Optional[str] = None,
     rng: Optional[jax.Array] = None,
 ) -> Dict[str, str]:
-    """Generate with a ParsedConfig and write the printer outputs.
-
-    Returns {evaluator name: result file written}. The generated node is the
-    config's output (`__beam_search_predict__` resolution); its cached beam
-    payload (scores/all-beam histories) feeds the beam-mode print.
-    """
-    from paddle_tpu.metrics.evaluators import EVALUATORS
-    from paddle_tpu.trainer.checkpoint import load_pass
-
-    net = Network(pc.outputs)
-    params, states = net.init(
-        rng if rng is not None else jax.random.PRNGKey(0), batch, train=False
-    )
-    if model_dir is not None:
-        import jax.numpy as jnp
-
-        loaded, _, _, _ = load_pass(model_dir, params_template=params)
-        params = {k: jnp.asarray(v) for k, v in loaded.items()}
-
-    ctx = Context("apply", params, states, None, False)
-    values = net._run(ctx, batch)
-
-    written: Dict[str, str] = {}
-    for ec in pc.context.evaluators:
-        if ec.type != "seq_text_printer":
-            continue
-        out_name = ec.input_layers[0] if ec.input_layers else pc.outputs[0].name
-        arg = values.get(out_name)
-        if arg is None:
-            continue
-        dest = result_file or _resolve(ec.result_file, base_dir)
-        printer = EVALUATORS.get("seq_text_printer")(
-            result_file=dest,
-            dict_file=_resolve(ec.dict_file, base_dir),
-            delimited=ec.delimited,
-        )
-        sample_ids = None
-        if len(ec.input_layers) > 1:
-            id_name = ec.input_layers[1]
-            if id_name in batch:
-                sample_ids = np.asarray(batch[id_name])
-        printer.start()
-        printer.update(
-            output=np.asarray(arg.value),
-            sample_ids=sample_ids,
-            beam=ctx.cache.get(("beam", out_name)),
-            lengths=None if arg.lengths is None else np.asarray(arg.lengths),
-            sub_lengths=(
-                None if arg.sub_lengths is None else np.asarray(arg.sub_lengths)
-            ),
-        )
-        printer.finish()
-        written[ec.name or "seq_text_printer"] = dest
-    return written
+    """One-shot generation: a thin wrapper building a GenerationSession for a
+    single batch (the original API; golden tests and the serving runtime both
+    land on the session path)."""
+    return GenerationSession(
+        pc, model_dir=model_dir, base_dir=base_dir, rng=rng
+    ).generate(batch, result_file=result_file)

@@ -43,6 +43,26 @@ def _generate(conf, config_args, batch, dest):
     return dest
 
 
+def test_generation_session_reuse_matches_golden(tmp_path):
+    """The serving-runtime contract on the golden model: ONE GenerationSession
+    (params built + checkpoint loaded once) generates repeatedly, and every
+    repeat reproduces the golden output — the compiled path run_generation
+    wraps is the same one a long-lived server reuses."""
+    from paddle_tpu.config.config_parser import parse_config
+    from paddle_tpu.trainer.generation import GenerationSession
+
+    pc = parse_config(
+        os.path.join(CONF_DIR, "sample_trainer_rnn_gen.conf"), "beam_search=0"
+    )
+    sess = GenerationSession(pc, model_dir=MODEL_DIR, base_dir=REF_ROOT)
+    want = _read_floats(os.path.join(GOLDEN, "r1.test.nobeam"))
+    for i in range(2):  # the second call must NOT rebuild/reload
+        dest = str(tmp_path / f"dump_text.{i}.test")
+        written = sess.generate(_flat_batch(), result_file=dest)
+        assert written
+        assert _read_floats(dest) == want
+
+
 def _flat_batch():
     rs = np.random.RandomState(0)
     return {

@@ -1,33 +1,37 @@
-"""Grep-lint for the trainer hot loop: per-step host syncs must not regress.
+"""Grep-lint for the hot loops: per-step host syncs must not regress.
 
 ISSUE 4 removed every per-step device→host fetch from the train loop (the
 old divergence guard called float(cost) on EVERY step — "the guard's price").
+ISSUE 6 added a second hot loop with the same discipline: the serving decode
+loop, whose per-step budget is exactly ONE fetch (the sampled token ids,
+which the autoregressive loop inherently needs on host).
+
 The remaining fetches are few, deliberate, and each carries a `sync-ok` tag
 naming its justification:
 
+  trainer (SGDTrainer.train / _train_one_pass):
   * the guard poll (_poll_guard, every guard_check_every steps),
   * the single pass-end fetch of the on-device cost sum,
   * the deferred log line (value copied to host asynchronously a dispatch
     earlier),
   * the opt-in PADDLE_TPU_TIMER block_until_ready.
 
+  serving (ServingSession._decode_once / step):
+  * the sampled-token fetch after the decode dispatch.
+
 This test fails the build if a sync-forcing call — float(...),
-np.isfinite(...), .item(...), jax.device_get(...), block_until_ready(...) —
-appears inside the train-loop body (SGDTrainer.train / _train_one_pass)
-without a `sync-ok` tag on the line or within the few lines above it, so a
-per-step sync cannot sneak back in as an innocent-looking one-liner."""
+np.isfinite(...), .item(...), jax.device_get(...), block_until_ready(...),
+and for the serving loop also np.asarray(...) — appears inside a hot-loop
+body without a `sync-ok` tag on the line or within the few lines above it,
+so a per-step sync cannot sneak back in as an innocent-looking one-liner."""
 
 import ast
 import os
 import re
 
-TRAINER_PY = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "paddle_tpu", "trainer", "trainer.py",
-)
-
-# the train-loop body: everything these methods (and their closures) contain
-HOT_METHODS = ("train", "_train_one_pass")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER_PY = os.path.join(_REPO, "paddle_tpu", "trainer", "trainer.py")
+SERVING_PY = os.path.join(_REPO, "paddle_tpu", "serving", "session.py")
 
 # calls that force a device sync when applied to a device array; jnp.* ops
 # (async, traced) are deliberately NOT matched — hence the lookbehinds
@@ -35,68 +39,82 @@ SYNC_CALL = re.compile(
     r"(?<![\w.])float\(|(?<![\w.])np\.isfinite\(|\.item\(|"
     r"jax\.device_get\(|block_until_ready\("
 )
+# the serving decode loop additionally bans untagged np.asarray — its one
+# sanctioned fetch uses exactly that idiom, so an unreviewed second one
+# must trip the lint
+SERVING_SYNC_CALL = re.compile(
+    SYNC_CALL.pattern + r"|(?<![\w.])np\.asarray\("
+)
+
+# (file, class, hot methods, pattern, max sync-ok tags)
+HOT_LOOPS = [
+    (TRAINER_PY, "SGDTrainer", ("train", "_train_one_pass"), SYNC_CALL, 4),
+    (SERVING_PY, "ServingSession", ("_decode_once", "step"),
+     SERVING_SYNC_CALL, 1),
+]
+
 # a tag on the offending line or in the contiguous comment block above it
 TAG = "sync-ok"
 TAG_LOOKBACK = 6  # lines
 
 
-def _hot_spans(tree: ast.Module):
+def _hot_spans(tree: ast.Module, class_name: str, methods):
     for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "SGDTrainer":
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
             for item in node.body:
                 if (
                     isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
-                    and item.name in HOT_METHODS
+                    and item.name in methods
                 ):
                     yield item.name, item.lineno, item.end_lineno
 
 
-def test_no_untagged_device_sync_in_train_loop():
-    with open(TRAINER_PY) as f:
+def _scan(path, class_name, methods, pattern):
+    with open(path) as f:
         source = f.read()
     lines = source.splitlines()
-    tree = ast.parse(source)
-    spans = list(_hot_spans(tree))
-    assert {name for name, _, _ in spans} == set(HOT_METHODS), (
-        f"hot-loop methods moved/renamed — update {__file__}"
+    spans = list(_hot_spans(ast.parse(source), class_name, methods))
+    assert {name for name, _, _ in spans} == set(methods), (
+        f"hot-loop methods of {class_name} moved/renamed — update {__file__}"
     )
-
-    violations = []
+    violations, tagged = [], []
     for name, lo, hi in spans:
         for ln in range(lo, hi + 1):
             text = lines[ln - 1]
+            if TAG in text:
+                tagged.append(ln)
             code = text.split("#", 1)[0]
-            if not SYNC_CALL.search(code):
+            if not pattern.search(code):
                 continue
             window = lines[max(0, ln - TAG_LOOKBACK):ln]
             if any(TAG in w for w in window):
                 continue
-            violations.append(f"{name}:{ln}: {text.strip()}")
+            violations.append(f"{os.path.basename(path)}:{name}:{ln}: {text.strip()}")
+    return violations, tagged
+
+
+def test_no_untagged_device_sync_in_hot_loops():
+    violations = []
+    for path, cls, methods, pattern, _ in HOT_LOOPS:
+        v, _ = _scan(path, cls, methods, pattern)
+        violations += v
     assert not violations, (
-        "device-sync call(s) in the train-loop body without a `sync-ok` "
-        "tag — per-step host syncs serialize the XLA async dispatch "
-        "pipeline (see ISSUE 4 / README 'Async execution'). Either move "
-        "the fetch out of the hot loop or, if it is genuinely one of the "
-        "sanctioned sites, tag the line with `# sync-ok: <why>`:\n  "
-        + "\n  ".join(violations)
+        "device-sync call(s) in a hot-loop body without a `sync-ok` tag — "
+        "per-step host syncs serialize the XLA async dispatch pipeline (see "
+        "ISSUE 4 / README 'Async execution' and the serving decode-loop "
+        "contract, README 'Serving'). Either move the fetch out of the hot "
+        "loop or, if it is genuinely one of the sanctioned sites, tag the "
+        "line with `# sync-ok: <why>`:\n  " + "\n  ".join(violations)
     )
 
 
 def test_sanctioned_sync_sites_stay_rare():
     """The tag is a justification, not a loophole: the number of sync-ok
-    sites in the hot loop is pinned so adding one forces a review here."""
-    with open(TRAINER_PY) as f:
-        source = f.read()
-    lines = source.splitlines()
-    spans = list(_hot_spans(ast.parse(source)))
-    tagged = [
-        ln
-        for _, lo, hi in spans
-        for ln in range(lo, hi + 1)
-        if TAG in lines[ln - 1]
-    ]
-    assert len(tagged) <= 4, (
-        f"{len(tagged)} sync-ok tags in the hot loop (expected <= 4): a new "
-        "sanctioned sync site was added — confirm it is not per-step and "
-        "bump this bound deliberately"
-    )
+    sites in each hot loop is pinned so adding one forces a review here."""
+    for path, cls, methods, pattern, budget in HOT_LOOPS:
+        _, tagged = _scan(path, cls, methods, pattern)
+        assert len(tagged) <= budget, (
+            f"{len(tagged)} sync-ok tags in the {cls} hot loop (expected <= "
+            f"{budget}): a new sanctioned sync site was added — confirm it "
+            "is not per-step and bump this bound deliberately"
+        )
