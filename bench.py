@@ -439,6 +439,15 @@ def run_bench(cpu_fallback: bool) -> dict:
     opt_state_bytes = stats.per_chip_tree_bytes(trainer.state["opt"])
     collective_bytes = trainer.updater.collective_bytes_per_step()
 
+    # HLO cost buckets (obs pillar 3 / ROADMAP item 2's target list): lower
+    # BEFORE the donated timing runs delete the state buffers; the AOT
+    # compile for the report happens after timing so it never skews it.
+    # Defaults on for the CPU fallback; BENCH_PROFILE=1 forces it on TPU
+    # (one extra XLA compile of the step program).
+    profile_on = (
+        os.environ.get("BENCH_PROFILE", "1" if cpu_fallback else "0") == "1"
+    )
+    lowered = None
     if scan_k > 1:
         # K distinct stacked batches per dispatch, scanned inside one
         # compiled program (SGDTrainer.make_multi_step)
@@ -451,6 +460,8 @@ def run_bench(cpu_fallback: bool) -> dict:
             }
         )
         multi = trainer.make_multi_step()
+        if profile_on:
+            lowered = multi.lower(trainer.state, batches)
         dispatches = max(1, steps // scan_k)
         sec_per_step, _ = time_multi_steps(
             multi, trainer.state, batches, scan_k,
@@ -460,6 +471,8 @@ def run_bench(cpu_fallback: bool) -> dict:
     else:
         step = trainer._make_step()
         batch = dp.shard_batch(batch)
+        if profile_on:
+            lowered = step.lower(trainer.state, batch)
         sec_per_step, _ = time_train_steps(
             step, trainer.state, batch, steps=steps, warmup=warmup
         )
@@ -500,6 +513,20 @@ def run_bench(cpu_fallback: bool) -> dict:
         "baseline_note": "vs_baseline = mfu/0.50 on the available chip, not v5p",
         **tune_info,
     }
+    if lowered is not None:
+        # top-k FLOP/byte buckets of the timed executable — the
+        # profile-driven optimization target list (obs/profile.py; the same
+        # report the CLI's --profile pass:N writes)
+        try:
+            from paddle_tpu.obs.profile import compiled_cost_report
+
+            out["hlo_cost"] = dict(
+                compiled_cost_report(lowered.compile(), top_k=3),
+                executable="train_step_scan" if scan_k > 1 else "train_step",
+            )
+        except Exception as exc:  # noqa: BLE001 — report must not kill bench
+            sys.stderr.write(f"[bench] hlo cost report failed: {exc!r}\n")
+            out["hlo_cost_error"] = repr(exc)[-300:]
     if cache_dir:
         # second runs against a warm cache report misses → 0 (or near it)
         out["compile_cache"] = {

@@ -183,6 +183,27 @@ def main():
 
     baseline = sps("1", 1, False)
     best = sps("16", 16, True)
+
+    # observability cost check (ISSUE 7 acceptance: disabled tracing must
+    # not move steps/sec): re-run the headline config with span recording ON
+    # — per-dispatch ring-buffer spans — and report the throughput delta
+    from paddle_tpu.obs import trace as obs_trace
+
+    spans0 = obs_trace.TRACER.recorded
+    obs_trace.enable_tracing(True)
+    try:
+        traced = run_config(args, batches, guard="16", k=16, async_ckpt=True)
+    finally:
+        obs_trace.enable_tracing(False)
+    tracing = {
+        "config": "guard_check_every=16, K=16, async ckpt, PADDLE_TPU_TRACE=1",
+        "steps_per_sec": traced["steps_per_sec"],
+        "vs_disabled": (
+            round(traced["steps_per_sec"] / best, 4) if best else 0.0
+        ),
+        "spans_recorded": obs_trace.TRACER.recorded - spans0,
+    }
+
     out = {
         "metric": "dispatch_runtime_speedup",
         "value": round(best / baseline, 3) if baseline and best else 0.0,
@@ -196,6 +217,7 @@ def main():
             "steps_per_sec": best,
         },
         "grid": results,
+        "tracing_enabled": tracing,
         "timer_split_instrumented": run_timer_split(args, batches),
         "batches_per_pass": args.batches,
         "timed_passes": args.passes,

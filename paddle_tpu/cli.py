@@ -14,6 +14,7 @@ Usage:
         [--guard_check_every=N] [--steps_per_dispatch=K] [--async_checkpoint=0|1]
         [--keep_last_n=N] [--faults=SPEC]
         [--master_endpoints=a:p1,b:p2] [--preempt_grace_s=S]
+        [--profile=pass:N] [--profile_dir=DIR]
     python -m paddle_tpu dump_config --config=conf.py
     python -m paddle_tpu merge_model --config=conf.py --model_dir=DIR --output=FILE
     python -m paddle_tpu serve [--port=N] [--demo | --load=model.npz]
@@ -132,6 +133,18 @@ def _train_args(p: argparse.ArgumentParser) -> None:
              "config's provider: 'host:port' or a failover list "
              "'a:p1,b:p2' (primary + standby); shards hold pickled "
              "provider-format samples",
+    )
+    p.add_argument(
+        "--profile", default=None, metavar="pass:N",
+        help="capture a jax.profiler trace of pass N and dump per-executable "
+             "HLO cost analysis (top-k FLOP/byte buckets) as profile.json — "
+             "the ROADMAP 'top-3 HLO cost buckets' target list. With "
+             "--job=time the buckets land in the printed JSON line instead",
+    )
+    p.add_argument(
+        "--profile_dir", default=None,
+        help="where the jax.profiler trace + profile.json go "
+             "(default: <save_dir>/profile, else /tmp/paddle_tpu_profile)",
     )
     p.add_argument(
         "--preempt_grace_s", type=float, default=30.0,
@@ -451,6 +464,26 @@ def cmd_train(args: argparse.Namespace) -> int:
         else None
     )
 
+    # --profile pass:N (obs pillar 3): validate the spec up front; the
+    # PassProfiler wraps the event handler to capture exactly that pass
+    profiler = None
+    profile_dir = None
+    if args.profile:
+        from paddle_tpu.obs import profile as obs_profile
+
+        profile_dir = args.profile_dir or (
+            os.path.join(args.save_dir, "profile")
+            if args.save_dir
+            else "/tmp/paddle_tpu_profile"
+        )
+        try:
+            profiler = obs_profile.PassProfiler.from_spec(
+                args.profile, logdir=profile_dir
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+
     if args.init_model_path:
         first = next(iter(reader() if reader else test_reader()))
         batch = feeder(first)
@@ -460,7 +493,10 @@ def cmd_train(args: argparse.Namespace) -> int:
         trainer.load(args.init_model_path, args.start_pass - 1 if args.start_pass else None)
 
     if args.job == "time":
-        return _job_time(trainer, reader, feeder, args.num_batches)
+        return _job_time(
+            trainer, reader, feeder, args.num_batches,
+            profile=args.profile, profile_dir=profile_dir,
+        )
     if args.job == "test":
         if test_reader is None:
             print("--job=test needs a test data source", file=sys.stderr)
@@ -555,6 +591,12 @@ def cmd_train(args: argparse.Namespace) -> int:
                 line += f" {k}={v}"
             print(line)
 
+    if profiler is not None:
+        handler = profiler.wrap(handler)
+    # the cost report lowers the step against one feed-ready batch; grab it
+    # from the PRE-prefetch reader so no worker thread outlives the report
+    profile_reader = reader
+
     if args.prefetch_depth > 0 and reader is not None:
         # run the feeder + batch sharding + H2D on a background thread so
         # host input prep overlaps the donated compiled step; with
@@ -598,11 +640,52 @@ def cmd_train(args: argparse.Namespace) -> int:
             f"continue", file=sys.stderr,
         )
         return preempt.EXIT_PREEMPTED
+
+    if profiler is not None:
+        from paddle_tpu.obs import profile as obs_profile
+
+        report = {
+            "profile": args.profile,
+            "trace_dir": profile_dir,
+            "captured": profiler.captured,
+        }
+        try:
+            raw = (
+                next(iter(profile_reader()), None)
+                if profile_reader is not None
+                else None
+            )
+            if raw is not None and trainer.state is not None:
+                batch = (
+                    feeder(raw)
+                    if feeder is not None and not isinstance(raw, dict)
+                    else raw
+                )
+                if parallel is not None:
+                    batch = parallel.shard_batch(batch)
+                report.update(obs_profile.trainer_cost_report(trainer, batch))
+        except Exception as e:  # the report must not fail a finished run
+            import logging
+
+            logging.getLogger("paddle_tpu.cli").warning(
+                "HLO cost report failed: %r", e
+            )
+            report["error"] = repr(e)[-400:]
+        path = obs_profile.write_report(
+            report, os.path.join(profile_dir, "profile.json")
+        )
+        print(json.dumps({"profile_json": path,
+                          "trace_dir": profile_dir if profiler.captured else None}))
     return 0
 
 
-def _job_time(trainer, reader, feeder, num_batches: int) -> int:
-    """--job=time (TrainerBenchmark.cpp): time num_batches hot-loop batches."""
+def _job_time(
+    trainer, reader, feeder, num_batches: int,
+    profile: Optional[str] = None, profile_dir: Optional[str] = None,
+) -> int:
+    """--job=time (TrainerBenchmark.cpp): time num_batches hot-loop batches.
+    With --profile, the timed window is captured as a jax.profiler trace and
+    the step's top-k HLO cost buckets join the printed bench JSON line."""
     import jax
 
     it = iter(reader())
@@ -620,14 +703,37 @@ def _job_time(trainer, reader, feeder, num_batches: int) -> int:
     trainer.init_state(batches[0])
     step = trainer._make_step()
     state = trainer.state
+    lowered = None
+    if profile:
+        # lower BEFORE the donated executions below delete the state buffers;
+        # AOT compile for the cost report happens after timing
+        lowered = step.lower(state, batches[0])
     state, cost, _ = step(state, batches[0])  # compile
     jax.block_until_ready(cost)
+    if profile:
+        from paddle_tpu.core import stats as _stats
+
+        _stats.profiler_start(profile_dir or "/tmp/paddle_tpu_profile")
     t0 = time.time()
     for b in batches:
         state, cost, _ = step(state, b)
     jax.block_until_ready(cost)
     dt = (time.time() - t0) / len(batches)
-    print(json.dumps({"ms_per_batch": dt * 1e3, "batches": len(batches)}))
+    out = {"ms_per_batch": dt * 1e3, "batches": len(batches)}
+    if profile:
+        from paddle_tpu.core import stats as _stats
+        from paddle_tpu.obs import profile as obs_profile
+
+        _stats.profiler_stop()
+        out["trace_dir"] = profile_dir or "/tmp/paddle_tpu_profile"
+        try:
+            out["hlo_cost"] = obs_profile.compiled_cost_report(
+                lowered.compile()
+            )
+        except Exception as e:  # the timing line must survive a backend
+            # that cannot cost-analyze (bench.py's discipline)
+            out["hlo_cost_error"] = repr(e)[-300:]
+    print(json.dumps(out))
     return 0
 
 
@@ -682,6 +788,12 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
                         "queued requests cancelled")
     p.add_argument("--require_register", type=_str2bool, default=False,
                    help="reject requests without a registered tenant lease")
+    p.add_argument(
+        "--master_endpoints", default=None,
+        help="routing master to health-check: its snapshot_failures / lease "
+             "evictions / live+evicted trainer counts are forwarded in this "
+             "server's stats() so deployments see control-plane degradation",
+    )
     # demo model shape knobs (ignored with --load)
     p.add_argument("--vocab", type=int, default=128)
     p.add_argument("--n_layers", type=int, default=2)
@@ -771,6 +883,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         session=session, gen_session=gen_session,
         host=args.host, port=args.port, lease_s=args.lease_s,
         require_register=args.require_register,
+        master_endpoints=args.master_endpoints,
     ).start()
     stop_evt = threading.Event()
     _signal.signal(_signal.SIGTERM, lambda *_: stop_evt.set())

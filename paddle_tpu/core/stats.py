@@ -65,10 +65,17 @@ class StatSet:
             self._stats.clear()
 
     def report(self) -> str:
+        # deterministic order (total desc, then name) and a percent-of-total
+        # column, so timer splits are diffable across bench runs — equal
+        # totals no longer land in dict-insertion order
         with self._lock:
-            stats = sorted(self._stats.values(), key=lambda s: -s.total)
+            stats = sorted(self._stats.values(), key=lambda s: (-s.total, s.name))
+        grand = sum(s.total for s in stats)
         lines = ["======= StatSet: [GlobalStatInfo] status ======"]
-        lines += [f"  {s!r}" for s in stats]
+        lines += [
+            f"  {s!r} ({100.0 * s.total / grand if grand else 0.0:5.1f}%)"
+            for s in stats
+        ]
         return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, Dict[str, float]]:
@@ -86,15 +93,27 @@ def enable_timers(on: bool = True) -> None:
     GLOBAL_STATS.enabled = on
 
 
+# every NAMED EventCounter registers here so the observability plane
+# (paddle_tpu/obs/metrics.py) can absorb them behind one read interface
+# without touching their hot-path increment cost
+EVENT_COUNTERS: Dict[str, "EventCounter"] = {}
+
+
 class EventCounter:
     """Thread-safe named counters for rare-but-load-bearing runtime events
     (divergence guard trips, feeder retries, pipeline stalls, master
     reconnects). Unlike Stat these are unconditional — failure telemetry must
-    not hide behind PADDLE_TPU_TIMER."""
+    not hide behind PADDLE_TPU_TIMER.
 
-    def __init__(self):
+    A `name` registers the counter group in EVENT_COUNTERS for the metrics
+    exporter; anonymous counters stay private."""
+
+    def __init__(self, name: Optional[str] = None):
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {}
+        self.name = name
+        if name:
+            EVENT_COUNTERS[name] = self
 
     def incr(self, name: str, n: int = 1) -> int:
         with self._lock:
@@ -119,12 +138,12 @@ class EventCounter:
 # guard_check_every window — pipeline retries/stalls, master client
 # reconnects/failovers, trainer-lease evictions, lost task acks, preemption
 # drains, standby takeovers)
-FT_EVENTS = EventCounter()
+FT_EVENTS = EventCounter("ft")
 
 # data-path events that are normal but worth counting: `padded_batches`
 # (trailing batches padded to the mesh data-axis multiple instead of
 # dropped — trainer + DevicePrefetcher increment it per padded batch)
-DATA_EVENTS = EventCounter()
+DATA_EVENTS = EventCounter("data")
 
 
 # -- memory / collective byte accounting (ISSUE 5 observability) -------------
@@ -321,18 +340,51 @@ class TimerOnce:
 
 
 # -- device profiler (hl_profiler_start/end → jax.profiler) -----------------
+#
+# Idempotent on purpose: jax.profiler raises RuntimeError on a second
+# start_trace and on stop without start; a double-wrapped event handler or a
+# crashed profiled pass must degrade to a warning, not kill training.
+
+_profiler_active = False
 
 
 def profiler_start(logdir: str = "/tmp/paddle_tpu_profile") -> None:
+    """Start a jax.profiler trace. A second start while one is active warns
+    and no-ops instead of propagating jax's "already started" RuntimeError."""
+    global _profiler_active
+    import logging
+
     import jax
 
-    jax.profiler.start_trace(logdir)
+    if _profiler_active:
+        logging.getLogger("paddle_tpu.stats").warning(
+            "profiler_start: a trace is already active — ignoring the "
+            "second start (stop the first with profiler_stop())"
+        )
+        return
+    try:
+        jax.profiler.start_trace(logdir)
+    except RuntimeError as e:
+        # started outside our bookkeeping (e.g. by user code calling jax
+        # directly); adopt it so profiler_stop() still works
+        logging.getLogger("paddle_tpu.stats").warning(
+            "profiler_start: jax reports a trace already running (%s); "
+            "adopting it", e,
+        )
+    _profiler_active = True
 
 
 def profiler_stop() -> None:
+    """Stop the active trace; a stop without a start is a silent no-op."""
+    global _profiler_active
     import jax
 
-    jax.profiler.stop_trace()
+    if not _profiler_active:
+        return
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        _profiler_active = False
 
 
 @contextlib.contextmanager

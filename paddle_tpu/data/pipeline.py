@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from paddle_tpu.core import faults, stats
+from paddle_tpu.obs import trace
 
 log = logging.getLogger("paddle_tpu.pipeline")
 
@@ -246,25 +247,29 @@ class DevicePrefetcher:
         return iter(self)
 
     def _feed(self, raw: Any) -> Dict[str, Any]:
-        """Raw reader item → feed-ready host batch (the hostFeed leg)."""
-        with stats.timer("hostFeed"):
-            return (
-                self.feeder(raw)
-                if self.feeder is not None and not isinstance(raw, dict)
-                else coerce_batch(raw)
-            )
+        """Raw reader item → feed-ready host batch (the hostFeed leg).
+        Span + timer stamp the same interval: the timer aggregates, the span
+        shows THIS batch's feed on the worker-thread row of the trace."""
+        with trace.span("pipeline.hostFeed"):
+            with stats.timer("hostFeed"):
+                return (
+                    self.feeder(raw)
+                    if self.feeder is not None and not isinstance(raw, dict)
+                    else coerce_batch(raw)
+                )
 
     def _device_put(self, batch: Dict[str, Any], stacked: bool = False) -> Any:
         """Feed-ready batch → device-resident batch (the h2d leg). stacked
         places a [K, B, ...] group with the scan-axis sharding; the chaos
         sleep fires once per call either way = once per dispatch."""
         faults.get().sleep("h2d_delay")  # chaos hook: slow transfer leg
-        if self.parallel is not None:
-            put = self.parallel.shard_batches if stacked else self.parallel.shard_batch
-            return put(batch)
-        if self.device is not None:
-            return {k: jax.device_put(v, self.device) for k, v in batch.items()}
-        return {k: jax.device_put(v) for k, v in batch.items()}
+        with trace.span("pipeline.h2d", stacked=stacked):
+            if self.parallel is not None:
+                put = self.parallel.shard_batches if stacked else self.parallel.shard_batch
+                return put(batch)
+            if self.device is not None:
+                return {k: jax.device_put(v, self.device) for k, v in batch.items()}
+            return {k: jax.device_put(v) for k, v in batch.items()}
 
     def _prepare(self, raw: Any) -> Any:
         """Raw reader item → device-resident batch (SKIP = drop)."""

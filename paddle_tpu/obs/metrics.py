@@ -1,0 +1,358 @@
+"""Fleet metrics: one registry over every counter the runtime already keeps.
+
+The repo grew its telemetry organically — `StatSet` timers, the
+`FT_EVENTS`/`DATA_EVENTS`/`SERVING_EVENTS` EventCounters, `RecompileStats`,
+ad-hoc `stats()` dicts on the master/allocator/serving server. This module
+puts ONE read path over all of them:
+
+  * `MetricsRegistry` — counter / gauge / histogram primitives for new
+    instrumentation, plus `register_collector()` hooks that absorb the
+    existing stats objects without moving them (they self-register via
+    `stats.EVENT_COUNTERS`; their hot-path increment cost is unchanged).
+  * `snapshot()` — a flat {dotted.name: value} dict, small enough to
+    piggyback on a master heartbeat; `FleetMetrics` aggregates the
+    per-trainer snapshots server-side so `MasterServer.stats()` answers for
+    the whole fleet, not one process.
+  * `to_prometheus_text()` — the standard exposition format, served by the
+    `metrics` RPC on the master and serving servers and by
+    `python -m paddle_tpu.obs export`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Mapping, NamedTuple, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "FleetMetrics",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "Sample",
+    "aggregate_snapshots",
+    "snapshot",
+    "to_prometheus_text",
+]
+
+
+class Sample(NamedTuple):
+    name: str
+    mtype: str  # counter | gauge | histogram-derived
+    value: float
+    labels: Tuple[Tuple[str, str], ...] = ()
+
+
+def _labels(kw: Mapping[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in kw.items()))
+
+
+class Counter:
+    """Monotonic counter; one value per label set."""
+
+    mtype = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._lock = threading.Lock()
+        self._vals: Dict[tuple, float] = {}
+
+    def inc(self, n: float = 1.0, **labels: Any) -> None:
+        key = _labels(labels)
+        with self._lock:
+            self._vals[key] = self._vals.get(key, 0.0) + n
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._vals.get(_labels(labels), 0.0)
+
+    def samples(self) -> Iterable[Sample]:
+        with self._lock:
+            items = list(self._vals.items())
+        for key, v in items or [((), 0.0)]:
+            yield Sample(self.name, self.mtype, v, key)
+
+
+class Gauge(Counter):
+    """Last-write-wins value; `set()` replaces, `inc()` still adjusts."""
+
+    mtype = "gauge"
+
+    def set(self, v: float, **labels: Any) -> None:
+        with self._lock:
+            self._vals[_labels(labels)] = float(v)
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative counts, Prometheus convention)."""
+
+    mtype = "histogram"
+    DEFAULT_BUCKETS = (
+        0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    )
+
+    def __init__(self, name: str, help: str = "", buckets: Optional[Iterable[float]] = None):
+        self.name, self.help = name, help
+        self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def samples(self) -> Iterable[Sample]:
+        with self._lock:
+            counts, total, n = list(self._counts), self._sum, self._n
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            yield Sample(
+                f"{self.name}_bucket", "counter", float(cum), (("le", repr(b)),)
+            )
+        yield Sample(f"{self.name}_bucket", "counter", float(n), (("le", "+Inf"),))
+        yield Sample(f"{self.name}_sum", "counter", total)
+        yield Sample(f"{self.name}_count", "counter", float(n))
+
+
+class MetricsRegistry:
+    """Named metrics + pluggable collectors over pre-existing stats objects."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Any] = {}
+        self._collectors: List[Callable[[], Iterable[Sample]]] = []
+
+    def _get(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "", buckets=None) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def register_collector(self, fn: Callable[[], Iterable[Sample]]) -> None:
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def collect(self) -> List[Sample]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+            collectors = list(self._collectors)
+        out: List[Sample] = []
+        for m in metrics:
+            out.extend(m.samples())
+        for fn in collectors:
+            out.extend(fn())
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+def _stats_collector() -> Iterable[Sample]:
+    """Absorb core/stats.py state: every registered EventCounter group, the
+    StatSet timers, and the recompile/compile-cache telemetry."""
+    from paddle_tpu.core import stats
+
+    for group, ec in stats.EVENT_COUNTERS.items():
+        for event, n in sorted(ec.as_dict().items()):
+            yield Sample(
+                "paddle_tpu_events_total", "counter", float(n),
+                (("event", event), ("group", group)),
+            )
+    for name, d in sorted(stats.GLOBAL_STATS.as_dict().items()):
+        yield Sample(
+            "paddle_tpu_timer_ms_total", "counter", float(d["total_ms"]),
+            (("name", name),),
+        )
+        yield Sample(
+            "paddle_tpu_timer_calls_total", "counter", float(d["count"]),
+            (("name", name),),
+        )
+    rc = stats.RECOMPILES
+    yield Sample(
+        "paddle_tpu_shape_signatures", "gauge", float(rc.total_signatures())
+    )
+    yield Sample(
+        "paddle_tpu_compile_cache_hits_total", "counter", float(rc.cache_hits)
+    )
+    yield Sample(
+        "paddle_tpu_compile_cache_misses_total", "counter",
+        float(rc.cache_misses),
+    )
+
+
+def _trace_collector() -> Iterable[Sample]:
+    from paddle_tpu.obs import trace
+
+    yield Sample(
+        "paddle_tpu_trace_spans_recorded_total", "counter",
+        float(trace.TRACER.recorded),
+    )
+    yield Sample(
+        "paddle_tpu_trace_spans_dropped_total", "counter",
+        float(trace.TRACER.dropped),
+    )
+
+
+REGISTRY = MetricsRegistry()
+REGISTRY.register_collector(_stats_collector)
+REGISTRY.register_collector(_trace_collector)
+
+
+# -- heartbeat snapshots + fleet aggregation ---------------------------------
+
+
+def _flat_key(s: Sample) -> str:
+    if not s.labels:
+        return s.name
+    return s.name + "{" + ",".join(f"{k}={v}" for k, v in s.labels) + "}"
+
+
+def snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, float]:
+    """Flat {key: value} view of every sample — the payload a trainer
+    piggybacks on its master heartbeat (a few hundred bytes of line-JSON)."""
+    return {
+        _flat_key(s): s.value for s in (registry or REGISTRY).collect()
+    }
+
+
+def aggregate_snapshots(snaps: Iterable[Mapping[str, float]]) -> Dict[str, float]:
+    """Sum per-trainer snapshots key-by-key. Counters sum exactly; summed
+    gauges read as fleet totals (per-trainer values stay visible in the raw
+    snapshots a caller can keep)."""
+    out: Dict[str, float] = {}
+    for snap in snaps:
+        for k, v in snap.items():
+            try:
+                out[k] = out.get(k, 0.0) + float(v)
+            except (TypeError, ValueError):
+                continue  # a garbled value must not poison the aggregate
+    return out
+
+
+class FleetMetrics:
+    """Server-side store of per-trainer heartbeat snapshots (master plane).
+
+    Entries expire after `ttl_s` without a fresh heartbeat (a dead trainer's
+    last numbers must not inflate the fleet forever) and are dropped eagerly
+    on deregister/eviction alongside the membership lease."""
+
+    def __init__(self, ttl_s: float = 60.0):
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._by_id: Dict[str, Tuple[float, Dict[str, float]]] = {}
+
+    def update(self, trainer_id: str, snap: Mapping[str, Any]) -> None:
+        if not trainer_id or not isinstance(snap, Mapping):
+            return
+        clean = {
+            str(k): float(v)
+            for k, v in snap.items()
+            if isinstance(v, (int, float))
+        }
+        with self._lock:
+            self._by_id[trainer_id] = (time.monotonic(), clean)
+
+    def drop(self, trainer_id: Optional[str]) -> None:
+        if not trainer_id:
+            return
+        with self._lock:
+            self._by_id.pop(trainer_id, None)
+
+    def aggregate(self) -> Dict[str, Any]:
+        cutoff = time.monotonic() - self.ttl_s
+        with self._lock:
+            live = {
+                tid: snap
+                for tid, (seen, snap) in self._by_id.items()
+                if seen >= cutoff
+            }
+        return {
+            "reporting_trainers": len(live),
+            "counters": aggregate_snapshots(live.values()),
+        }
+
+
+# -- Prometheus exposition ---------------------------------------------------
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _fmt(v: float) -> str:
+    """Exposition-format a sample value losslessly: %g truncates to 6
+    significant digits, which corrupts large counters (1234567 → 1.23457e+06
+    = 1234570) and breaks rate() over long-running servers. Integral values
+    print as integers, the rest with full float precision."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 2**53:
+        return str(int(f))
+    return repr(f)
+
+
+def to_prometheus_text(
+    registry: Optional[MetricsRegistry] = None,
+    fleet: Optional[Mapping[str, Any]] = None,
+    extra: Optional[Mapping[str, float]] = None,
+) -> str:
+    """Render the registry (plus an optional fleet aggregate and flat extra
+    gauges) in the Prometheus text exposition format."""
+    samples = (registry or REGISTRY).collect()
+    by_name: Dict[str, List[Sample]] = {}
+    types: Dict[str, str] = {}
+    for s in samples:
+        by_name.setdefault(s.name, []).append(s)
+        types.setdefault(s.name, "counter" if s.mtype == "counter" else s.mtype)
+    lines: List[str] = []
+    for name in sorted(by_name):
+        lines.append(f"# TYPE {name} {types[name]}")
+        for s in by_name[name]:
+            if s.labels:
+                lab = ",".join(f'{k}="{_escape(v)}"' for k, v in s.labels)
+                lines.append(f"{name}{{{lab}}} {_fmt(s.value)}")
+            else:
+                lines.append(f"{name} {_fmt(s.value)}")
+    if extra:
+        for k, v in sorted(extra.items()):
+            lines.append(f"# TYPE {k} gauge")
+            lines.append(f"{k} {_fmt(v)}")
+    if fleet:
+        n = int(fleet.get("reporting_trainers", 0) or 0)
+        lines.append("# TYPE paddle_tpu_fleet_reporting_trainers gauge")
+        lines.append(f"paddle_tpu_fleet_reporting_trainers {n}")
+        counters = fleet.get("counters") or {}
+        if counters:
+            lines.append("# TYPE paddle_tpu_fleet gauge")
+            for k, v in sorted(counters.items()):
+                lines.append(
+                    f'paddle_tpu_fleet{{key="{_escape(str(k))}"}} '
+                    f"{_fmt(v)}"
+                )
+    return "\n".join(lines) + "\n"

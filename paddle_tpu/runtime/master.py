@@ -40,6 +40,8 @@ import uuid
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple, Union
 
 from paddle_tpu.core import faults, stats
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs import trace as obs_trace
 from paddle_tpu.runtime import native
 from paddle_tpu.runtime import recordio
 
@@ -320,96 +322,127 @@ class _SnapshotPolicy:
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         ms: MasterServer = self.server.ctx  # type: ignore[attr-defined]
-        master = ms.master
-        lock = ms.master_lock
         for line in self.rfile:
             try:
                 req = json.loads(line)
             except json.JSONDecodeError:
                 self._reply({"err": "bad json"})
                 continue
-            method = req.get("method")
-            if faults.get().fire("master_drop"):
-                # chaos hook: the RPC vanishes in transit — drop the
-                # connection without processing or replying; the client's
-                # reconnect/backoff path has to absorb it
+            # span per RPC, adopting the caller's piggybacked trace context
+            # (`_trace` on the line-JSON frame) so a task's or request's
+            # spans stitch client → master under one trace id
+            with obs_trace.server_span(
+                "rpc." + str(req.get("method")), req.get("_trace"),
+                side="server",
+            ):
+                keep = self._handle_one(ms, req)
+            if not keep:
                 return
-            if faults.get().fire("master_kill"):
-                # chaos hook: the master process dies mid-RPC — no reply, no
-                # final snapshot, every open connection severed; only a
-                # standby restoring the last on-disk snapshot saves the pass
-                log.warning("chaos: master_kill fired — dying without reply")
-                ms.kill()
-                return
-            trainer_id = req.get("trainer_id")
-            ms.membership.note_seen(trainer_id)
-            # (expired leases are swept by the reaper thread every lease_s/4 —
-            # that bound IS the eager-requeue guarantee; scanning again per
-            # RPC would only add membership-lock traffic to the hot path)
-            # membership RPCs never touch the native queue — answered outside
-            # master_lock (drop_trainer takes it itself for the re-queue)
-            if method == "register":
-                self._reply({
-                    "trainer_id": ms.membership.register(),
-                    "lease_s": ms.membership.lease_s,
-                })
-                continue
-            if method == "heartbeat":
-                # note_seen above already renewed (or adopted) the lease
-                self._reply({"ok": bool(trainer_id)})
-                continue
-            if method == "deregister":
-                self._reply({"ok": ms.drop_trainer(trainer_id, evict=False)})
-                continue
-            snapshot_due = False
-            with lock:
-                if master.closed:  # killed under us — sever like a crash
-                    return
-                if method == "get_task":
-                    got = master.get_task()
-                    if got is None:
-                        resp = {"retry": True}
-                    elif got[0] == TaskMaster.PASS_FINISHED:
-                        resp = {"pass_finished": True}
-                    else:
-                        resp = {"task_id": got[0], "shards": got[1]}
-                        ms.membership.own(trainer_id, got[0])
-                elif method == "task_finished":
-                    tid = int(req["task_id"])
-                    ok = master.task_finished(tid)
-                    ms.membership.release(tid)
-                    resp = {"ok": ok}
-                    if ok and ms.snap is not None:
-                        snapshot_due = ms.snap.note_ack()
-                elif method == "task_failed":
-                    tid = int(req["task_id"])
-                    ok = master.task_failed(tid)
-                    ms.membership.release(tid)
-                    resp = {"ok": ok}
-                elif method == "set_dataset":
-                    master.set_dataset(
-                        req["shards"], int(req.get("chunks_per_task", 1))
-                    )
-                    resp = {"ok": True}
-                elif method == "pass_finished":
-                    resp = {
-                        "finished": master.pass_finished(
-                            bool(req.get("start_next", False))
-                        )
-                    }
-                elif method == "stats":
-                    resp = master.stats()
-                    resp["snapshot_failures"] = ms.snapshot_failures
-                    resp["live_trainers"] = ms.membership.live
-                    resp["evicted_trainers"] = ms.membership.evicted
+
+    def _handle_one(self, ms: "MasterServer", req: dict) -> bool:
+        """Process one request line; False severs the connection (chaos
+        sites, master killed under us)."""
+        master = ms.master
+        lock = ms.master_lock
+        method = req.get("method")
+        if faults.get().fire("master_drop"):
+            # chaos hook: the RPC vanishes in transit — drop the
+            # connection without processing or replying; the client's
+            # reconnect/backoff path has to absorb it
+            return False
+        if faults.get().fire("master_kill"):
+            # chaos hook: the master process dies mid-RPC — no reply, no
+            # final snapshot, every open connection severed; only a
+            # standby restoring the last on-disk snapshot saves the pass
+            log.warning("chaos: master_kill fired — dying without reply")
+            ms.kill()
+            return False
+        trainer_id = req.get("trainer_id")
+        ms.membership.note_seen(trainer_id)
+        # (expired leases are swept by the reaper thread every lease_s/4 —
+        # that bound IS the eager-requeue guarantee; scanning again per
+        # RPC would only add membership-lock traffic to the hot path)
+        # membership + observability RPCs never touch the native queue —
+        # answered outside master_lock (drop_trainer takes it itself)
+        if method == "register":
+            self._reply({
+                "trainer_id": ms.membership.register(),
+                "lease_s": ms.membership.lease_s,
+            })
+            return True
+        if method == "heartbeat":
+            # note_seen above already renewed (or adopted) the lease; a
+            # piggybacked metrics snapshot joins the fleet aggregate
+            if trainer_id and "metrics" in req:
+                ms.fleet.update(trainer_id, req["metrics"])
+            self._reply({"ok": bool(trainer_id)})
+            return True
+        if method == "deregister":
+            self._reply({"ok": ms.drop_trainer(trainer_id, evict=False)})
+            return True
+        if method == "metrics":
+            fleet = ms.fleet.aggregate()
+            self._reply({
+                "text": obs_metrics.to_prometheus_text(fleet=fleet),
+                "fleet": fleet,
+            })
+            return True
+        if method == "trace_export":
+            self._reply({"chrome_trace": obs_trace.export_chrome()})
+            return True
+        snapshot_due = False
+        with lock:
+            if master.closed:  # killed under us — sever like a crash
+                return False
+            if method == "get_task":
+                got = master.get_task()
+                if got is None:
+                    resp = {"retry": True}
+                elif got[0] == TaskMaster.PASS_FINISHED:
+                    resp = {"pass_finished": True}
                 else:
-                    resp = {"err": f"unknown method {method!r}"}
-            if snapshot_due:
-                # the write happens OUTSIDE master_lock: other trainers keep
-                # getting tasks while this thread does file I/O (the native
-                # snapshot takes its own internal mutex for a consistent view)
-                ms.snap.write(master)
-            self._reply(resp)
+                    resp = {"task_id": got[0], "shards": got[1]}
+                    ms.membership.own(trainer_id, got[0])
+            elif method == "task_finished":
+                tid = int(req["task_id"])
+                ok = master.task_finished(tid)
+                ms.membership.release(tid)
+                resp = {"ok": ok}
+                if ok and ms.snap is not None:
+                    snapshot_due = ms.snap.note_ack()
+            elif method == "task_failed":
+                tid = int(req["task_id"])
+                ok = master.task_failed(tid)
+                ms.membership.release(tid)
+                resp = {"ok": ok}
+            elif method == "set_dataset":
+                master.set_dataset(
+                    req["shards"], int(req.get("chunks_per_task", 1))
+                )
+                resp = {"ok": True}
+            elif method == "pass_finished":
+                resp = {
+                    "finished": master.pass_finished(
+                        bool(req.get("start_next", False))
+                    )
+                }
+            elif method == "stats":
+                resp = master.stats()
+                resp["snapshot_failures"] = ms.snapshot_failures
+                resp["live_trainers"] = ms.membership.live
+                resp["evicted_trainers"] = ms.membership.evicted
+                # fleet-wide aggregate of the heartbeat metric snapshots:
+                # one stats() answers for every reporting trainer
+                resp["fleet"] = ms.fleet.aggregate()
+            else:
+                resp = {"err": f"unknown method {method!r}"}
+        if snapshot_due:
+            # the write happens OUTSIDE master_lock: other trainers keep
+            # getting tasks while this thread does file I/O (the native
+            # snapshot takes its own internal mutex for a consistent view)
+            ms.snap.write(master)
+        self._reply(resp)
+        return True
 
     def _reply(self, obj: Any) -> None:
         try:
@@ -442,6 +475,9 @@ class MasterServer:
         self.master = master or TaskMaster()
         self.master_lock = threading.Lock()
         self.membership = _Membership(lease_s)
+        # per-trainer heartbeat metric snapshots → fleet aggregate in stats();
+        # entries expire a few leases after the last heartbeat
+        self.fleet = obs_metrics.FleetMetrics(ttl_s=max(3.0 * lease_s, 30.0))
         self.snap = (
             _SnapshotPolicy(snapshot_path, snapshot_every, snapshot_interval_s)
             if snapshot_path
@@ -491,6 +527,7 @@ class MasterServer:
         if not tid:
             return False
         tasks = self.membership.drop(tid)
+        self.fleet.drop(tid)
         requeued = 0
         with self.master_lock:
             if not self.master.closed:
@@ -668,6 +705,12 @@ def _main(argv: Optional[List[str]] = None) -> int:
     common.add_argument("--failure_max", type=int, default=3)
     common.add_argument("--faults", default=None)
     common.add_argument("--faults_seed", type=int, default=0)
+    common.add_argument(
+        "--trace", type=int, default=0,
+        help="1 = record RPC spans into the ring buffer (also settable via "
+             "PADDLE_TPU_TRACE); fetch them with the trace_export RPC or "
+             "`python -m paddle_tpu.obs trace --endpoint host:port`",
+    )
     sub.add_parser("serve", parents=[common])
     st = sub.add_parser("standby", parents=[common])
     st.add_argument("--primary", required=True, help="host:port to watch")
@@ -677,6 +720,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
 
     if args.faults:
         faults.get().configure(args.faults, args.faults_seed)
+    if args.trace:
+        obs_trace.enable_tracing(True)
 
     def build() -> MasterServer:
         return MasterServer(
@@ -776,6 +821,16 @@ class MasterClient:
             log.warning("master failover: trying endpoint %s:%d", *self.address)
 
     def call(self, method: str, **kw) -> dict:
+        """One RPC (with reconnect/failover/backoff). With tracing enabled
+        the call runs inside a client span and piggybacks its context on the
+        frame (`_trace`), so the server's handler span joins this trace."""
+        if obs_trace.TRACER.enabled:
+            with obs_trace.span("rpc." + method, side="client") as sp:
+                kw["_trace"] = {"t": sp.trace_id, "s": sp.span_id}
+                return self._call(method, kw)
+        return self._call(method, kw)
+
+    def _call(self, method: str, kw: dict) -> dict:
         last_err: Optional[Exception] = None
         for attempt in range(self.retries):
             try:
@@ -850,7 +905,12 @@ class _Heartbeater:
             if tid is None:
                 continue
             try:
-                self._client.call("heartbeat", trainer_id=tid)
+                # metrics snapshot piggybacks on the lease renewal — the
+                # master aggregates these into its fleet-wide stats() view
+                self._client.call(
+                    "heartbeat", trainer_id=tid,
+                    metrics=obs_metrics.snapshot(),
+                )
             except ConnectionError:
                 # terminal after retries+failover — the lease will lapse and
                 # the master re-queues our tasks; the reader's own calls will

@@ -24,8 +24,16 @@ import threading
 import time
 from typing import Deque, List, Optional, Sequence, Tuple
 
+from paddle_tpu.obs import metrics as obs_metrics
 from paddle_tpu.serving.kv_cache import PagedKVCache
 from paddle_tpu.serving.quota import QuotaExceeded, TenantQuotas
+
+# end-to-end request latency (submit → done), observed at retirement —
+# unconditional telemetry, exported via the `metrics` RPC / obs export CLI
+REQUEST_HISTOGRAM = obs_metrics.REGISTRY.histogram(
+    "paddle_tpu_serving_request_seconds",
+    "submit → completion, per retired request",
+)
 
 
 class FinishReason:
@@ -58,6 +66,10 @@ class RequestHandle:
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
+        # trace context ({"t": trace_id, "s": span_id}) captured at submit
+        # time (ServingSession.submit) so engine-thread spans — queue-wait,
+        # prefill, ttft — stitch under the submitting RPC's trace id
+        self.trace_ctx: Optional[dict] = None
         self._event = threading.Event()
 
     @property
@@ -143,10 +155,17 @@ class Scheduler:
 
     # -- intake -------------------------------------------------------------
     def submit(
-        self, prompt: Sequence[int], max_new_tokens: int, tenant: str
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        tenant: str,
+        trace_ctx: Optional[dict] = None,
     ) -> RequestHandle:
         """Admission control happens HERE, synchronously: the caller learns
-        'no' at the front door, not by timing out in a silent queue."""
+        'no' at the front door, not by timing out in a silent queue.
+        trace_ctx must ride in (not be set on the returned handle after):
+        the engine thread can pop the request the instant it is queued, so
+        the context has to be on the handle BEFORE it becomes visible."""
         prompt = [int(t) for t in prompt]
         with self.lock:
             if len(self.waiting) >= self.max_queue:
@@ -163,6 +182,7 @@ class Scheduler:
             handle = RequestHandle(
                 next(self._ids), tenant, len(prompt), max_new_tokens
             )
+            handle.trace_ctx = trace_ctx
             self.waiting.append(_Waiting(handle, prompt))
             return handle
 
@@ -201,6 +221,7 @@ class Scheduler:
             unused = act.handle.max_new_tokens - act.generated
             self.quotas.release(act.handle.tenant, max(0, unused))
         act.handle._complete(RequestHandle.DONE, reason)
+        REQUEST_HISTOGRAM.observe(act.handle.t_done - act.handle.t_submit)
 
     def cancel_tenant(self, tenant: str) -> int:
         """Drop a (evicted/deregistered) tenant's QUEUED requests; running

@@ -34,6 +34,8 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from paddle_tpu.core import stats
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs import trace as obs_trace
 from paddle_tpu.runtime.master import (
     EndpointsLike,
     MasterClient,
@@ -58,7 +60,15 @@ class _Handler(socketserver.StreamRequestHandler):
             tenant_id = req.get("tenant_id")
             srv.membership.note_seen(tenant_id)
             try:
-                resp = srv.dispatch(req.get("method"), req, tenant_id)
+                # handler span adopts the client's piggybacked trace context
+                # (ServingClient rides on MasterClient, which injects
+                # `_trace`) — and is itself the parent the session's
+                # queue-wait/prefill/ttft spans stitch under
+                with obs_trace.server_span(
+                    "rpc." + str(req.get("method")), req.get("_trace"),
+                    side="server",
+                ):
+                    resp = srv.dispatch(req.get("method"), req, tenant_id)
             except QuotaExceeded as e:
                 resp = {"err": str(e), "rejected": e.reason}
             except Exception as e:  # a bad request must not kill the server
@@ -88,11 +98,25 @@ class ServingServer:
         lease_s: float = 30.0,
         require_register: bool = False,
         handle_ttl_s: float = 600.0,
+        master_endpoints: Optional[EndpointsLike] = None,
     ):
         if session is None and gen_session is None:
             raise ValueError("need a ServingSession and/or a GenerationSession")
         self.session = session
         self.gen_session = gen_session
+        # control-plane visibility: with master_endpoints set, stats()
+        # forwards the routing master's health (snapshot failures, lease
+        # evictions, live/evicted trainers) so a serving deployment sees
+        # control-plane degradation from the same endpoint it already polls
+        self.master_endpoints = master_endpoints
+        self._master_client: Optional[MasterClient] = None
+        self._master_client_lock = threading.Lock()
+        # (monotonic, result) of the last probe: stats() calls are served
+        # concurrently (ThreadingTCPServer), and a DOWN master costs ~10s of
+        # retries per probe — at most one probe is ever in flight, everyone
+        # else reads the cached view instead of queueing behind the lock
+        self._master_health_cache: tuple = (0.0, None)
+        self._master_health_ttl_s = 2.0
         self.membership = _Membership(lease_s)
         self.require_register = require_register
         # ids THIS server minted via register: require_register must check
@@ -146,7 +170,13 @@ class ServingServer:
             out = dict(self.session.stats()) if self.session else {}
             out["live_tenants"] = self.membership.live
             out["evicted_tenants"] = self.membership.evicted
+            if self.master_endpoints is not None:
+                out["master"] = self._master_health()
             return out
+        if method == "metrics":
+            return {"text": obs_metrics.to_prometheus_text()}
+        if method == "trace_export":
+            return {"chrome_trace": obs_trace.export_chrome()}
         if method in ("submit", "generate"):
             if self.session is None:
                 return {
@@ -220,6 +250,50 @@ class ServingServer:
                 )
             return tenant_id
         return tenant_id or "default"
+
+    def _master_health(self) -> dict:
+        """The underlying routing master's control-plane health, forwarded
+        into stats(). Unreachability is itself the signal — reported, never
+        raised (a dead master must not take the serving stats down too).
+        TTL-cached, single probe in flight: concurrent stats() callers read
+        the last view instead of serializing behind a dead master's retries."""
+        import time as _time
+
+        ts, cached = self._master_health_cache
+        if cached is not None and _time.monotonic() - ts < self._master_health_ttl_s:
+            return cached
+        if not self._master_client_lock.acquire(blocking=False):
+            # another thread is probing right now — serve the stale view
+            if cached is not None:
+                return cached
+            return {"reachable": False, "error": "health probe in flight"}
+        try:
+            try:
+                if self._master_client is None:
+                    self._master_client = MasterClient(
+                        self.master_endpoints, timeout=5.0, retries=2,
+                    )
+                st = self._master_client.call("stats")
+            except (ConnectionError, OSError) as e:
+                out = {
+                    "reachable": False,
+                    "error": f"{type(e).__name__}: {e}"[-300:],
+                }
+            else:
+                out = {
+                    k: st[k]
+                    for k in (
+                        "snapshot_failures", "live_trainers",
+                        "evicted_trainers", "todo", "pending", "done",
+                        "discarded",
+                    )
+                    if k in st
+                }
+                out["reachable"] = True
+        finally:
+            self._master_client_lock.release()
+        self._master_health_cache = (_time.monotonic(), out)
+        return out
 
     def _forget_tenant(self, tid: str) -> int:
         """Drop a tenant's lease + minted id and cancel its queued work
@@ -319,6 +393,15 @@ class ServingServer:
         self._srv.server_close()
         if self._reaper is not None:
             self._reaper.join(timeout=5.0)
+        # non-blocking: an in-flight health probe (up to ~10s against a dead
+        # master) must not stall shutdown — its daemon thread's socket dies
+        # with the process
+        if self._master_client_lock.acquire(blocking=False):
+            try:
+                if self._master_client is not None:
+                    self._master_client.close()
+            finally:
+                self._master_client_lock.release()
         if self.session is not None:
             self.session.stop()
 
@@ -391,6 +474,17 @@ class ServingClient:
 
     def stats(self) -> dict:
         return self._client.call("stats", **self._id_kw())
+
+    def metrics(self) -> str:
+        """The server's Prometheus metrics text (the `metrics` RPC)."""
+        return self._client.call("metrics", **self._id_kw()).get("text", "")
+
+    def trace_export(self) -> dict:
+        """The server's span ring buffer as Chrome trace JSON — merge with
+        the local export via obs.trace.merge_chrome for one stitched view."""
+        return self._client.call(
+            "trace_export", **self._id_kw()
+        ).get("chrome_trace", {})
 
     def close(self) -> None:
         self._client.close()

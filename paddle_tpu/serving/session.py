@@ -32,14 +32,24 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from paddle_tpu.core import stats
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs import trace
 from paddle_tpu.serving.kv_cache import PagedKVCache
 from paddle_tpu.serving.model import LMConfig, ServableLM
 from paddle_tpu.serving.quota import TenantQuotas
 from paddle_tpu.serving.scheduler import RequestHandle, Scheduler
 
 # serving-side counters (sibling of stats.FT_EVENTS/DATA_EVENTS): admissions,
-# retirements, quota rejections, decode steps — unconditional telemetry
-SERVING_EVENTS = stats.EventCounter()
+# retirements, quota rejections, decode steps — unconditional telemetry;
+# the "serving" name registers the group with the obs metrics exporter
+SERVING_EVENTS = stats.EventCounter("serving")
+
+# time-to-first-token distribution (PADDLE_TPU_TRACE not required: histograms
+# are unconditional telemetry like the event counters above)
+TTFT_HISTOGRAM = obs_metrics.REGISTRY.histogram(
+    "paddle_tpu_serving_ttft_seconds",
+    "submit → first sampled token, per request",
+)
 
 
 def _bucket_for(buckets: Sequence[int], n: int) -> int:
@@ -136,7 +146,14 @@ class ServingSession:
                 f"request needs {need} KV pages; pool allows "
                 f"{min(self.cache.max_pages_per_seq, self.cache.num_pages - 1)}"
             )
-        handle = self.scheduler.submit(prompt, max_new, tenant)
+        # request trace context: the submitter's current span (the RPC
+        # handler's server span, or whatever the caller has open) — the
+        # engine thread's queue-wait/prefill/ttft spans stitch under it.
+        # Captured BEFORE submit: the engine can admit the request the
+        # moment it is queued, so a post-submit assignment would race
+        handle = self.scheduler.submit(
+            prompt, max_new, tenant, trace_ctx=trace.wire_context()
+        )
         SERVING_EVENTS.incr("serving_submitted")
         with self._work:
             self._work.notify()
@@ -148,19 +165,42 @@ class ServingSession:
         import jax.numpy as jnp
 
         for slot, act in self.scheduler.pop_admissions():
-            bucket = _bucket_for(self.buckets, len(act.prompt))
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, : len(act.prompt)] = act.prompt
-            lengths = np.array([len(act.prompt)], np.int32)
-            first_tok, kc, vc = self._prefill(self.params, toks, lengths)
-            rows = self.cache.block_table()[slot : slot + 1]
-            self.k_pages, self.v_pages = self._commit(
-                self.k_pages, self.v_pages, kc, vc,
-                jnp.asarray(lengths), jnp.asarray(rows),
+            h = act.handle
+            ctx = h.trace_ctx
+            # queue-wait: submit → this admission boundary, under the
+            # request's own trace id (measured on the scheduler's monotonic
+            # clock, re-anchored to wall-clock for the export)
+            trace.span_from_monotonic(
+                "serving.queue_wait", h.t_submit,
+                trace_id=ctx and ctx.get("t"), parent_id=ctx and ctx.get("s"),
+                attrs={"request_id": h.request_id},
             )
-            # one tiny host fetch per ADMISSION (not per decode step): the
-            # prompt's first sampled token — argmax happened on device
-            act.append(int(first_tok[0]))
+            bucket = _bucket_for(self.buckets, len(act.prompt))
+            with trace.activate(ctx):
+                with trace.span(
+                    "serving.prefill", request_id=h.request_id, bucket=bucket
+                ):
+                    toks = np.zeros((1, bucket), np.int32)
+                    toks[0, : len(act.prompt)] = act.prompt
+                    lengths = np.array([len(act.prompt)], np.int32)
+                    first_tok, kc, vc = self._prefill(self.params, toks, lengths)
+                    rows = self.cache.block_table()[slot : slot + 1]
+                    self.k_pages, self.v_pages = self._commit(
+                        self.k_pages, self.v_pages, kc, vc,
+                        jnp.asarray(lengths), jnp.asarray(rows),
+                    )
+                    # one tiny host fetch per ADMISSION (not per decode step):
+                    # the prompt's first sampled token — argmax on device
+                    act.append(int(first_tok[0]))
+            # time-to-first-token: prefill emits the first sampled token, so
+            # TTFT completes here — span under the request trace + histogram
+            ttft_s = (h.t_first_token or h.t_submit) - h.t_submit
+            TTFT_HISTOGRAM.observe(ttft_s)
+            trace.span_from_monotonic(
+                "serving.ttft", h.t_submit,
+                trace_id=ctx and ctx.get("t"), parent_id=ctx and ctx.get("s"),
+                attrs={"request_id": h.request_id},
+            )
             SERVING_EVENTS.incr("serving_prefills")
             reason = act.finished(self.cfg.eos_id)
             if reason is not None:
@@ -189,15 +229,19 @@ class ServingSession:
                  "block_table": bt}
             )
         )
-        self.k_pages, self.v_pages, next_tok = self._decode(
-            self.params, self.k_pages, self.v_pages,
-            tokens, positions, act_mask, bt,
-        )
-        # sync-ok: the ONE sanctioned fetch in the serving hot loop — the
-        # sampled token ids, which the autoregressive loop needs on host to
-        # detect EOS/budget and stream tokens; everything else stays device-
-        # resident (pages are donated through, logits never leave the device)
-        toks = np.asarray(next_tok)
+        # span-ok: ring-buffer write only, constant name, int attr — no file
+        # I/O or string formatting on the decode hot path; a no-op truth
+        # test when PADDLE_TPU_TRACE is off (tests/test_lint_hotloop.py)
+        with trace.span("serving.decode_step", active=len(active)):
+            self.k_pages, self.v_pages, next_tok = self._decode(
+                self.params, self.k_pages, self.v_pages,
+                tokens, positions, act_mask, bt,
+            )
+            # sync-ok: the ONE sanctioned fetch in the serving hot loop — the
+            # sampled token ids, which the autoregressive loop needs on host to
+            # detect EOS/budget and stream tokens; everything else stays device-
+            # resident (pages are donated through, logits never leave the device)
+            toks = np.asarray(next_tok)
         self.decode_steps += 1
         SERVING_EVENTS.incr("serving_decode_steps")
         for slot, act in active:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import faults, preempt, stats
+from paddle_tpu.obs import trace
 from paddle_tpu.data.pipeline import StackedBatch
 from paddle_tpu.data.pipeline import coerce_batch as _coerce_batch
 from paddle_tpu.data.pipeline import is_device_batch
@@ -608,17 +609,21 @@ class SGDTrainer:
             # (TrainerInternal.cpp:94-152); enable via PADDLE_TPU_TIMER.
             # Timing is opt-in, so when enabled we sync the device inside
             # the timer — otherwise it would measure only async dispatch.
-            with stats.timer("forwardBackward"):
-                if k == 1:
-                    self.state, cost, extras = self._step_fn(self.state, batch)
-                    costs = None
-                else:
-                    if self._multi_fn is None:
-                        self._multi_fn = self.make_multi_step()
-                    self.state, costs = self._multi_fn(self.state, batch)
-                    cost, extras = costs[-1], {}
-                if stats.GLOBAL_STATS.enabled:
-                    jax.block_until_ready(cost)  # sync-ok: opt-in timing only
+            # span-ok: one ring-buffer span per DISPATCH (constant name, int
+            # attrs, no formatting) — a no-op truth test when tracing is off;
+            # note it measures dispatch latency, not device time (no sync)
+            with trace.span("train.dispatch", first=idx_first, k=k):
+                with stats.timer("forwardBackward"):
+                    if k == 1:
+                        self.state, cost, extras = self._step_fn(self.state, batch)
+                        costs = None
+                    else:
+                        if self._multi_fn is None:
+                            self._multi_fn = self.make_multi_step()
+                        self.state, costs = self._multi_fn(self.state, batch)
+                        cost, extras = costs[-1], {}
+                    if stats.GLOBAL_STATS.enabled:
+                        jax.block_until_ready(cost)  # sync-ok: opt-in timing only
             # pass-cost accumulation never syncs: in guard mode the compiled
             # step itself accumulates state["cost_acc"] (with the divergence
             # revert masking poisoned entries), otherwise accumulate with one
@@ -823,6 +828,12 @@ class SGDTrainer:
                 metrics["peak_hbm_bytes"] = hbm["peak_bytes_in_use"]
         if stats.GLOBAL_STATS.enabled:
             log.info("pass %d %s", pass_id, stats.RECOMPILES.report())
+        # span-ok: whole-pass span recorded once at pass end (ring buffer
+        # write from already-measured wall-clock; no per-step work)
+        trace.record_span(
+            "train.pass", int(t0 * 1e6), time.time_ns() // 1000,
+            attrs={"pass": pass_id, "batches": n_batches},
+        )
         self.updater.finish_pass()
         if test_reader is not None:
             metrics["test_cost"] = self.test(test_reader, feeder)["cost"]
@@ -848,7 +859,8 @@ class SGDTrainer:
         poisoned update on device, so by the time the host learns about a
         window's divergences the state is clean — the reaction here is
         policy, not protection. Returns the number of new events."""
-        d = int(self.state["diverged"])  # sync-ok: the guard-poll site
+        with trace.span("train.guard_poll", batch=batch_id):
+            d = int(self.state["diverged"])  # sync-ok: the guard-poll site
         new = d - self._diverged_seen
         self._diverged_seen = d
         if new <= 0:
@@ -1038,46 +1050,50 @@ class SGDTrainer:
         checkpoint_wait(); train()/load()/the preempt drain invoke that
         barrier themselves."""
         assert self.state is not None
-        # checkpoints always store the optimizer's CANONICAL per-param layout:
-        # a ShardedUpdater gathers its flat [n, chunk] slot/EF shards back to
-        # parameter shapes here, so the same pass dir resumes under
-        # shard_update on or off (and across device counts) bitwise
-        opt_tree = {"opt": self.updater.to_canonical(self.state["opt"])}
-        if self.state["avg"]:
-            opt_tree["avg"] = self.state["avg"]
-        extra_meta = {
-            "samples": int(self.state["samples"]),
-            "lr_scale": float(self.state["lr_scale"]),
-        }
-        if mid_pass_batches is not None:
-            extra_meta["mid_pass"] = True
-            extra_meta["batches_done"] = int(mid_pass_batches)
-        if not async_:
-            return ckpt_mod.save_pass(
+        # the checkpoint span covers what the TRAINING THREAD pays: the full
+        # write when synchronous, only the D2H fetch + enqueue when async
+        with trace.span("train.checkpoint", pass_id=pass_id, is_async=async_):
+            # checkpoints always store the optimizer's CANONICAL per-param
+            # layout: a ShardedUpdater gathers its flat [n, chunk] slot/EF
+            # shards back to parameter shapes here, so the same pass dir
+            # resumes under shard_update on or off (and across device
+            # counts) bitwise
+            opt_tree = {"opt": self.updater.to_canonical(self.state["opt"])}
+            if self.state["avg"]:
+                opt_tree["avg"] = self.state["avg"]
+            extra_meta = {
+                "samples": int(self.state["samples"]),
+                "lr_scale": float(self.state["lr_scale"]),
+            }
+            if mid_pass_batches is not None:
+                extra_meta["mid_pass"] = True
+                extra_meta["batches_done"] = int(mid_pass_batches)
+            if not async_:
+                return ckpt_mod.save_pass(
+                    save_dir,
+                    pass_id,
+                    self.state["params"],
+                    self.state["states"],
+                    opt_tree,
+                    extra_meta=extra_meta,
+                    keep_last_n=keep_last_n,
+                )
+            if self._ckpt_writer is None:
+                self._ckpt_writer = ckpt_mod.AsyncCheckpointer()
+            with stats.timer("ckptFetch"):
+                params_np = _fetch_host_tree(self.state["params"])
+                states_np = _fetch_host_tree(self.state["states"])
+                opt_np = _fetch_host_tree(opt_tree)
+            return ckpt_mod.save_pass_async(
+                self._ckpt_writer,
                 save_dir,
                 pass_id,
-                self.state["params"],
-                self.state["states"],
-                opt_tree,
+                params_np,
+                states_np,
+                opt_np,
                 extra_meta=extra_meta,
                 keep_last_n=keep_last_n,
             )
-        if self._ckpt_writer is None:
-            self._ckpt_writer = ckpt_mod.AsyncCheckpointer()
-        with stats.timer("ckptFetch"):
-            params_np = _fetch_host_tree(self.state["params"])
-            states_np = _fetch_host_tree(self.state["states"])
-            opt_np = _fetch_host_tree(opt_tree)
-        return ckpt_mod.save_pass_async(
-            self._ckpt_writer,
-            save_dir,
-            pass_id,
-            params_np,
-            states_np,
-            opt_np,
-            extra_meta=extra_meta,
-            keep_last_n=keep_last_n,
-        )
 
     def checkpoint_wait(self) -> None:
         """Durability barrier for async saves: returns once no checkpoint

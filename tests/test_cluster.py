@@ -223,6 +223,65 @@ def test_trainer_lease_eviction_eagerly_requeues(tmp_path):
     server.stop()
 
 
+def test_fleet_metrics_aggregated_from_real_process_heartbeats(tmp_path):
+    """ISSUE 7 acceptance: a REAL master process aggregates the metric
+    snapshots riding on cluster_reader's heartbeats, and stats() answers
+    with the fleet-wide view while the trainer is mid-pass."""
+    nrec = 48
+    shards = recordio.convert(
+        str(tmp_path / "ds"), lambda: ({"sid": i} for i in range(nrec)),
+        records_per_file=4,
+    )
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.runtime.master", "serve",
+         "--port", str(port), "--lease_s", "1"],
+        env=_child_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        _wait_port(port)
+        boot = MasterClient(("127.0.0.1", port))
+        boot.call("set_dataset", shards=shards, chunks_per_task=1)
+        # guarantee a recognizable counter in this process's snapshot
+        stats.FT_EVENTS.incr("fleet_probe", 3)
+        consumed, errs = [], []
+
+        def consume():
+            try:
+                for s in cluster_reader(
+                    ("127.0.0.1", port), client_kw={"retries": 20}
+                )():
+                    consumed.append(s["sid"])
+                    time.sleep(0.05)  # stretch the pass past heartbeats
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        fleet = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = boot.call("stats")
+            fleet = st.get("fleet")
+            if fleet and fleet.get("reporting_trainers", 0) >= 1 and any(
+                "fleet_probe" in k for k in fleet.get("counters", {})
+            ):
+                break
+            time.sleep(0.1)
+        t.join(timeout=60)
+        assert not t.is_alive() and not errs, errs
+        assert fleet is not None and fleet["reporting_trainers"] >= 1, fleet
+        key = next(k for k in fleet["counters"] if "fleet_probe" in k)
+        assert fleet["counters"][key] >= 3.0
+        assert sorted(consumed) == list(range(nrec))
+        boot.close()
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+        proc.wait(timeout=15)
+
+
 def test_deregister_releases_lease_without_eviction():
     server = MasterServer(TaskMaster(), lease_s=30.0).start()
     try:

@@ -57,6 +57,31 @@ HOT_LOOPS = [
 TAG = "sync-ok"
 TAG_LOOKBACK = 6  # lines
 
+# -- span-recording sites (ISSUE 7 observability) ----------------------------
+#
+# Spans in the hot loops must go through the obs ring buffer (trace.span /
+# trace.record_span / trace.span_from_monotonic — a no-op truth test when
+# PADDLE_TPU_TRACE is off) and carry a `span-ok` tag naming the site; the
+# count is pinned so a new per-step span forces a review here. Two hard bans
+# ride along: no file I/O in a hot-loop body at all, and no string formatting
+# inside a span call's arguments (f-strings/%/.format evaluate at the call
+# site even when tracing is disabled — exactly the cost the gate exists to
+# avoid).
+SPAN_CALL = re.compile(
+    r"(?<![\w.])trace\.(?:span|record_span|span_from_monotonic)\("
+)
+SPAN_TAG = "span-ok"
+# (file, class, hot methods, max span-ok tags)
+SPAN_HOT_LOOPS = [
+    (TRAINER_PY, "SGDTrainer", ("train", "_train_one_pass"), 2),
+    (SERVING_PY, "ServingSession", ("_decode_once", "step"), 1),
+]
+HOT_IO_CALL = re.compile(r"(?<![\w.])open\(|\.write\(|json\.dump")
+SPAN_FMT = re.compile(
+    r"trace\.(?:span|record_span|span_from_monotonic)\("
+    r"[^\n]*(?:f\"|f'|\.format\(|% ?\()"
+)
+
 
 def _hot_spans(tree: ast.Module, class_name: str, methods):
     for node in ast.walk(tree):
@@ -69,7 +94,7 @@ def _hot_spans(tree: ast.Module, class_name: str, methods):
                     yield item.name, item.lineno, item.end_lineno
 
 
-def _scan(path, class_name, methods, pattern):
+def _scan(path, class_name, methods, pattern, tag=TAG):
     with open(path) as f:
         source = f.read()
     lines = source.splitlines()
@@ -81,14 +106,15 @@ def _scan(path, class_name, methods, pattern):
     for name, lo, hi in spans:
         for ln in range(lo, hi + 1):
             text = lines[ln - 1]
-            if TAG in text:
+            if tag is not None and tag in text:
                 tagged.append(ln)
             code = text.split("#", 1)[0]
             if not pattern.search(code):
                 continue
-            window = lines[max(0, ln - TAG_LOOKBACK):ln]
-            if any(TAG in w for w in window):
-                continue
+            if tag is not None:
+                window = lines[max(0, ln - TAG_LOOKBACK):ln]
+                if tag in text or any(tag in w for w in window):
+                    continue
             violations.append(f"{os.path.basename(path)}:{name}:{ln}: {text.strip()}")
     return violations, tagged
 
@@ -118,3 +144,52 @@ def test_sanctioned_sync_sites_stay_rare():
             f"{budget}): a new sanctioned sync site was added — confirm it "
             "is not per-step and bump this bound deliberately"
         )
+
+
+def test_span_sites_in_hot_loops_tagged_and_pinned():
+    """Span recording inside the train / serving-decode hot loops must go
+    through the obs ring-buffer API and carry a `span-ok` tag; the tag count
+    is pinned so a new per-step span site forces a review here."""
+    for path, cls, methods, budget in SPAN_HOT_LOOPS:
+        violations, tagged = _scan(path, cls, methods, SPAN_CALL, tag=SPAN_TAG)
+        assert not violations, (
+            "span-recording call(s) in a hot-loop body without a `span-ok` "
+            "tag — every hot-loop span must be a gated ring-buffer write "
+            "(obs/trace.py) and name its justification:\n  "
+            + "\n  ".join(violations)
+        )
+        assert len(tagged) <= budget, (
+            f"{len(tagged)} span-ok tags in the {cls} hot loop (expected <= "
+            f"{budget}): a new sanctioned span site was added — confirm it "
+            "records per-dispatch (not per-step work beyond a ring write) "
+            "and bump this bound deliberately"
+        )
+
+
+def test_no_file_io_in_hot_loops():
+    """No open()/.write()/json.dump in any hot-loop body, tagged or not —
+    span export and metric scraping happen OUTSIDE the loops (export_chrome,
+    the metrics/trace_export RPCs)."""
+    violations = []
+    for path, cls, methods, _budget in SPAN_HOT_LOOPS:
+        v, _ = _scan(path, cls, methods, HOT_IO_CALL, tag=None)
+        violations += v
+    assert not violations, (
+        "file I/O in a hot-loop body — move it behind the ring buffer / "
+        "pass boundary:\n  " + "\n  ".join(violations)
+    )
+
+
+def test_span_args_not_formatted_in_hot_loops():
+    """Span call arguments in hot loops must be cheap literals: an f-string
+    or %/.format inside the call evaluates at the call site even when
+    tracing is DISABLED, defeating the near-zero-cost gate."""
+    violations = []
+    for path, cls, methods, _budget in SPAN_HOT_LOOPS:
+        v, _ = _scan(path, cls, methods, SPAN_FMT, tag=None)
+        violations += v
+    assert not violations, (
+        "string formatting inside a hot-loop span call (evaluates even with "
+        "tracing off) — pass raw ints/strings instead:\n  "
+        + "\n  ".join(violations)
+    )

@@ -1,0 +1,479 @@
+"""Observability-plane tests (ISSUE 7): span tracing + Chrome export,
+RPC trace-context propagation through a REAL MasterServer process,
+heartbeat-aggregated fleet metrics, Prometheus export, serving request
+correlation, HLO cost reporting, and the profiler-idempotence satellite."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import stats
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs import trace
+
+pytestmark = [pytest.mark.timeout(150)]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _native_available() -> bool:
+    from paddle_tpu.runtime import available
+
+    return available()
+
+
+needs_native = pytest.mark.skipif(
+    not _native_available(), reason="native runtime unavailable"
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    was = trace.TRACER.enabled
+    trace.reset()
+    trace.enable_tracing(True)
+    yield
+    trace.enable_tracing(was)
+    trace.reset()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(port: int, deadline_s: float = 60.0) -> None:
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+            return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"port {port} never came up")
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return env
+
+
+# -- span API + Chrome export -------------------------------------------------
+
+
+def test_chrome_export_golden_format():
+    """The export is loadable trace-event JSON: every event carries
+    ph/ts/pid/tid/name (the Perfetto-required keys), complete-event phase,
+    and parent/trace ids that reflect span nesting."""
+    with trace.span("outer", role="test"):
+        with trace.span("inner"):
+            time.sleep(0.001)
+    trace.record_span("external", 1_000, 2_000)
+    out = trace.export_chrome()
+    assert trace.validate_chrome(out) == []
+    events = out["traceEvents"]
+    assert {e["name"] for e in events} == {"outer", "inner", "external"}
+    for ev in events:
+        for key in ("ph", "ts", "pid", "tid", "name"):
+            assert key in ev, f"event missing {key}: {ev}"
+        assert ev["ph"] == "X" and ev["dur"] >= 0
+    # survives a JSON round-trip byte-for-byte (what a file load sees)
+    assert json.loads(json.dumps(out)) == out
+    inner = next(e for e in events if e["name"] == "inner")
+    outer = next(e for e in events if e["name"] == "outer")
+    assert inner["args"]["trace_id"] == outer["args"]["trace_id"]
+    assert inner["args"]["parent_id"] == outer["args"]["span_id"]
+    assert outer["args"]["role"] == "test"
+    assert inner["ts"] >= outer["ts"]
+
+
+def test_ring_buffer_bounded_and_counts_drops():
+    t = trace.Tracer(capacity=4)
+    t.enabled = True
+    for i in range(10):
+        t.record("s", i, 1, "tid", f"sp{i}", None, None)
+    rows = t.snapshot()
+    assert len(rows) == 4
+    assert [r[1] for r in rows] == [6, 7, 8, 9]  # oldest dropped, order kept
+    assert t.dropped == 6 and t.recorded == 10
+
+
+def test_disabled_tracing_records_nothing():
+    trace.enable_tracing(False)
+    before = trace.TRACER.recorded
+    with trace.span("nope", x=1):
+        trace.record_span("also_nope", 0, 1)
+    assert trace.TRACER.recorded == before
+    assert trace.wire_context() is None
+
+
+def test_activate_foreign_context_stitches_trace():
+    wire = {"t": "cafe" * 4, "s": "dead.1"}
+    with trace.activate(wire):
+        with trace.span("child"):
+            pass
+    ev = trace.export_chrome()["traceEvents"][0]
+    assert ev["args"]["trace_id"] == wire["t"]
+    assert ev["args"]["parent_id"] == wire["s"]
+
+
+def test_span_stack_survives_exceptions():
+    with pytest.raises(RuntimeError):
+        with trace.span("outer"):
+            raise RuntimeError("boom")
+    assert trace.TRACER.current() is None  # stack fully unwound
+    with trace.span("after"):
+        assert trace.TRACER.current() is not None
+
+
+# -- metrics registry + Prometheus -------------------------------------------
+
+
+def test_metrics_registry_absorbs_event_counters():
+    stats.FT_EVENTS.incr("obs_test_marker", 3)
+    snap = obs_metrics.snapshot()
+    key = "paddle_tpu_events_total{event=obs_test_marker,group=ft}"
+    assert snap[key] == 3.0
+    text = obs_metrics.to_prometheus_text()
+    assert "# TYPE paddle_tpu_events_total counter" in text
+    assert 'paddle_tpu_events_total{event="obs_test_marker",group="ft"} 3' in text
+
+
+def test_histogram_and_prometheus_shape():
+    reg = obs_metrics.MetricsRegistry()
+    h = reg.histogram("t_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = obs_metrics.to_prometheus_text(reg)
+    assert 't_seconds_bucket{le="0.1"} 1' in text
+    assert 't_seconds_bucket{le="1.0"} 2' in text
+    assert 't_seconds_bucket{le="+Inf"} 3' in text
+    assert "t_seconds_count 3" in text
+    c = reg.counter("reqs_total")
+    c.inc(2, tenant="a")
+    assert 'reqs_total{tenant="a"} 2' in obs_metrics.to_prometheus_text(reg)
+
+
+def test_aggregate_snapshots_sums_and_skips_garbage():
+    agg = obs_metrics.aggregate_snapshots(
+        [{"a": 1, "b": 2}, {"a": 4, "c": "garbage"}]
+    )
+    assert agg == {"a": 5.0, "b": 2.0}
+
+
+def test_fleet_metrics_ttl_and_drop():
+    fm = obs_metrics.FleetMetrics(ttl_s=60)
+    fm.update("tr-1", {"a": 1.0})
+    fm.update("tr-2", {"a": 2.0, "b": 1.0})
+    agg = fm.aggregate()
+    assert agg["reporting_trainers"] == 2
+    assert agg["counters"] == {"a": 3.0, "b": 1.0}
+    fm.drop("tr-1")
+    assert fm.aggregate()["reporting_trainers"] == 1
+
+
+def test_obs_export_cli_local(tmp_path):
+    """`python -m paddle_tpu.obs export` without an endpoint prints this
+    process's registry as Prometheus text; `... trace` emits loadable JSON."""
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.obs", "export"],
+        env=_child_env(), capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "# TYPE paddle_tpu_shape_signatures gauge" in r.stdout
+    out = tmp_path / "t.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.obs", "trace", "--out", str(out)],
+        env=_child_env(), capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    loaded = json.loads(out.read_text())
+    assert "traceEvents" in loaded
+
+
+# -- RPC propagation + fleet aggregation (master plane) -----------------------
+
+
+@needs_native
+def test_rpc_trace_roundtrips_through_real_master_process(tmp_path):
+    """Acceptance: the trace context piggybacked on the line-JSON frames
+    round-trips through a REAL `python -m paddle_tpu.runtime.master serve`
+    process — the server's handler spans (fetched over the `trace_export`
+    RPC) stitch into the client span's trace id, and the merged trace is
+    Perfetto-loadable."""
+    from paddle_tpu.runtime.master import MasterClient
+
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.runtime.master", "serve",
+         "--port", str(port), "--trace", "1"],
+        env=_child_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        _wait_port(port)
+        client = MasterClient(("127.0.0.1", port))
+        client.call("set_dataset", shards=["a", "b"])
+        got = client.call("get_task")
+        assert "task_id" in got
+        remote = client.call("trace_export")["chrome_trace"]
+        client.close()
+        local = trace.export_chrome()
+
+        def events(tr, name, side):
+            return [
+                e for e in tr["traceEvents"]
+                if e["name"] == name and e["args"].get("side") == side
+            ]
+
+        cl = events(local, "rpc.get_task", "client")
+        sv = events(remote, "rpc.get_task", "server")
+        assert len(cl) == 1 and len(sv) == 1
+        # one trace id across the process boundary; the server span is the
+        # client span's child; distinct processes (pid rows) in the merge
+        assert sv[0]["args"]["trace_id"] == cl[0]["args"]["trace_id"]
+        assert sv[0]["args"]["parent_id"] == cl[0]["args"]["span_id"]
+        assert sv[0]["pid"] != cl[0]["pid"]
+        merged = trace.merge_chrome([local, remote])
+        assert trace.validate_chrome(merged) == []
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
+
+
+@needs_native
+def test_master_stats_aggregates_heartbeat_metrics():
+    """Heartbeats carrying metric snapshots land in stats()["fleet"]:
+    counters sum across trainers, deregister drops the contribution."""
+    from paddle_tpu.runtime.master import MasterClient, MasterServer, TaskMaster
+
+    server = MasterServer(TaskMaster(), lease_s=30.0).start()
+    try:
+        c = MasterClient(server.address)
+        t1 = c.call("register")["trainer_id"]
+        t2 = c.call("register")["trainer_id"]
+        c.call("heartbeat", trainer_id=t1, metrics={"steps": 5, "x": 1})
+        c.call("heartbeat", trainer_id=t2, metrics={"steps": 7})
+        fleet = c.call("stats")["fleet"]
+        assert fleet["reporting_trainers"] == 2
+        assert fleet["counters"]["steps"] == 12.0
+        assert fleet["counters"]["x"] == 1.0
+        # a RE-heartbeat replaces (not doubles) that trainer's snapshot
+        c.call("heartbeat", trainer_id=t2, metrics={"steps": 8})
+        assert c.call("stats")["fleet"]["counters"]["steps"] == 13.0
+        c.call("deregister", trainer_id=t2)
+        fleet = c.call("stats")["fleet"]
+        assert fleet["reporting_trainers"] == 1
+        assert fleet["counters"]["steps"] == 5.0
+        # the metrics RPC serves Prometheus text incl. the fleet aggregate
+        text = c.call("metrics")["text"]
+        assert "paddle_tpu_fleet_reporting_trainers 1" in text
+        assert 'paddle_tpu_fleet{key="steps"} 5' in text
+        c.close()
+    finally:
+        server.stop()
+
+
+# -- serving correlation (client → server → session) --------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_session():
+    from paddle_tpu.serving.session import make_demo_session
+
+    return make_demo_session(
+        vocab=64, n_layers=1, d_model=16, n_heads=2, seed=0,
+        max_slots=2, page_size=8, prefill_buckets=(8,), max_new_limit=4,
+    )
+
+
+@pytest.mark.serving
+@needs_native
+def test_serving_request_spans_share_one_trace_id(tiny_session):
+    """Acceptance: one serving request's spans — client RPC, server handler,
+    and the engine's queue-wait/prefill/ttft — correlate under ONE trace id,
+    and the server's buffer exports as loadable Chrome trace JSON."""
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    srv = ServingServer(session=tiny_session).start()
+    try:
+        c = ServingClient(srv.address)
+        res = c.generate([1, 2, 3], max_new_tokens=3, timeout_s=60)
+        assert res["done"]
+        exported = c.trace_export()
+        assert trace.validate_chrome(exported) == []
+        c.close()
+    finally:
+        srv.stop()
+    events = exported["traceEvents"]
+    submit_client = [
+        e for e in events
+        if e["name"] == "rpc.submit" and e["args"].get("side") == "client"
+    ]
+    assert submit_client, [e["name"] for e in events]
+    tid = submit_client[0]["args"]["trace_id"]
+    by_trace = {
+        e["name"] for e in events if e["args"].get("trace_id") == tid
+    }
+    assert {
+        "rpc.submit", "serving.queue_wait", "serving.prefill", "serving.ttft",
+    } <= by_trace, by_trace
+    # batch-level decode steps ran too (their own trace — they serve many
+    # requests at once) and TTFT landed in the histogram
+    assert any(e["name"] == "serving.decode_step" for e in events)
+    from paddle_tpu.serving.session import TTFT_HISTOGRAM
+
+    assert TTFT_HISTOGRAM._n > 0
+
+
+@pytest.mark.serving
+@needs_native
+def test_serving_stats_forwards_master_health(tiny_session):
+    """Satellite: stats() on a serving server wired to a routing master
+    surfaces the control plane's snapshot_failures / lease evictions /
+    live+evicted trainer counts — and reports unreachability as data."""
+    from paddle_tpu.runtime.master import MasterClient, MasterServer, TaskMaster
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    master = MasterServer(TaskMaster(), lease_s=30.0).start()
+    mc = MasterClient(master.address)
+    tid = mc.call("register")["trainer_id"]
+    srv = ServingServer(
+        session=tiny_session, master_endpoints=master.address
+    ).start()
+    srv._master_health_ttl_s = 0.0  # probe every stats() — the test flips
+    # the master down and must see the change immediately, not the cache
+    try:
+        c = ServingClient(srv.address)
+        st = c.stats()
+        assert st["master"]["reachable"] is True
+        assert st["master"]["snapshot_failures"] == 0
+        assert st["master"]["live_trainers"] == 1
+        assert st["master"]["evicted_trainers"] == 0
+        mc.close()
+        master.stop()  # control plane dies; serving stats must say so
+        st = c.stats()
+        assert st["master"]["reachable"] is False and st["master"]["error"]
+        c.close()
+    finally:
+        srv.stop()
+        master.stop()
+
+
+# -- profiling hooks ----------------------------------------------------------
+
+
+def test_profiler_start_stop_idempotent(tmp_path):
+    """Satellite: double start warns + no-ops (no jax RuntimeError), stop
+    without start no-ops."""
+    stats.profiler_stop()  # no active trace: must be a silent no-op
+    stats.profiler_start(str(tmp_path / "p"))
+    stats.profiler_start(str(tmp_path / "p"))  # second start: warn + no-op
+    stats.profiler_stop()
+    stats.profiler_stop()  # double stop: no-op
+
+
+def _toy_trainer_and_batch():
+    from paddle_tpu.nn import costs as C
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn.graph import reset_name_scope
+    from paddle_tpu.optim import SGD
+    from paddle_tpu.trainer import SGDTrainer
+
+    reset_name_scope()
+    x = L.Data("x", shape=(8,))
+    lbl = L.Data("label", shape=())
+    cost = C.ClassificationCost(L.Fc(L.Fc(x, 16, act="relu"), 3, act=None), lbl)
+    trainer = SGDTrainer(cost, SGD(learning_rate=0.1), seed=0)
+    rs = np.random.RandomState(0)
+    batch = {
+        "x": rs.randn(8, 8).astype(np.float32),
+        "label": (np.arange(8) % 3).astype(np.int32),
+    }
+    return trainer, batch
+
+
+def test_trainer_cost_report_top_k_buckets():
+    from paddle_tpu.obs import profile as obs_profile
+
+    trainer, batch = _toy_trainer_and_batch()
+    trainer.init_state(batch)
+    report = obs_profile.trainer_cost_report(trainer, batch, top_k=3)
+    step = report["executables"]["train_step"]
+    assert step["flops"] > 0
+    assert step["bytes_accessed"] > 0
+    assert 0 < len(step["top_buckets"]) <= 3
+    # ranked descending, deterministically
+    vals = [b["value"] for b in step["top_buckets"]]
+    assert vals == sorted(vals, reverse=True)
+
+
+def test_pass_profiler_captures_one_pass(tmp_path):
+    from paddle_tpu.obs import profile as obs_profile
+
+    trainer, batch = _toy_trainer_and_batch()
+    profiler = obs_profile.PassProfiler.from_spec(
+        "pass:1", logdir=str(tmp_path / "trace")
+    )
+    seen = []
+    handler = profiler.wrap(lambda e: seen.append(type(e).__name__))
+    trainer.train(
+        lambda: iter([batch] * 4), num_passes=2, event_handler=handler,
+        log_period=100,
+    )
+    assert profiler.captured
+    assert not profiler._active
+    assert (tmp_path / "trace").is_dir()
+    assert "EndPass" in seen  # the wrapped handler still ran
+
+
+def test_parse_profile_spec_rejects_bad_forms():
+    from paddle_tpu.obs.profile import parse_profile_spec
+
+    assert parse_profile_spec("pass:0") == ("pass", 0)
+    for bad in ("", "pass", "pass:x", "pass:-1", "step:3"):
+        with pytest.raises(ValueError):
+            parse_profile_spec(bad)
+
+
+def test_statset_report_percent_and_deterministic_ties():
+    """Satellite: report() shows percent-of-total and breaks total ties by
+    name so timer splits diff cleanly across runs."""
+    ss = stats.StatSet()
+    ss.get("zeta").add(0.010)
+    ss.get("alpha").add(0.010)
+    ss.get("big").add(0.080)
+    rep = ss.report()
+    lines = rep.splitlines()[1:]
+    names = [ln.strip().split(":")[0] for ln in lines]
+    assert names == ["big", "alpha", "zeta"]  # total desc, then name
+    assert "80.0%" in lines[0]
+    assert "10.0%" in lines[1]
+    assert ss.report() == rep  # stable across calls
+
+
+# -- trainer spans ------------------------------------------------------------
+
+
+def test_trainer_emits_pass_dispatch_checkpoint_spans(tmp_path):
+    trainer, batch = _toy_trainer_and_batch()
+    trainer.train(
+        lambda: iter([batch] * 3), num_passes=1, log_period=100,
+        save_dir=str(tmp_path / "ckpt"),
+    )
+    names = [r[0] for r in trace.TRACER.snapshot()]
+    assert names.count("train.dispatch") == 3
+    assert "train.pass" in names
+    assert "train.checkpoint" in names
