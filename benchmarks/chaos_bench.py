@@ -1,5 +1,5 @@
-"""Chaos benchmark: training throughput under injected faults, plus a
-multi-process cluster failover scenario.
+"""Chaos benchmark: training throughput under injected faults, a
+multi-process cluster failover scenario, and a live elastic-resize drill.
 
 --mode local (default) measures steps/sec for the same toy workload three
 ways — clean, under an input-side fault mix (flaky feeder + slowed H2D), and
@@ -16,9 +16,27 @@ the shared snapshot, and N consumer threads failing over through their
 endpoint list — and reports the wall-clock cost of the failover plus the
 exactly-once bookkeeping (done == ntasks, discarded == 0, replayed records).
 
+--mode resize (ISSUE 8) drills live elastic resize on a forced-host-device
+CPU mesh:
+  * grow: one pass trained on a 2-chip data axis that re-shards to 4 chips
+    mid-pass and finishes there — the pass average must match the fixed-size
+    run, and the drain / re-shard / resume latency split is reported;
+  * shrink: the same 4 -> 2;
+  * reshard_kill: the seeded fault kills the trainer mid-re-shard (after the
+    drain checkpoint); a fresh trainer at the TARGET world auto-resumes from
+    the drained boundary and must land bitwise on the uninterrupted resized
+    run's params;
+  * drain-barrier kill: a real master + N cluster_reader consumers; a resize
+    epoch is announced mid-pass and one consumer wedges inside the barrier
+    (`resize_drain_stall`) until the master's DRAIN TIMEOUT drops it from
+    the barrier (its daemon heartbeat thread keeps the lease alive, so lease
+    eviction alone can never catch it) — the epoch must still complete and
+    task accounting stays exactly-once (done == ntasks, discarded == 0, full
+    record coverage).
+
 Usage:
-  JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py [--mode local|cluster]
-      [--faults SPEC] [--seed N]
+  JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py
+      [--mode local|cluster|resize] [--faults SPEC] [--seed N]
 """
 
 from __future__ import annotations
@@ -220,11 +238,297 @@ def run_cluster(args) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _build_resize_trainer(args, world, shard):
+    from paddle_tpu.nn import costs as C
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn.graph import reset_name_scope
+    from paddle_tpu.optim import SGD
+    from paddle_tpu.parallel import DataParallel, make_mesh
+    from paddle_tpu.trainer import SGDTrainer
+
+    reset_name_scope()
+    x = L.Data("x", shape=(args.dim,))
+    lbl = L.Data("label", shape=())
+    h = L.Fc(x, args.hidden, act="relu", name="h")
+    logits = L.Fc(h, args.classes, act=None, name="out")
+    cost = C.ClassificationCost(logits, lbl, name="cost")
+    dp = DataParallel(make_mesh({"data": world}))
+    # power-of-two lr: scale products are FMA-proof, so the bitwise gates
+    # below test the resize seam, not XLA contraction luck
+    return SGDTrainer(
+        cost, SGD(learning_rate=0.125, momentum=0.5), parallel=dp, seed=5,
+        shard_update=shard,
+    )
+
+
+def run_resize(args) -> dict:
+    """Live elastic-resize drill (see module docstring). Every leg is seeded
+    and in-process except the drain-barrier kill, which runs a real TCP
+    master with cluster_reader consumer threads."""
+    import numpy as np
+
+    import jax
+
+    from paddle_tpu.core import faults, preempt, stats
+    from paddle_tpu.trainer.events import EndIteration, EndPass
+
+    ndev = len(jax.devices())
+    need = max(args.resize_from, args.resize_to_world)
+    if ndev < need:
+        return {
+            "metric": "resize_epoch_total_s", "value": None,
+            "error": f"need {need} devices, host has {ndev} "
+                     "(set --force_devices before jax imports)",
+        }
+    backend = jax.default_backend()
+    rs = np.random.RandomState(args.seed)
+    xs = rs.randn(args.batches * args.batch_size, args.dim).astype(np.float32)
+    ys = (rs.rand(len(xs)) * args.classes).astype(np.int32)
+
+    def reader():
+        for i in range(0, len(xs), args.batch_size):
+            yield {"x": xs[i:i + args.batch_size], "label": ys[i:i + args.batch_size]}
+
+    def run(world, target=None, spec="", save_dir=None, auto_resume=False,
+            shard=False):
+        preempt.reset()
+        tr = _build_resize_trainer(args, world, shard)
+        metrics, killed = [], False
+
+        def handler(ev):
+            if (
+                target is not None
+                and isinstance(ev, EndIteration)
+                and (ev.pass_id, ev.batch_id) == (0, args.resize_at)
+            ):
+                preempt.get().request_resize(target, reason="bench resize")
+            if isinstance(ev, EndPass):
+                metrics.append(ev.metrics)
+
+        with faults.inject(spec, seed=args.seed):
+            try:
+                tr.train(
+                    reader, num_passes=1, event_handler=handler,
+                    save_dir=save_dir, auto_resume=auto_resume,
+                    log_period=10_000,
+                )
+            except faults.InjectedKill:
+                killed = True
+        preempt.reset()
+        return tr, metrics, killed
+
+    def params(tr):
+        return {k: np.asarray(v) for k, v in tr.state["params"].items()}
+
+    def rel_close(a, b, tol=1e-5):
+        return abs(a - b) <= tol * max(abs(a), abs(b), 1e-12)
+
+    def leg(world_from, world_to):
+        t0 = time.time()
+        fixed, m_fixed, _ = run(world_from)
+        resized, m_rz, _ = run(world_from, target=world_to)
+        split = (m_rz[0].get("resizes") or [{}])[0]
+        return {
+            "from": world_from, "to": world_to,
+            "platform": backend,
+            "fixed_avg_cost": m_fixed[0]["avg_cost"],
+            "resized_avg_cost": m_rz[0]["avg_cost"],
+            "pass_avg_match": rel_close(
+                m_fixed[0]["avg_cost"], m_rz[0]["avg_cost"]
+            ),
+            "resize_epochs": m_rz[0].get("resize_epochs", 0),
+            "drain_s": split.get("drain_s"),
+            "reshard_s": split.get("reshard_s"),
+            "resume_s": split.get("resume_s"),
+            "wall_s": round(time.time() - t0, 3),
+        }
+
+    grow = leg(args.resize_from, args.resize_to_world)
+    shrink = leg(args.resize_to_world, args.resize_from)
+
+    # -- reshard_kill: death mid-re-shard, auto-resume on the NEW world ------
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="chaos_resize_")
+    try:
+        oracle, m_o, _ = run(args.resize_from, target=args.resize_to_world)
+        _, _, killed = run(
+            args.resize_from, target=args.resize_to_world,
+            spec="reshard_kill:step=0", save_dir=tmp,
+        )
+        resumed, m_r, _ = run(
+            args.resize_to_world, save_dir=tmp, auto_resume=True,
+        )
+        p_o, p_r = params(oracle), params(resumed)
+        bitwise = all(
+            np.array_equal(p_o[k].view(np.uint32), p_r[k].view(np.uint32))
+            for k in p_o
+        )
+        reshard_kill = {
+            "killed_mid_reshard": killed,
+            "resume_bitwise_vs_uninterrupted": bitwise,
+            "platform": backend,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    fleet = run_resize_fleet(args)
+
+    ok = (
+        grow["pass_avg_match"] and shrink["pass_avg_match"]
+        # a silently-no-op resize would make pass_avg_match vacuously true:
+        # each leg must have completed exactly one real epoch
+        and grow["resize_epochs"] == 1 and shrink["resize_epochs"] == 1
+        and reshard_kill["killed_mid_reshard"]
+        and reshard_kill["resume_bitwise_vs_uninterrupted"]
+        and fleet.get("exactly_once_tasks") and fleet.get("epoch_completed")
+        and fleet.get("barrier_exercised")
+    )
+    return {
+        "metric": "resize_epoch_total_s",
+        "value": grow["drain_s"] + grow["reshard_s"] + grow["resume_s"]
+        if grow["drain_s"] is not None else None,
+        "unit": "s",
+        "platform": backend,
+        "all_gates_pass": bool(ok),
+        "grow": grow,
+        "shrink": shrink,
+        "reshard_kill": reshard_kill,
+        "drain_barrier_kill": fleet,
+        "seed": args.seed,
+    }
+
+
+def run_resize_fleet(args) -> dict:
+    """Drain-barrier-kill drill: real TCP master + cluster_reader consumer
+    threads; a resize epoch lands mid-pass and one consumer wedges inside
+    the barrier until the drain TIMEOUT drops it (its heartbeat thread keeps
+    the lease alive, so lease eviction alone cannot catch it). Gates: the
+    epoch completes, the wedged consumer is timed out of the barrier (and
+    rejoins after waking), and task accounting is exactly-once."""
+    import shutil
+    import tempfile
+    import threading
+
+    from paddle_tpu.core import faults, stats
+    from paddle_tpu.runtime import recordio
+    from paddle_tpu.runtime.master import (
+        MasterClient, MasterServer, TaskMaster, cluster_reader,
+    )
+
+    os.environ["PADDLE_TPU_RESIZE_STALL_S"] = str(args.stall_s)
+    tmp = tempfile.mkdtemp(prefix="chaos_resize_fleet_")
+    nrec = args.cluster_tasks * args.records_per_task
+    srv = None
+    try:
+        shards = recordio.convert(
+            os.path.join(tmp, "ds"),
+            lambda: ({"sid": i} for i in range(nrec)),
+            records_per_file=args.records_per_task,
+        )
+        srv = MasterServer(
+            TaskMaster(timeout_s=30.0, failure_max=10), lease_s=1.5,
+            resize_drain_timeout_s=args.drain_timeout_s,
+        ).start()
+        endpoint = srv.address
+        boot = MasterClient(endpoint)
+        boot.call("set_dataset", shards=shards, chunks_per_task=1)
+
+        consumed = [[] for _ in range(args.consumers)]
+        stats.FT_EVENTS.reset()
+
+        def consume(i):
+            rd = cluster_reader(
+                endpoint, client_kw={"retries": 40, "timeout": 5},
+                poll_interval=0.05,
+            )
+            for s in rd():
+                consumed[i].append(s["sid"])
+                # slower than --mode cluster on purpose: the pass must
+                # outlive a heartbeat period (lease/3) so every consumer
+                # SEES the piggybacked drain signal mid-pass — otherwise
+                # the drill degenerates to deregister-empties-the-barrier
+                time.sleep(args.fleet_work_ms / 1e3)
+
+        threads = [
+            threading.Thread(target=consume, args=(i,), daemon=True)
+            for i in range(args.consumers)
+        ]
+        t0 = time.time()
+        with faults.inject("resize_drain_stall:step=0", seed=args.seed) as inj:
+            for t in threads:
+                t.start()
+            # announce the epoch once every consumer holds a lease
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if boot.call("stats").get("live_leases", 0) >= args.consumers:
+                    break
+                time.sleep(0.05)
+            ann = boot.call("resize", world=args.resize_to_world)
+            # the epoch must complete despite the wedged consumer
+            info = ann
+            deadline = time.time() + 60
+            while time.time() < deadline and info.get("state") != "idle":
+                time.sleep(0.1)
+                info = boot.call("stats")["resize"]
+            for t in threads:
+                t.join(timeout=120)
+            stalled = inj.fired.get("resize_drain_stall", 0)
+        elapsed = time.time() - t0
+        st = boot.call("stats")
+        boot.close()
+        flat = [x for c in consumed for x in c]
+        drains = stats.FT_EVENTS.get("reader_resize_drain")
+        return {
+            # the drill is only meaningful when the barrier was really
+            # exercised: one consumer wedged in it, at least one other
+            # drained through it, and the wedged one was removed (barrier
+            # timeout — its heartbeat thread keeps the lease alive, so
+            # lease eviction alone cannot catch it)
+            "barrier_exercised": (
+                stalled >= 1 and drains >= 2
+                and (info.get("last", {}).get("timed_out") or 0)
+                + (info.get("last", {}).get("evicted_during") or 0) >= 1
+            ),
+            "stall_fired": stalled,
+            "reader_drains": drains,
+            "platform": "host",
+            "consumers": args.consumers,
+            "tasks": args.cluster_tasks,
+            "records": nrec,
+            "epoch_completed": info.get("state") == "idle"
+            and info.get("completed", 0) >= 1,
+            "evicted_during_epoch": info.get("last", {}).get("evicted_during"),
+            "barrier_timed_out": info.get("last", {}).get("timed_out"),
+            "barrier_drain_s": info.get("last", {}).get("drain_s"),
+            "epoch_total_s": info.get("last", {}).get("total_s"),
+            "done": st.get("done"),
+            "discarded": st.get("discarded"),
+            "exactly_once_tasks": (
+                st.get("done") == args.cluster_tasks
+                and st.get("discarded") == 0
+            ),
+            "records_delivered": len(flat),
+            "records_replayed": len(flat) - len(set(flat)),
+            "coverage_complete": set(flat) == set(range(nrec)),
+            "wall_s": round(elapsed, 3),
+            "ft_events": stats.FT_EVENTS.as_dict(),
+            "seed": args.seed,
+        }
+    finally:
+        if srv is not None:
+            srv.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", default="local", choices=["local", "cluster"],
+    ap.add_argument("--mode", default="local",
+                    choices=["local", "cluster", "resize"],
                     help="local: in-process throughput-under-faults; "
-                         "cluster: multi-process master-failover drill")
+                         "cluster: multi-process master-failover drill; "
+                         "resize: live elastic grow/shrink mid-pass drill")
     ap.add_argument("--faults", default=DEFAULT_FAULTS,
                     help="input-side fault mix for the chaos mode")
     ap.add_argument("--seed", type=int, default=0)
@@ -245,7 +549,41 @@ def main():
     ap.add_argument("--nan_every", type=int, default=10,
                     help="guard mode poisons every Nth batch (via probability "
                          "1/N) to exercise skip_batch under load")
+    ap.add_argument("--resize_from", type=int, default=2,
+                    help="resize mode: data-axis size the pass starts on")
+    ap.add_argument("--resize_to_world", type=int, default=4,
+                    help="resize mode: data-axis size after the mid-pass epoch")
+    ap.add_argument("--resize_at", type=int, default=2,
+                    help="resize mode: batch id whose EndIteration requests "
+                         "the resize (drain lands at the next boundary)")
+    ap.add_argument("--force_devices", type=int, default=8,
+                    help="resize mode: xla_force_host_platform_device_count "
+                         "for the virtual CPU mesh (set before jax imports)")
+    ap.add_argument("--stall_s", type=float, default=8.0,
+                    help="resize mode: how long the resize_drain_stall "
+                         "consumer stays wedged inside the drain barrier "
+                         "(longer than --drain_timeout_s, so the master "
+                         "times it out of the barrier)")
+    ap.add_argument("--drain_timeout_s", type=float, default=3.0,
+                    help="resize mode: master drain-barrier timeout — a "
+                         "wedged-but-heartbeating member is dropped from the "
+                         "barrier after this long and the survivors proceed")
+    ap.add_argument("--fleet_work_ms", type=float, default=40.0,
+                    help="resize mode: per-record consumer work in the "
+                         "drain-barrier drill — the pass must outlive a "
+                         "heartbeat period so the drain signal lands mid-pass")
     args = ap.parse_args()
+
+    if args.mode == "resize":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.force_devices}"
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(run_resize(args)))
+        return
 
     if args.mode == "cluster":
         print(json.dumps(run_cluster(args)))

@@ -13,7 +13,7 @@ Usage:
         [--shard_update=1] [--grad_compression=none|bf16|int8]
         [--guard_check_every=N] [--steps_per_dispatch=K] [--async_checkpoint=0|1]
         [--keep_last_n=N] [--faults=SPEC]
-        [--master_endpoints=a:p1,b:p2] [--preempt_grace_s=S]
+        [--master_endpoints=a:p1,b:p2] [--preempt_grace_s=S] [--elastic=1]
         [--profile=pass:N] [--profile_dir=DIR]
     python -m paddle_tpu dump_config --config=conf.py
     python -m paddle_tpu merge_model --config=conf.py --model_dir=DIR --output=FILE
@@ -152,6 +152,15 @@ def _train_args(p: argparse.ArgumentParser) -> None:
              "the step and checkpoint within this many seconds, then exit "
              "with code 77 (preempt.EXIT_PREEMPTED) so a supervisor restart "
              "with --auto_resume=1 continues from the drained batch boundary",
+    )
+    p.add_argument(
+        "--elastic", type=_str2bool, default=False,
+        help="join the master's elastic-resize plane (needs "
+             "--master_endpoints and --trainer_count > 1): a `resize` epoch "
+             "announced by the master drains this trainer at a batch "
+             "boundary, re-shards params/optimizer state from the canonical "
+             "layout onto the new mesh data-axis size, and resumes the "
+             "interrupted pass in place (see README 'Elastic resize')",
     )
 
 
@@ -612,6 +621,33 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     from paddle_tpu.trainer.trainer import Preempted
 
+    resize_client = None
+    resize_barrier = None
+    if args.elastic:
+        if not args.master_endpoints or parallel is None:
+            print(
+                "--elastic needs --master_endpoints (the resize plane rides "
+                "the master heartbeats) and --trainer_count > 1 (a mesh to "
+                "re-shape); continuing without elastic resize",
+                file=sys.stderr,
+            )
+        else:
+            from paddle_tpu.runtime.master import ResizeClient
+
+            try:
+                resize_client = ResizeClient(args.master_endpoints)
+                resize_barrier = resize_client.barrier
+            except ConnectionError as e:
+                # same degrade contract as the misconfiguration branch
+                # above: an unreachable master must not abort training (a
+                # supervisor loop with --auto_resume restarts into the
+                # current mesh and re-attaches when the master returns)
+                print(
+                    f"--elastic: master unreachable ({e}); continuing "
+                    "without elastic resize",
+                    file=sys.stderr,
+                )
+
     try:
         trainer.train(
             reader,
@@ -625,6 +661,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             keep_last_n=args.keep_last_n or None,
             steps_per_dispatch=args.steps_per_dispatch,
             async_checkpoint=args.async_checkpoint,
+            resize_barrier=resize_barrier,
         )
     except Preempted as p:
         # distinct exit code: a supervisor restarting with --auto_resume=1
@@ -640,6 +677,9 @@ def cmd_train(args: argparse.Namespace) -> int:
             f"continue", file=sys.stderr,
         )
         return preempt.EXIT_PREEMPTED
+    finally:
+        if resize_client is not None:
+            resize_client.close()
 
     if profiler is not None:
         from paddle_tpu.obs import profile as obs_profile

@@ -35,6 +35,16 @@ Known sites (hooks live next to the code they sabotage):
     conn_reset     client-side partition: the master RPC     (runtime.master.MasterClient)
                    socket resets after connect; reconnect/
                    failover path must absorb
+    resize_drain_stall  trainer wedges INSIDE the resize      (trainer._drain_resize /
+                   drain barrier — never acks resize_drained, runtime.master.ResizeClient,
+                   so the master must evict it on lease       cluster_reader drain)
+                   expiry for the epoch to complete; stall
+                   length via PADDLE_TPU_RESIZE_STALL_S
+                   (default 300)
+    reshard_kill   process dies mid-re-shard, AFTER the       (trainer.SGDTrainer.resize_to)
+                   drain checkpoint and barrier — auto_resume
+                   must replay the pass from the drained
+                   boundary on the NEW mesh
 
 Seeding: `PADDLE_TPU_FAULTS_SEED` (or the `seed` argument). Each site gets
 its own `random.Random(f"{seed}:{site}")` stream, so the fire pattern of one
@@ -203,6 +213,29 @@ ACTIVE = FaultInjector(os.environ.get("PADDLE_TPU_FAULTS", ""))
 
 def get() -> FaultInjector:
     return ACTIVE
+
+
+def maybe_stall(
+    site: str,
+    env: str = "PADDLE_TPU_RESIZE_STALL_S",
+    default_s: float = 300.0,
+) -> bool:
+    """Wedge-the-process hook shared by the resize drain sites: when `site`
+    fires, sleep for `$env` seconds (default `default_s`) — long enough for
+    the master's barrier timeout / lease eviction to remove the member —
+    then return True. One definition so the trainer drain and the
+    reader/client barrier stall identically."""
+    if not (ACTIVE.active and ACTIVE.fire(site)):
+        return False
+    stall_s = float(os.environ.get(env, str(default_s)))
+    import logging
+
+    logging.getLogger("paddle_tpu.faults").warning(
+        "chaos: %s fired — wedging %.0fs (no ack; the barrier timeout or "
+        "lease eviction must remove this member)", site, stall_s,
+    )
+    time.sleep(stall_s)
+    return True
 
 
 @contextlib.contextmanager

@@ -83,6 +83,44 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     return cache_dir
 
 
+def detach_compilation_cache(reason: str = "") -> bool:
+    """PERMANENTLY detach the persistent compilation cache from this
+    process (sticky; True when a cache was actually detached).
+
+    Exists for elastic resize: once a process re-shapes its mesh, later
+    small EAGER multi-device programs (cost-sum adds, canonical
+    gather/re-flatten, placement moves) repeat byte-identically across
+    trainer generations and carry no per-trainer cache salt — on jax
+    0.4.37's CPU backend, executing a persistent-cache-DESERIALIZED
+    multi-device program in such a process corrupts memory or segfaults
+    (the same bug the SGDTrainer `_cache_salt` works around for the
+    compiled step; empirically, a region-scoped opt-out around the re-shard
+    alone is NOT sufficient — the poisoned execution can be any later
+    deserialized multi-device program, so the opt-out must be sticky).
+    Mesh step programs never used the persistent cache anyway (the salt),
+    so a resize-performing trainer process loses only the single-device
+    program cache from the first resize onward. No-op when the cache was
+    never enabled. jax_enable_compilation_cache alone does not reliably
+    gate cache READS on jax 0.4.37 — the directory itself is detached and
+    the latched cache object reset."""
+    import jax
+
+    if jax.config.jax_compilation_cache_dir is None:
+        return False
+    from jax.experimental.compilation_cache import compilation_cache
+
+    log.warning(
+        "detaching the persistent compilation cache for the rest of this "
+        "process%s — deserialized multi-device programs are unsafe on this "
+        "backend after a mesh resize (jax 0.4.37 CPU corruption bug; see "
+        "core/init_ctx.detach_compilation_cache)",
+        f" ({reason})" if reason else "",
+    )
+    jax.config.update("jax_compilation_cache_dir", None)
+    compilation_cache.reset_cache()
+    return True
+
+
 def init(**kwargs: Any) -> GlobalFlags:
     """paddle.init analog. Accepts the v1 flag names; unknown flags are kept in
     ``extras`` rather than rejected (the reference forwards argv to gflags)."""

@@ -21,6 +21,15 @@ Semantics:
 The guard is also the landing point for the seeded `preempt` chaos site
 (core/faults.py): the injector calls `request()` directly, so the whole
 drain path is a deterministic, tested code path without real signals.
+
+Elastic resize (ISSUE 8) reuses the same poll-at-batch-boundary discipline as
+a COOPERATIVE drain — no process exit: `request_resize(world)` parks a
+`ResizeRequest` on the guard; the train loop sees `resize_requested()` at the
+next dispatch boundary, writes a mid-pass checkpoint, re-shards the train
+state from the canonical layout onto the new mesh, and CONTINUES the pass.
+The request is claimed with `take_resize()` (one drain per request), and a
+fleet-coordinated trainer gets the request set by its master heartbeat watcher
+(runtime.master.ResizeClient) rather than a signal.
 """
 
 from __future__ import annotations
@@ -44,6 +53,36 @@ EXIT_PREEMPTED = 77
 DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
 
+class ResizeRequest:
+    """One pending elastic-resize order: re-shape the mesh data axis to
+    `world` chips. `epoch` is the master's resize-epoch id (0 for local,
+    uncoordinated requests) and `instance` the announcing master's resize-
+    plane instance token ("" for local) — epoch numbers restart when a
+    standby is promoted, so only the (instance, epoch) pair identifies an
+    epoch; `requested_at` anchors the drain-latency split reported by the
+    trainer."""
+
+    __slots__ = ("world", "epoch", "instance", "reason", "requested_at")
+
+    def __init__(
+        self, world: int, epoch: int = 0, instance: str = "",
+        reason: str = "resize",
+    ):
+        if int(world) < 1:
+            raise ValueError(f"resize world must be >= 1, got {world}")
+        self.world = int(world)
+        self.epoch = int(epoch)
+        self.instance = instance or ""
+        self.reason = reason
+        self.requested_at = time.monotonic()
+
+    def __repr__(self):
+        return (
+            f"ResizeRequest(world={self.world}, epoch={self.epoch}, "
+            f"instance={self.instance!r}, reason={self.reason!r})"
+        )
+
+
 class PreemptionGuard:
     """Flag + deadline the train loop polls at batch boundaries."""
 
@@ -52,6 +91,7 @@ class PreemptionGuard:
         self._lock = threading.Lock()
         self._requested_at: Optional[float] = None
         self._reason: Optional[str] = None
+        self._resize: Optional[ResizeRequest] = None
         self._old_handlers: Dict[int, object] = {}
 
     # -- signal wiring -------------------------------------------------------
@@ -120,10 +160,57 @@ class PreemptionGuard:
                 return False
             return time.monotonic() - self._requested_at > self.grace_s
 
+    # -- elastic resize (cooperative drain, no exit) -------------------------
+    def request_resize(
+        self, world: int, epoch: int = 0, instance: str = "",
+        reason: str = "resize",
+    ) -> bool:
+        """Park a resize order for the train loop's next dispatch boundary.
+        A strictly LATER epoch from the SAME master instance supersedes an
+        unclaimed earlier request (the master may re-announce after
+        membership churn), and any epoch from a DIFFERENT instance does too
+        (a heartbeat reply reflects the live master's current state — a
+        promoted standby's epoch 1 outranks a dead primary's parked epoch
+        5). Stale/duplicate same-instance epochs, and a local epoch-0
+        order while any request is already parked, are ignored — a local
+        request can never clobber a pending master-coordinated one.
+        Returns True when the request was accepted."""
+        req = ResizeRequest(world, epoch, instance, reason)
+        with self._lock:
+            cur = self._resize
+            if cur is not None:
+                if epoch == 0:
+                    return False  # local order never clobbers a parked one
+                if cur.instance == req.instance and cur.epoch >= epoch:
+                    return False  # duplicate/stale within one master's numbering
+            self._resize = req
+        stats.FT_EVENTS.incr("resize_request")
+        log.warning(
+            "resize notice (%s): will drain at the next batch boundary and "
+            "re-shard onto %d chip(s) (epoch %d)", reason, req.world, req.epoch,
+        )
+        return True
+
+    @property
+    def resize_pending(self) -> bool:
+        return self._resize is not None
+
+    def resize_request(self) -> Optional[ResizeRequest]:
+        with self._lock:
+            return self._resize
+
+    def take_resize(self) -> Optional[ResizeRequest]:
+        """Claim the pending resize (clears the flag) — exactly one drain per
+        request, even with several pollers."""
+        with self._lock:
+            req, self._resize = self._resize, None
+            return req
+
     def reset(self) -> None:
         with self._lock:
             self._requested_at = None
             self._reason = None
+            self._resize = None
 
 
 # -- module-level singleton (what the trainer and CLI talk to) ---------------
@@ -158,6 +245,12 @@ def requested() -> bool:
     """Cheap poll for the train loop: no guard → never preempted."""
     g = _GUARD
     return g is not None and g.requested
+
+
+def resize_requested() -> bool:
+    """Cheap per-boundary poll for the train loop: no guard → no resize."""
+    g = _GUARD
+    return g is not None and g.resize_pending
 
 
 def reset() -> None:

@@ -246,6 +246,17 @@ class DevicePrefetcher:
     def __call__(self):
         return iter(self)
 
+    def rebind_parallel(self, parallel: Optional[Any]) -> None:
+        """Point FUTURE batches at a new DataParallel plan (elastic resize).
+        The swap is one attribute store and each worker batch captures the
+        plan exactly once at preparation start, so no batch is ever padded
+        for one mesh and sharded for another — batches already prepared (or
+        mid-flight) under the old plan surface to the consumer as old-mesh
+        stragglers, which the trainer rebuilds host-side for the current
+        plan. At most prefetch_depth + 1 batches take that slow path; the
+        rest of the run lands directly on the new mesh."""
+        self.parallel = parallel
+
     def _feed(self, raw: Any) -> Dict[str, Any]:
         """Raw reader item → feed-ready host batch (the hostFeed leg).
         Span + timer stamp the same interval: the timer aggregates, the span
@@ -258,14 +269,18 @@ class DevicePrefetcher:
                     else coerce_batch(raw)
                 )
 
-    def _device_put(self, batch: Dict[str, Any], stacked: bool = False) -> Any:
-        """Feed-ready batch → device-resident batch (the h2d leg). stacked
-        places a [K, B, ...] group with the scan-axis sharding; the chaos
-        sleep fires once per call either way = once per dispatch."""
+    def _device_put(
+        self, batch: Dict[str, Any], par: Optional[Any], stacked: bool = False
+    ) -> Any:
+        """Feed-ready batch → device-resident batch (the h2d leg) under the
+        plan `par` the caller captured at preparation start (rebind_parallel
+        may have swapped self.parallel since). stacked places a [K, B, ...]
+        group with the scan-axis sharding; the chaos sleep fires once per
+        call either way = once per dispatch."""
         faults.get().sleep("h2d_delay")  # chaos hook: slow transfer leg
         with trace.span("pipeline.h2d", stacked=stacked):
-            if self.parallel is not None:
-                put = self.parallel.shard_batches if stacked else self.parallel.shard_batch
+            if par is not None:
+                put = par.shard_batches if stacked else par.shard_batch
                 return put(batch)
             if self.device is not None:
                 return {k: jax.device_put(v, self.device) for k, v in batch.items()}
@@ -273,18 +288,19 @@ class DevicePrefetcher:
 
     def _prepare(self, raw: Any) -> Any:
         """Raw reader item → device-resident batch (SKIP = drop)."""
+        par = self.parallel  # one capture: pad and shard under ONE plan
         batch = self._feed(raw)
         with stats.timer("h2d"):
-            if self.parallel is not None:
+            if par is not None:
                 # pad to the shard multiple with a row mask instead of
                 # dropping (cost layers zero pad rows; see
                 # DataParallel.pad_batch) — the sample stream now matches
                 # the unsharded reader exactly; only unpaddable ragged
                 # batches drop
-                batch = self.parallel.maybe_pad_batch(batch, where="prefetcher")
+                batch = par.maybe_pad_batch(batch, where="prefetcher")
                 if batch is None:
                     return SKIP
-            return self._device_put(batch)
+            return self._device_put(batch, par)
 
     def _grouped_reader(self):
         buf: List[Any] = []
@@ -300,14 +316,15 @@ class DevicePrefetcher:
         """A run of stack_k raw items → one StackedBatch (the fast path: one
         np.stack + one device put covering K steps), or _Singles/SKIP when
         the group cannot stack as a whole."""
+        par = self.parallel  # one capture: the whole group under ONE plan
         batches = [self._feed(raw) for raw in group]
-        if self.parallel is not None:
+        if par is not None:
             # a padded batch gains a mask slot → its signature differs →
             # the group degrades to singles below
             batches = [
                 b
                 for b in (
-                    self.parallel.maybe_pad_batch(b, where="prefetcher group")
+                    par.maybe_pad_batch(b, where="prefetcher group")
                     for b in batches
                 )
                 if b is not None
@@ -320,12 +337,12 @@ class DevicePrefetcher:
         )
         with stats.timer("h2d"):
             if not stackable:
-                return _Singles(self._device_put(b) for b in batches)
+                return _Singles(self._device_put(b, par) for b in batches)
             stacked = {
                 k: np.stack([np.asarray(b[k]) for b in batches])
                 for k in batches[0]
             }
-            out = self._device_put(stacked, stacked=True)
+            out = self._device_put(stacked, par, stacked=True)
         sb = StackedBatch(out)
         sb.k = self.stack_k
         return sb

@@ -225,6 +225,26 @@ REGISTRY.register_collector(_stats_collector)
 REGISTRY.register_collector(_trace_collector)
 
 
+def observe_resize(phase_seconds: Mapping[str, float]) -> None:
+    """Record one completed elastic-resize epoch on this process's registry:
+    bumps `paddle_tpu_resize_epochs_total` and adds each phase's seconds to
+    `paddle_tpu_resize_latency_seconds_total{phase=drain|reshard|resume}`.
+    Counters (not gauges) on purpose: trainer heartbeats piggyback
+    `snapshot()` and the master sums snapshots key-by-key, so the fleet
+    aggregate reads as total epochs and total seconds per phase (mean =
+    seconds/epochs) instead of a meaningless summed last-value."""
+    REGISTRY.counter(
+        "paddle_tpu_resize_epochs_total",
+        "completed elastic resize epochs",
+    ).inc()
+    lat = REGISTRY.counter(
+        "paddle_tpu_resize_latency_seconds_total",
+        "elastic resize wall-clock by phase",
+    )
+    for phase, s in phase_seconds.items():
+        lat.inc(float(s), phase=phase)
+
+
 # -- heartbeat snapshots + fleet aggregation ---------------------------------
 
 

@@ -1,4 +1,4 @@
-from paddle_tpu.parallel.mesh import make_mesh  # noqa: F401
+from paddle_tpu.parallel.mesh import make_mesh, resize_mesh  # noqa: F401
 from paddle_tpu.parallel.data_parallel import DataParallel  # noqa: F401
 from paddle_tpu.parallel import distributed as distributed  # noqa: F401
 from paddle_tpu.parallel.sequence_parallel import (  # noqa: F401

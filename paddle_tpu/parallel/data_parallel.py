@@ -42,6 +42,10 @@ class DataParallel:
         self.param_attrs = param_attrs or {}
         self._replicated = NamedSharding(mesh, P())
         self._batch_sharding = NamedSharding(mesh, P(batch_axis))
+        # K-stacked ([K, B, ...]) placement: scan axis unsharded, batch axis
+        # over the data axis — cached because is_sharded_batches runs per
+        # dispatch in the train hot loop
+        self._batches_sharding = NamedSharding(mesh, P(None, batch_axis))
 
     # -- sharding rules ------------------------------------------------------
     def param_sharding(self, name: str, ndim: int) -> NamedSharding:
@@ -118,6 +122,12 @@ class DataParallel:
             out[k] = np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
         mask = np.ones(b + pad, np.float32)
         mask[b:] = 0.0
+        if SAMPLE_MASK_KEY in batch:
+            # re-padding an already-masked batch (a batch padded for the
+            # pre-resize mesh crossing a grown data axis): EXTEND the
+            # existing mask with zero rows — overwriting it would un-mask
+            # the original pad rows
+            mask[:b] = np.asarray(batch[SAMPLE_MASK_KEY], np.float32)
         out[SAMPLE_MASK_KEY] = mask
         return out, pad
 
@@ -140,6 +150,13 @@ class DataParallel:
     def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         return self._put(batch, self._batch_sharding)
 
+    def replicate(self, value: Any) -> Any:
+        """Place one array replicated on THIS plan's mesh — how host-side
+        accumulators (e.g. the pass-cost sum) migrate across an elastic
+        resize, where arrays committed to the old mesh cannot join new-mesh
+        computations."""
+        return jax.device_put(value, self._replicated)
+
     def is_sharded_batch(self, batch: Dict[str, Any]) -> bool:
         """True when every slot already carries this plan's batch sharding —
         the trainer's device-batch fast path must not skip shard_batch for
@@ -154,8 +171,18 @@ class DataParallel:
         """Shard a K-stacked batch dict ([K, B, ...] per slot) for the
         multi-step scan driver: the scan axis stays unsharded, batch axis 1
         shards over the mesh data axis."""
-        return self._put(
-            batches, NamedSharding(self.mesh, P(None, self.batch_axis))
+        return self._put(batches, self._batches_sharding)
+
+    def is_sharded_batches(self, batches: Dict[str, Any]) -> bool:
+        """is_sharded_batch for a K-stacked group: true when every [K, B,
+        ...] slot already carries THIS plan's scan-unsharded/batch-sharded
+        placement — false for groups a prefetcher stacked for a different
+        (pre-resize) mesh, which must be rebuilt rather than dispatched."""
+        want = self._batches_sharding
+        return all(
+            isinstance(v, jax.Array)
+            and v.sharding.is_equivalent_to(want, v.ndim)
+            for v in batches.values()
         )
 
     def shard_state(

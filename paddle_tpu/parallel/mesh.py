@@ -41,3 +41,36 @@ def make_mesh(
     shape = [sizes[a] for a in names]
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, tuple(names))
+
+
+def resize_mesh(
+    mesh: Mesh,
+    axis: str,
+    new_size: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Elastic resize: the same mesh with `axis` re-shaped to `new_size`
+    chips (every other axis keeps its extent). Raises with a clear message
+    when the host cannot supply enough devices — the caller (trainer drain /
+    chaos bench) turns that into a rejected resize rather than a deep jax
+    error."""
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no axis {axis!r} to resize (axes: {mesh.axis_names})"
+        )
+    new_size = int(new_size)
+    if new_size < 1:
+        raise ValueError(f"resize target for axis {axis!r} must be >= 1")
+    sizes = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+    sizes[axis] = new_size
+    total = int(np.prod(list(sizes.values())))
+    pool = list(devices if devices is not None else jax.devices())
+    if total > len(pool):
+        raise ValueError(
+            f"cannot resize mesh axis {axis!r} to {new_size}: the new mesh "
+            f"needs {total} device(s) but only {len(pool)} are available"
+        )
+    # hand make_mesh exactly the devices the new shape consumes — the full
+    # pool would trip its divisibility check for any world size that does
+    # not divide the host device count (e.g. 3 trainers on an 8-chip host)
+    return make_mesh(sizes, devices=pool[:total])

@@ -80,6 +80,15 @@ class ParameterUpdater:
         M*(n-1)/n). 0 for single-replica updaters."""
         return 0
 
+    def rebind(self, parallel, params: Dict[str, Any]) -> "ParameterUpdater":
+        """Elastic-resize seam: a NEW updater of this kind bound to a
+        different mesh/parallel plan, with its layout geometry derived from
+        `params` — no optimizer slots are allocated (the live state crosses
+        the resize through to_canonical on the OLD updater and
+        from_canonical on the returned one). Single-replica updaters are
+        mesh-free and rebind to themselves."""
+        return self
+
 
 class SgdLocalUpdater(ParameterUpdater):
     """Single-replica updater (ParameterUpdater.h:38 SgdLocalUpdater): the
@@ -114,6 +123,10 @@ class IciAllReduceUpdater(SgdLocalUpdater):
             distributed.barrier("finish_pass")
 
     def init_opt_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        self._record_grad_bytes(params)
+        return super().init_opt_state(params)
+
+    def _record_grad_bytes(self, params: Dict[str, Any]) -> None:
         # record sizes for the collective-bytes model (the replicated path's
         # gradient all-reduce is the baseline the sharded path halves)
         # the grad all-reduce carries the PARAM dtype (the f32 cast happens
@@ -124,7 +137,6 @@ class IciAllReduceUpdater(SgdLocalUpdater):
             for k, p in params.items()
             if not (self.optimizer.param_attrs.get(k) or ParamAttr()).is_static
         )
-        return super().init_opt_state(params)
 
     def collective_bytes_per_step(self) -> int:
         n = self.parallel.mesh.shape[self.parallel.batch_axis]
@@ -132,6 +144,11 @@ class IciAllReduceUpdater(SgdLocalUpdater):
             return 0
         # full-precision grad all-reduce: 2*M*(n-1)/n bytes per chip
         return int(2 * getattr(self, "_grad_bytes", 0) * (n - 1) / n)
+
+    def rebind(self, parallel, params: Dict[str, Any]) -> "IciAllReduceUpdater":
+        new = type(self)(self.optimizer, parallel)
+        new._record_grad_bytes(params)
+        return new
 
 
 @dataclasses.dataclass
@@ -212,9 +229,23 @@ class ShardedUpdater(IciAllReduceUpdater):
         chunk = -(-chunk // align) * align
         return _FlatGeom(tuple(p.shape), size, chunk, flat)
 
+    def bind_geometry(self, params: Dict[str, Any]) -> None:
+        """Derive the flat-shard geometry for `params` without allocating any
+        optimizer state — the elastic-resize rebind path, where the slot
+        values arrive separately through from_canonical."""
+        self._geom = {k: self._param_geom(k, p) for k, p in params.items()}
+
+    def rebind(self, parallel, params: Dict[str, Any]) -> "ShardedUpdater":
+        new = type(self)(
+            self.optimizer, parallel, compression=self.compression.name
+        )
+        new._record_grad_bytes(params)
+        new.bind_geometry(params)
+        return new
+
     def init_opt_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
         opt = super().init_opt_state(params)  # canonical slots (+ _grad_bytes)
-        self._geom = {k: self._param_geom(k, p) for k, p in params.items()}
+        self.bind_geometry(params)
         slots = {}
         for k, ss in opt["slots"].items():
             geom = self._geom[k]

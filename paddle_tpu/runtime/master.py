@@ -23,6 +23,17 @@ Cluster-level failure is a first-class code path here:
 - **Chaos**: the seeded sites `master_drop` (RPC vanishes), `master_kill`
   (server dies mid-RPC, no final snapshot) and `conn_reset` (client socket
   resets) make every failover path deterministic and testable.
+- **Elastic resize** (ISSUE 8): a `resize` RPC (or join/evict with
+  `resize_on_membership=True`) announces a resize EPOCH; the drain signal
+  piggybacks on heartbeat replies (no control-plane RPC storm), every live
+  member acks `resize_drained` at its own boundary, eviction recomputes the
+  barrier so a trainer killed mid-drain cannot wedge the epoch, and
+  `resize_status` polls double as resumed acks. `_ResizeEpoch` is the state
+  machine; `ResizeClient` is the trainer-side hook
+  (`train(resize_barrier=rc.barrier)`); a registered `cluster_reader`
+  participates between task acks. The seeded sites `resize_drain_stall`
+  (member wedges inside the barrier) and `reshard_kill` (death mid-re-shard,
+  trainer side) make the epoch's failure transitions deterministic.
 """
 
 from __future__ import annotations
@@ -183,6 +194,10 @@ class _Membership:
         self.lease_s = float(lease_s)
         self._lock = threading.Lock()
         self._last_seen: Dict[str, float] = {}
+        # lease role: "trainer" (default) or "reader" — one PROCESS may hold
+        # both (ResizeClient + registered cluster_reader), so membership-
+        # triggered resize worlds must count trainer leases, not all leases
+        self._roles: Dict[str, str] = {}
         self._owned: Dict[str, Set[int]] = {}
         self._owner: Dict[int, str] = {}
         self._next = 0
@@ -191,18 +206,23 @@ class _Membership:
         self._prefix = uuid.uuid4().hex[:6]
         self.evicted = 0
 
-    def register(self) -> str:
+    def register(self, role: str = "trainer") -> str:
         with self._lock:
             tid = f"tr-{self._prefix}-{self._next}"
             self._next += 1
             self._last_seen[tid] = time.monotonic()
+            self._roles[tid] = role or "trainer"
             return tid
 
-    def note_seen(self, tid: Optional[str]) -> None:
+    def note_seen(self, tid: Optional[str], role: Optional[str] = None) -> None:
         if not tid:
             return
         with self._lock:
             self._last_seen[tid] = time.monotonic()
+            if role:
+                # heartbeats re-assert the role so a lease ADOPTED by a
+                # standby (which never saw `register`) heals its type too
+                self._roles[tid] = role
 
     def own(self, tid: Optional[str], task_id: int) -> None:
         if not tid:
@@ -219,9 +239,16 @@ class _Membership:
 
     def drop(self, tid: str) -> Set[int]:
         """Forget a trainer (graceful deregister or eviction); returns the
-        task ids it still held, for the caller to re-queue."""
+        task ids it still held, for the caller to re-queue. Reader-role
+        entries survive as tombstones: an evicted-but-alive reader whose
+        next get_task/task_done resurrects the lease (note_seen carries no
+        role) must not default back to "trainer" and inflate the next
+        membership-triggered world size. Ids are never reused, so the
+        tombstones are one short string per ever-registered reader."""
         with self._lock:
             self._last_seen.pop(tid, None)
+            if self._roles.get(tid, "trainer") != "reader":
+                self._roles.pop(tid, None)
             tasks = self._owned.pop(tid, set())
             for t in tasks:
                 self._owner.pop(t, None)
@@ -239,6 +266,265 @@ class _Membership:
     def live(self) -> int:
         with self._lock:
             return len(self._last_seen)
+
+    @property
+    def live_trainers(self) -> int:
+        """Trainer-role leases only — the world size a membership-triggered
+        resize should announce (reader leases join the drain barrier but do
+        not shard the data axis)."""
+        with self._lock:
+            return sum(
+                1 for t in self._last_seen
+                if self._roles.get(t, "trainer") != "reader"
+            )
+
+    def role(self, tid: Optional[str]) -> str:
+        with self._lock:
+            return self._roles.get(tid, "trainer") if tid else "trainer"
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return list(self._last_seen)
+
+
+class _ResizeEpoch:
+    """Master-side elastic-resize state machine (ISSUE 8 tentpole).
+
+    One epoch at a time:
+
+        idle --announce--> draining --all live members acked--> go
+          ^                                                      |
+          +------------- every acked member saw go --------------+
+
+    `announce(world, live)` snapshots the live trainer set as the drain
+    BARRIER membership; each member acks `resize_drained` at its own batch
+    boundary. The barrier is recomputed on eviction (`note_dropped`) so a
+    trainer KILLED during the drain cannot deadlock the epoch: lease expiry
+    shrinks the membership and the survivors proceed. A trainer that is
+    wedged but still heart-beating (`resize_drain_stall` — its daemon
+    heartbeat thread keeps the lease alive) is caught by the second guard:
+    `tick()` (called from the master's reaper loop) times the DRAIN phase
+    out after `drain_timeout_s` and drops non-acked members from the
+    barrier, so liveness never depends on every member being prompt. In
+    `go`, members poll `resize_status` (their poll marks them resumed); once
+    every surviving member resumed, the epoch closes and the drain/total
+    latency lands in `last`. A timed-out straggler that eventually wakes
+    sees the epoch in `go`/`idle`, adopts the decided world, and rejoins.
+
+    Task accounting stays exactly-once across the epoch by construction:
+    drained trainers hold no in-flight task (the reader drains between task
+    acks), and a killed trainer's pending tasks ride the existing eager
+    re-queue on eviction — nothing is double-acked and nothing is lost, so
+    `done == ntasks` holds at pass end regardless of how many epochs (or
+    mid-epoch deaths) the pass saw."""
+
+    def __init__(self, drain_timeout_s: float = 60.0):
+        self._lock = threading.Lock()
+        self.drain_timeout_s = float(drain_timeout_s)
+        # epoch numbers are a per-master-INSTANCE counter: a promoted
+        # standby (or restarted master) counts from 1 again, so clients
+        # must treat (instance, epoch) — not the bare number — as the
+        # epoch's identity or a post-failover collision with an already-
+        # handled number silently exempts them from the new master's epochs
+        self.instance = uuid.uuid4().hex[:8]
+        self.epoch = 0
+        self.state = "idle"  # idle | draining | go
+        self.world = 0
+        self.barrier: Set[str] = set()
+        self.acked: Set[str] = set()
+        self.resumed: Set[str] = set()
+        self.evicted_during = 0
+        self.timed_out = 0
+        self.announced_at = 0.0
+        self.drained_at = 0.0
+        self.completed = 0
+        self.last: Dict[str, Any] = {}
+
+    def announce(self, world: int, live: Sequence[str]) -> Dict[str, Any]:
+        with self._lock:
+            if self.state != "idle":
+                return {
+                    "err": (
+                        f"resize epoch {self.epoch} still {self.state} "
+                        f"(world {self.world}); retry after it completes"
+                    )
+                }
+            self.epoch += 1
+            self.state = "draining"
+            self.world = int(world)
+            self.barrier = set(live)
+            self.acked = set()
+            self.resumed = set()
+            self.evicted_during = 0
+            self.timed_out = 0
+            self.announced_at = time.monotonic()
+            self.drained_at = 0.0
+            if not self.barrier:
+                # nobody to drain (resize before any trainer registered):
+                # complete immediately instead of wedging `draining` — and
+                # rejecting every later announce — until the drain timeout
+                self._maybe_go_locked()
+            info = self._info_locked()
+        stats.FT_EVENTS.incr("resize_announce")
+        log.warning(
+            "resize epoch %d announced: world -> %d, drain barrier of %d "
+            "trainer(s)", info["epoch"], info["world"], info["barrier"],
+        )
+        return info
+
+    def ack_drained(self, tid: Optional[str], epoch: int) -> Dict[str, Any]:
+        with self._lock:
+            if self.state == "draining" and epoch == self.epoch and tid:
+                self.acked.add(tid)
+                # a late joiner acking the barrier counts as a member (it
+                # registered after the announce but still drains with us)
+                self.barrier.add(tid)
+                self._maybe_go_locked()
+            return self._info_locked()
+
+    def mark_resumed(self, tid: Optional[str], epoch: int) -> Dict[str, Any]:
+        with self._lock:
+            if self.state == "go" and epoch == self.epoch and tid:
+                self.resumed.add(tid)
+                self._maybe_finish_locked()
+            return self._info_locked()
+
+    def note_dropped(self, tid: str) -> None:
+        """Membership eviction/deregister during an epoch: the barrier must
+        not wait for the dead."""
+        with self._lock:
+            if self.state == "idle":
+                return
+            dropped = False
+            for s in (self.barrier, self.acked, self.resumed):
+                if tid in s:
+                    s.discard(tid)
+                    dropped = True
+            if not dropped:
+                return
+            self.evicted_during += 1
+            if self.state == "draining":
+                self._maybe_go_locked()
+            elif self.state == "go":
+                self._maybe_finish_locked()
+        stats.FT_EVENTS.incr("resize_barrier_evicted")
+
+    def tick(self) -> None:
+        """Reaper-loop guard: a drain phase older than `drain_timeout_s`
+        drops every non-acked member from the barrier (a member can be
+        wedged yet still heart-beating, so lease eviction alone is not a
+        liveness guarantee) and lets the survivors go. The `go` phase gets
+        the same guard against its own wedge mode: a member that acked the
+        drain and then hung inside its re-shard (heartbeat thread still
+        renewing the lease) must not pin the epoch in `go` — and reject
+        every future announce — forever."""
+        stragglers: Set[str] = set()
+        with self._lock:
+            if self.state == "draining":
+                if time.monotonic() - self.announced_at < self.drain_timeout_s:
+                    return
+                stragglers = self.barrier - self.acked
+                if stragglers:
+                    log.warning(
+                        "resize epoch %d: drain barrier timed out after "
+                        "%.0fs — dropping %d non-acked member(s) and "
+                        "proceeding",
+                        self.epoch, self.drain_timeout_s, len(stragglers),
+                    )
+                    self.barrier -= stragglers
+                    self.timed_out += len(stragglers)
+                    self.evicted_during += len(stragglers)
+                self._maybe_go_locked()
+            elif self.state == "go":
+                if time.monotonic() - self.drained_at < self.drain_timeout_s:
+                    return
+                stragglers = self.barrier - self.resumed
+                if stragglers:
+                    log.warning(
+                        "resize epoch %d: %d drained member(s) never resumed "
+                        "after %.0fs — dropping them and completing the "
+                        "epoch",
+                        self.epoch, len(stragglers), self.drain_timeout_s,
+                    )
+                    self.barrier -= stragglers
+                    self.acked -= stragglers
+                    self.timed_out += len(stragglers)
+                    self.evicted_during += len(stragglers)
+                self._maybe_finish_locked()
+            else:
+                return
+        for _ in stragglers:
+            stats.FT_EVENTS.incr("resize_barrier_timeout")
+
+    def _maybe_go_locked(self) -> None:
+        if self.barrier and not (self.barrier - self.acked):
+            self.state = "go"
+            self.drained_at = time.monotonic()
+            log.warning(
+                "resize epoch %d: all %d live trainer(s) drained (%.3fs) — go",
+                self.epoch, len(self.barrier),
+                self.drained_at - self.announced_at,
+            )
+        elif not self.barrier:
+            # everyone died mid-drain: nothing left to coordinate
+            self.state = "go"
+            self.drained_at = time.monotonic()
+            self._maybe_finish_locked()
+
+    def _maybe_finish_locked(self) -> None:
+        if self.barrier - self.resumed:
+            return
+        self.state = "idle"
+        self.completed += 1
+        now = time.monotonic()
+        self.last = {
+            "epoch": self.epoch,
+            "world": self.world,
+            "trainers": len(self.barrier),
+            "evicted_during": self.evicted_during,
+            "timed_out": self.timed_out,
+            "drain_s": round(
+                (self.drained_at or now) - self.announced_at, 6
+            ),
+            "total_s": round(now - self.announced_at, 6),
+        }
+        stats.FT_EVENTS.incr("resize_complete")
+        log.warning(
+            "resize epoch %d complete: world=%d %d trainer(s), %d evicted "
+            "mid-epoch, drain %.3fs total %.3fs", self.epoch, self.world,
+            len(self.barrier), self.evicted_during, self.last["drain_s"],
+            self.last["total_s"],
+        )
+
+    def _info_locked(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "instance": self.instance,
+            "epoch": self.epoch,
+            "world": self.world,
+            "barrier": len(self.barrier),
+            "drained": len(self.acked),
+            "resumed": len(self.resumed),
+            "timed_out": self.timed_out,
+            "completed": self.completed,
+            "last": dict(self.last),
+        }
+
+    def info(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._info_locked()
+
+    def heartbeat_payload(self) -> Optional[Dict[str, Any]]:
+        """The drain signal that piggybacks on heartbeat replies while an
+        epoch is active — no extra RPC round-trips on the control plane
+        ("RPC Considered Harmful"); None (omitted) when idle."""
+        with self._lock:
+            if self.state == "idle":
+                return None
+            return {
+                "state": self.state, "instance": self.instance,
+                "epoch": self.epoch, "world": self.world,
+            }
 
 
 class _SnapshotPolicy:
@@ -358,15 +644,27 @@ class _Handler(socketserver.StreamRequestHandler):
             ms.kill()
             return False
         trainer_id = req.get("trainer_id")
-        ms.membership.note_seen(trainer_id)
+        ms.membership.note_seen(trainer_id, req.get("role"))
         # (expired leases are swept by the reaper thread every lease_s/4 —
         # that bound IS the eager-requeue guarantee; scanning again per
         # RPC would only add membership-lock traffic to the hot path)
         # membership + observability RPCs never touch the native queue —
         # answered outside master_lock (drop_trainer takes it itself)
         if method == "register":
+            role = req.get("role") or "trainer"
+            tid = ms.membership.register(role)
+            if (
+                ms.resize_on_membership
+                and role != "reader"
+                and ms.membership.live_trainers > 1
+            ):
+                # join-triggered epoch: re-shape the fleet to the new live
+                # TRAINER count (while another epoch is still in flight the
+                # announce parks and the reaper re-fires it on completion);
+                # a reader lease joining changes no world size
+                ms.announce_membership_resize()
             self._reply({
-                "trainer_id": ms.membership.register(),
+                "trainer_id": tid,
                 "lease_s": ms.membership.lease_s,
             })
             return True
@@ -375,10 +673,53 @@ class _Handler(socketserver.StreamRequestHandler):
             # piggybacked metrics snapshot joins the fleet aggregate
             if trainer_id and "metrics" in req:
                 ms.fleet.update(trainer_id, req["metrics"])
-            self._reply({"ok": bool(trainer_id)})
+            resp = {"ok": bool(trainer_id)}
+            rz = ms.resize.heartbeat_payload()
+            if rz is not None:
+                # the resize drain signal rides the lease renewal — an
+                # active epoch reaches every live trainer within one
+                # heartbeat period, with zero extra control-plane RPCs
+                resp["resize"] = rz
+            self._reply(resp)
             return True
         if method == "deregister":
             self._reply({"ok": ms.drop_trainer(trainer_id, evict=False)})
+            return True
+        if method == "resize":
+            # explicit fleet re-shape order (ops tooling / chaos bench);
+            # join/evict-triggered epochs go through the same announce. A
+            # malformed order gets an err REPLY — crashing the handler here
+            # would sever the connection instead
+            try:
+                world = req["world"]
+                # strict: a JSON bool/float would coerce under int() (True
+                # -> 1 would re-shard the fleet to one chip; 4.7 -> 4) —
+                # reply err instead of guessing what the operator meant
+                if (
+                    isinstance(world, bool)
+                    or not isinstance(world, int)
+                    or world < 1
+                ):
+                    raise ValueError(world)
+            except (KeyError, TypeError, ValueError):
+                self._reply({
+                    "err": f"resize needs a positive integer world, got "
+                           f"{req.get('world')!r}"
+                })
+                return True
+            self._reply(ms.resize.announce(world, ms.membership.ids()))
+            return True
+        if method in ("resize_drained", "resize_status"):
+            try:
+                epoch = int(req.get("epoch", 0))
+            except (TypeError, ValueError):
+                epoch = -1  # malformed: matches no epoch, replies status-only
+            # in `go`, a member's status poll doubles as its resumed ack
+            self._reply(
+                ms.resize.ack_drained(trainer_id, epoch)
+                if method == "resize_drained"
+                else ms.resize.mark_resumed(trainer_id, epoch)
+            )
             return True
         if method == "metrics":
             fleet = ms.fleet.aggregate()
@@ -429,8 +770,14 @@ class _Handler(socketserver.StreamRequestHandler):
             elif method == "stats":
                 resp = master.stats()
                 resp["snapshot_failures"] = ms.snapshot_failures
-                resp["live_trainers"] = ms.membership.live
+                # role-aware: live_trainers is the world size a resize
+                # would announce; reader leases show up in live_leases
+                resp["live_trainers"] = ms.membership.live_trainers
+                resp["live_leases"] = ms.membership.live
                 resp["evicted_trainers"] = ms.membership.evicted
+                # resize-epoch observability: state machine position,
+                # completed-epoch count and the last epoch's latency split
+                resp["resize"] = ms.resize.info()
                 # fleet-wide aggregate of the heartbeat metric snapshots:
                 # one stats() answers for every reporting trainer
                 resp["fleet"] = ms.fleet.aggregate()
@@ -471,10 +818,36 @@ class MasterServer:
         lease_s: float = 10.0,
         snapshot_every: int = 1,
         snapshot_interval_s: float = 0.0,
+        resize_on_membership: bool = False,
+        resize_drain_timeout_s: Optional[float] = None,
     ):
         self.master = master or TaskMaster()
         self.master_lock = threading.Lock()
         self.membership = _Membership(lease_s)
+        # elastic resize epoch state machine; resize_on_membership=True also
+        # announces an epoch (world = live trainer count) whenever a trainer
+        # joins or is evicted — the join/evict-triggered policy; explicit
+        # `resize` RPCs work either way. The drain timeout defaults to a few
+        # leases: enough for every prompt member's next batch boundary,
+        # short enough that one wedged-but-heartbeating member cannot hold
+        # the fleet hostage.
+        self.resize = _ResizeEpoch(
+            drain_timeout_s=(
+                resize_drain_timeout_s
+                if resize_drain_timeout_s is not None
+                else max(4.0 * lease_s, 10.0)
+            )
+        )
+        self.resize_on_membership = resize_on_membership
+        # membership churn that lands while an epoch is in flight parks here
+        # (announce() rejects overlapping epochs); the reaper re-announces
+        # against the CURRENT membership once the epoch completes, so the
+        # fleet never settles at a stale world size
+        self._resize_pending = False
+        # serializes announce()+park so a successful announce on one handler
+        # thread cannot clobber a concurrent rejected announce's park (the
+        # lost-update hazard maybe_reannounce_resize's docstring describes)
+        self._resize_announce_lock = threading.Lock()
         # per-trainer heartbeat metric snapshots → fleet aggregate in stats();
         # entries expire a few leases after the last heartbeat
         self.fleet = obs_metrics.FleetMetrics(ttl_s=max(3.0 * lease_s, 30.0))
@@ -523,11 +896,66 @@ class MasterServer:
                 n += 1
         return n
 
+    def announce_membership_resize(self) -> None:
+        """Join/evict-triggered resize epoch for the CURRENT membership.
+        The announced WORLD counts trainer-role leases only (a process may
+        hold a reader lease too — double-counting would shard the data axis
+        to a size no real trainer count backs), while the drain BARRIER
+        spans every lease (readers drain between tasks). While another
+        epoch is still in flight the announce is rejected; park it so the
+        reaper fires it once the epoch completes instead of silently
+        dropping the churn. The announce and the park write are one
+        critical section: handler threads race here, and a successful
+        announce's pending=False must not overwrite a concurrent rejected
+        announce's park (that churn would be silently dropped). A success
+        CLEARS the park because the membership it announced was read inside
+        the same lock — any parked churn is subsumed by that epoch."""
+        with self._resize_announce_lock:
+            world = self.membership.live_trainers
+            if not world:
+                return
+            r = self.resize.announce(world, self.membership.ids())
+            self._resize_pending = "err" in r
+
+    def maybe_reannounce_resize(self) -> None:
+        """Reaper hook: fire a parked membership-churn announce once the
+        in-flight epoch has completed. Ordering matters: while
+        resize_on_membership is on, this thread must never WRITE
+        _resize_pending=False on the not-pending path — an RPC handler's
+        rejected announce can park (set True) between this thread's read
+        and such a write, and the clobbered park would silently drop the
+        churn (the fleet settles at a stale world size)."""
+        if not self.resize_on_membership:
+            self._resize_pending = False
+            return
+        if not self._resize_pending:
+            return
+        if self.resize.info()["state"] != "idle":
+            return
+        if not self.membership.live_trainers:
+            # keep the park (same lost-update hazard as above): once a
+            # trainer appears the next tick announces at the live count
+            return
+        self.announce_membership_resize()
+
     def drop_trainer(self, tid: Optional[str], evict: bool) -> bool:
         if not tid:
             return False
+        was_trainer = self.membership.role(tid) != "reader"
         tasks = self.membership.drop(tid)
         self.fleet.drop(tid)
+        # a dead/deregistered trainer must not hold up an in-flight resize
+        # drain barrier — recompute it against the survivors
+        self.resize.note_dropped(tid)
+        if (
+            evict
+            and self.resize_on_membership
+            and was_trainer
+            and self.membership.live_trainers
+        ):
+            # evict-triggered epoch: shrink the fleet to the surviving
+            # trainers (an evicted reader lease changes no world size)
+            self.announce_membership_resize()
         requeued = 0
         with self.master_lock:
             if not self.master.closed:
@@ -552,6 +980,8 @@ class MasterServer:
         period = max(0.05, min(1.0, self.membership.lease_s / 4.0))
         while not self._stop_evt.wait(period):
             self.evict_expired()
+            self.resize.tick()  # drain/go-phase timeout guard
+            self.maybe_reannounce_resize()  # parked membership churn
             if self.snap is not None and self.snap.pending():
                 # quiet-period flush: acks below the debounce threshold still
                 # become durable without waiting for the next burst
@@ -877,16 +1307,23 @@ class MasterClient:
 
 class _Heartbeater:
     """Background lease renewal on its OWN connection (the reader's socket is
-    busy inside blocking calls; sharing it would interleave frames)."""
+    busy inside blocking calls; sharing it would interleave frames).
+
+    Heartbeat REPLIES carry the master's piggybacked resize drain signal
+    while an epoch is active; it is stashed on the shared `ident` dict
+    (`ident["resize"]`) for the reader's between-task drain check and handed
+    to `on_resize` (the ResizeClient's watcher) when given."""
 
     def __init__(
         self,
         address: EndpointsLike,
         ident: Dict[str, Any],
         client_kw: Optional[dict] = None,
+        on_resize: Optional[Callable[[Dict[str, Any]], None]] = None,
     ):
         self._ident = ident
         self._client = MasterClient(address, **(client_kw or {}))
+        self._on_resize = on_resize
         self._evt = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="master-heartbeat", daemon=True
@@ -907,19 +1344,241 @@ class _Heartbeater:
             try:
                 # metrics snapshot piggybacks on the lease renewal — the
                 # master aggregates these into its fleet-wide stats() view
-                self._client.call(
-                    "heartbeat", trainer_id=tid,
-                    metrics=obs_metrics.snapshot(),
-                )
+                hb_kw: Dict[str, Any] = {
+                    "trainer_id": tid,
+                    "metrics": obs_metrics.snapshot(),
+                }
+                if self._ident.get("role"):
+                    # re-assert the lease role so an adoption after master
+                    # failover heals the type (reader vs trainer) too
+                    hb_kw["role"] = self._ident["role"]
+                resp = self._client.call("heartbeat", **hb_kw)
             except ConnectionError:
                 # terminal after retries+failover — the lease will lapse and
                 # the master re-queues our tasks; the reader's own calls will
                 # surface the outage, nothing more to do here
                 stats.FT_EVENTS.incr("heartbeat_lost")
+                continue
+            rz = resp.get("resize") if isinstance(resp, dict) else None
+            if rz:
+                self._ident["resize"] = rz
+                if self._on_resize is not None:
+                    try:
+                        self._on_resize(rz)
+                    except Exception:
+                        log.exception("resize watcher callback failed")
 
     def stop(self) -> None:
         self._evt.set()
         self._thread.join(timeout=5.0)
+        self._client.close()
+
+
+def _barrier_master_lost(
+    epoch: int, fallback_world: int, err: Exception
+) -> int:
+    """The master died mid-epoch (retries exhausted): the documented
+    proceed-alone fallback, not a crash of the training pass."""
+    stats.FT_EVENTS.incr("resize_barrier_master_lost")
+    log.warning(
+        "resize epoch %d: master unreachable at the drain barrier (%s) — "
+        "proceeding alone with world=%d", epoch, err, fallback_world,
+    )
+    return fallback_world
+
+
+# cluster_reader idents living in THIS process, so a co-resident trainer's
+# drain barrier can ack on their behalf (see _service_reader_drains)
+_READER_IDENTS: List[Dict[str, Any]] = []
+_READER_IDENTS_LOCK = threading.Lock()
+
+
+def _service_reader_drains(client: MasterClient) -> None:
+    """Ack the drain for any cluster_reader lease in THIS process whose
+    consuming loop cannot reach its own between-task boundary right now —
+    in the two-lease setup the reader feeds the very train loop that is
+    parked inside the trainer's drain barrier (same thread), so without
+    this the barrier and the reader serialize into a circular wait the
+    master could only break by timing the healthy reader lease out. A
+    reader lease holds no in-flight RESIZE obligation beyond its ack (task
+    accounting is lease-based either way); its resumed ack still rides the
+    reader's next boundary poll."""
+    with _READER_IDENTS_LOCK:
+        idents = list(_READER_IDENTS)
+    for ident in idents:
+        info = ident.get("resize")
+        tid = ident.get("trainer_id")
+        if not info or tid is None or info.get("state") != "draining":
+            continue
+        try:
+            epoch = int(info.get("epoch", 0))
+        except (TypeError, ValueError):
+            continue
+        key = (info.get("instance"), epoch)
+        if key == ident.get("resize_done"):
+            continue
+        try:
+            client.call("resize_drained", trainer_id=tid, epoch=epoch)
+        except ConnectionError:
+            continue  # the reader's own boundary (or eviction) handles it
+        stats.FT_EVENTS.incr("reader_resize_drain")
+        ident["resize_done"] = key
+        ident["resize_resume"] = epoch
+        ident.pop("resize", None)
+
+
+def _drain_barrier(
+    client: MasterClient,
+    trainer_id: str,
+    epoch: int,
+    fallback_world: int,
+    poll_s: float = 0.1,
+    max_wait_s: float = 120.0,
+) -> int:
+    """One member's walk through the drain barrier: ack `resize_drained`,
+    poll `resize_status` until the epoch leaves `draining` (every live member
+    acked, or the stragglers were evicted), mark resumed, and return the
+    final world size. A barrier that never resolves within `max_wait_s`
+    (master gone mid-epoch) falls back to the announced world so the member
+    can proceed alone."""
+    # chaos hook: wedge INSIDE the barrier without acking — the master's
+    # drain timeout (or lease eviction, if heartbeats stop too) must remove
+    # this member for the epoch to complete
+    faults.maybe_stall("resize_drain_stall")
+    try:
+        info = client.call("resize_drained", trainer_id=trainer_id, epoch=epoch)
+    except ConnectionError as e:
+        return _barrier_master_lost(epoch, fallback_world, e)
+    deadline = time.monotonic() + max_wait_s
+    while info.get("state") == "draining" and info.get("epoch") == epoch:
+        if time.monotonic() > deadline:
+            log.warning(
+                "resize epoch %d: drain barrier unresolved after %.0fs — "
+                "proceeding alone with world=%d", epoch, max_wait_s,
+                fallback_world,
+            )
+            return fallback_world
+        # co-resident reader leases can't ack while we hold their consumer
+        # thread here; their heartbeat stash may land at any poll, so
+        # service them every iteration (no-op when nothing is stashed)
+        _service_reader_drains(client)
+        time.sleep(poll_s)
+        try:
+            info = client.call(
+                "resize_status", trainer_id=trainer_id, epoch=epoch
+            )
+        except ConnectionError as e:
+            return _barrier_master_lost(epoch, fallback_world, e)
+    if info.get("state") == "go" and info.get("epoch") == epoch:
+        # the status poll that observes `go` is the resumed ack; make sure
+        # one landed even when the drained reply itself already said go
+        try:
+            info = client.call(
+                "resize_status", trainer_id=trainer_id, epoch=epoch
+            )
+        except ConnectionError:
+            # the master decided `go` and then died: the observed world IS
+            # the decision — proceed with it; there is nobody left to ack
+            stats.FT_EVENTS.incr("resize_barrier_master_lost")
+    if info.get("epoch") == epoch and info.get("world"):
+        return int(info["world"])
+    last = info.get("last") or {}
+    if last.get("epoch") == epoch and last.get("world"):
+        # the epoch completed (and went idle) before we looked
+        return int(last["world"])
+    return fallback_world
+
+
+class ResizeClient:
+    """Trainer-side fleet hook for elastic resize (ISSUE 8).
+
+    Registers a membership lease, heartbeats it from a background thread,
+    and watches the heartbeat replies for an announced resize epoch: on
+    `draining` it parks a resize order on the core.preempt guard, which the
+    train loop claims at its next dispatch boundary. Pass `barrier` as
+    `SGDTrainer.train(resize_barrier=...)` — after the trainer's mid-pass
+    drain checkpoint it acks `resize_drained`, blocks until every live
+    member drained (or was evicted), and returns the final world size to
+    re-shard to.
+
+        rc = ResizeClient("host:p1,host:p2")
+        trainer.train(reader, resize_barrier=rc.barrier, ...)
+        rc.close()
+
+    A trainer that ALSO consumes tasks via cluster_reader holds two
+    membership leases (the reader's and this one); both join the drain
+    barrier and both ack — the reader between tasks (without blocking for
+    go), this client at the trainer's batch boundary. When the resize lands
+    mid-task the trainer drains first while it holds the reader's consumer
+    thread, so the barrier acks the reader lease on its behalf
+    (_service_reader_drains) instead of waiting for a boundary that cannot
+    come."""
+
+    def __init__(
+        self,
+        address: EndpointsLike,
+        client_kw: Optional[dict] = None,
+        poll_s: float = 0.1,
+        max_wait_s: float = 120.0,
+    ):
+        self._client = MasterClient(address, **(client_kw or {}))
+        resp = self._client.call("register")
+        if "trainer_id" not in resp:
+            raise ConnectionError(
+                f"resize client could not register with the master: {resp}"
+            )
+        self.trainer_id = resp["trainer_id"]
+        self._ident: Dict[str, Any] = {
+            "trainer_id": self.trainer_id,
+            "lease_s": float(resp.get("lease_s", 10.0)),
+        }
+        self.poll_s = poll_s
+        self.max_wait_s = max_wait_s
+        self._seen: Optional[Tuple[Any, int]] = None
+        self._hb = _Heartbeater(
+            address, self._ident, client_kw=client_kw, on_resize=self._watch
+        ).start()
+
+    def _watch(self, info: Dict[str, Any]) -> None:
+        """Heartbeat-thread hook: turn a newly-announced epoch into a parked
+        resize order (idempotent per epoch — re-announcements of an epoch we
+        already claimed must not re-trigger a drain). The epoch's identity
+        is (master instance, epoch number), compared by equality: epoch
+        numbers are per-master-instance counters, so a restarted/standby
+        master announcing a number we already handled — equal OR lower — is
+        a genuinely new epoch, and suppressing it would silently exempt
+        this trainer from every resize the new master coordinates."""
+        from paddle_tpu.core import preempt
+
+        try:
+            epoch = int(info.get("epoch", 0))
+            world = int(info.get("world", 0))
+        except (TypeError, ValueError):
+            return
+        if info.get("state") != "draining" or world < 1:
+            return
+        key = (info.get("instance"), epoch)
+        if key == self._seen:
+            return
+        self._seen = key
+        preempt.get().request_resize(
+            world, epoch=epoch, instance=info.get("instance") or "",
+            reason="master resize epoch",
+        )
+
+    def barrier(self, req, pass_id: int, batches_done: int) -> int:
+        """The train(resize_barrier=...) callable (see _drain_barrier)."""
+        return _drain_barrier(
+            self._client, self.trainer_id, req.epoch, req.world,
+            poll_s=self.poll_s, max_wait_s=self.max_wait_s,
+        )
+
+    def close(self) -> None:
+        self._hb.stop()
+        try:
+            self._client.call("deregister", trainer_id=self.trainer_id)
+        except ConnectionError:
+            pass  # lease will simply expire
         self._client.close()
 
 
@@ -938,30 +1597,108 @@ def cluster_reader(
     `register=True` the reader takes out a membership lease and renews it
     from a background heartbeat thread, so a trainer that dies mid-task is
     evicted and its tasks re-queued eagerly rather than after the per-task
-    timeout; the lease is released (`deregister`) on a clean pass end."""
+    timeout; the lease is released (`deregister`) on a clean pass end.
+
+    Elastic resize: a registered reader is a drain-barrier MEMBER. When the
+    heartbeat thread sees an announced epoch it stashes the signal on the
+    shared ident; the reader drains at its natural boundary — between task
+    acks, holding no in-flight task (so the master's exactly-once accounting
+    needs no special casing) — and acks `resize_drained` WITHOUT blocking
+    for go (in the two-lease setup the trainer lease's ack, which go also
+    needs, can only happen after this reader yields back to the train loop);
+    the resumed ack rides a `resize_status` poll at a later boundary."""
     import pickle
 
     deserialize = deserialize or pickle.loads
 
+    def _maybe_drain(client: MasterClient, ident: Dict[str, Any]) -> None:
+        tid = ident.get("trainer_id")
+        if tid is None:
+            return
+        pending = ident.get("resize_resume")
+        if pending is not None:
+            # a previous boundary acked the drain without blocking; finish
+            # the epoch's bookkeeping now — a resize_status poll that
+            # observes `go` IS this lease's resumed ack (any other state
+            # means the epoch moved on without us, e.g. closed by the
+            # go-phase timeout or eviction — nothing left to ack)
+            try:
+                st = client.call("resize_status", trainer_id=tid, epoch=pending)
+            except ConnectionError:
+                st = {}
+            if st.get("state") != "draining" or st.get("epoch") != pending:
+                ident.pop("resize_resume", None)
+        info = ident.get("resize")
+        if not info:
+            return
+        try:
+            epoch = int(info.get("epoch", 0))
+        except (TypeError, ValueError):
+            ident.pop("resize", None)
+            return
+        # the epoch's identity is (master instance, number) — see
+        # ResizeClient._watch: a restarted master re-counts from 1, so a
+        # number collision with the last drained epoch of a PREVIOUS
+        # master must not make this reader skip the new master's barrier
+        key = (info.get("instance"), epoch)
+        if info.get("state") != "draining" or key == ident.get("resize_done"):
+            ident.pop("resize", None)
+            return
+        # ack the drain WITHOUT blocking for go: when the process also runs
+        # a ResizeClient-coordinated trainer on this thread (the documented
+        # two-lease setup), go needs the trainer lease's ack too — and that
+        # ack only happens once this reader yields back to the train loop's
+        # dispatch boundary, so waiting here would serialize into a circular
+        # wait the master could only break by timing out a healthy lease.
+        # The reader holds no in-flight task at this point either way, which
+        # is all the exactly-once accounting needs; the resumed ack rides
+        # the status poll at a later boundary (or, for a pass that ends
+        # first, deregister's barrier drop closes the epoch).
+        faults.maybe_stall("resize_drain_stall")
+        try:
+            client.call("resize_drained", trainer_id=tid, epoch=epoch)
+        except ConnectionError as e:
+            _barrier_master_lost(epoch, int(info.get("world", 0) or 0), e)
+            ident.pop("resize", None)
+            return
+        stats.FT_EVENTS.incr("reader_resize_drain")
+        ident["resize_resume"] = epoch
+        ident["resize_done"] = key
+        ident.pop("resize", None)
+
     def reader() -> Iterator[Any]:
         client = MasterClient(master_address, **(client_kw or {}))
-        ident: Dict[str, Any] = {"trainer_id": None, "lease_s": 10.0}
+        # reader-role lease: joins resize drain barriers (and is drained
+        # between task acks) but does not count toward a membership-
+        # triggered world size — the process's ResizeClient lease does
+        ident: Dict[str, Any] = {
+            "trainer_id": None, "lease_s": 10.0, "role": "reader",
+        }
         hb: Optional[_Heartbeater] = None
         try:
             if register:
-                resp = client.call("register")
+                resp = client.call("register", role="reader")
                 if "trainer_id" in resp:
                     ident["trainer_id"] = resp["trainer_id"]
                     ident["lease_s"] = float(resp.get("lease_s", 10.0))
                     hb = _Heartbeater(
                         master_address, ident, client_kw=client_kw
                     ).start()
+                    # visible to a co-resident trainer's drain barrier, which
+                    # acks on our behalf while it holds our consumer thread
+                    # (see _service_reader_drains)
+                    with _READER_IDENTS_LOCK:
+                        _READER_IDENTS.append(ident)
             id_kw = (
                 {"trainer_id": ident["trainer_id"]}
                 if ident["trainer_id"] is not None
                 else {}
             )
             while True:
+                # between-task boundary: no task leased to us right now, so
+                # joining a resize drain barrier here keeps the master's
+                # todo/pending/done books untouched
+                _maybe_drain(client, ident)
                 resp = client.call("get_task", **id_kw)
                 if resp.get("pass_finished"):
                     return
@@ -1000,6 +1737,10 @@ def cluster_reader(
         finally:
             if hb is not None:
                 hb.stop()
+            with _READER_IDENTS_LOCK:
+                _READER_IDENTS[:] = [
+                    d for d in _READER_IDENTS if d is not ident
+                ]
             if ident["trainer_id"] is not None:
                 try:
                     client.call("deregister", trainer_id=ident["trainer_id"])

@@ -49,6 +49,37 @@ def _to_numpy_tree(tree: Any) -> Dict[str, np.ndarray]:
     return flat
 
 
+def tree_shape_mismatches(
+    template: Any, flat: Dict[str, np.ndarray]
+) -> List[Tuple[str, Tuple[int, ...], Tuple[int, ...]]]:
+    """(key, expected_shape, found_shape) for every `flat` entry whose shape
+    disagrees with the matching `template` leaf. restore_tree silently keeps
+    the template value for those — callers that must NOT lose state (the
+    trainer's optimizer resume) turn a non-empty result into a hard error
+    naming expected vs found shard counts instead of resuming with silently
+    re-initialized slots."""
+    out: List[Tuple[str, Tuple[int, ...], Tuple[int, ...]]] = []
+    leaves, _ = jax.tree_util.tree_flatten_with_path(template)
+    for path, leaf in leaves:
+        key = _path_key(path)
+        if key in flat and tuple(np.shape(flat[key])) != tuple(np.shape(leaf)):
+            out.append((key, tuple(np.shape(leaf)), tuple(np.shape(flat[key]))))
+    return out
+
+
+def tree_missing_keys(template: Any, flat: Dict[str, np.ndarray]) -> List[str]:
+    """Template leaf paths with NO entry in `flat` at all. restore_tree
+    keeps the template's (freshly initialized) value for those — for state
+    that must round-trip exactly (the trainer's optimizer slots), a missing
+    key is the same silent wrong resume as a shape mismatch, just invisible
+    to tree_shape_mismatches (which only compares keys present in both)."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(template)
+    return [
+        key for path, _leaf in leaves
+        if (key := _path_key(path)) not in flat
+    ]
+
+
 def restore_tree(template: Any, flat: Dict[str, np.ndarray]) -> Any:
     """Rebuild a pytree shaped like `template` from a flat path→array dict
     (inverse of _to_numpy_tree). Leaves missing from `flat` or with mismatched

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import faults, preempt, stats
+from paddle_tpu.obs import metrics as obs_metrics
 from paddle_tpu.obs import trace
 from paddle_tpu.data.pipeline import StackedBatch
 from paddle_tpu.data.pipeline import coerce_batch as _coerce_batch
@@ -189,6 +190,10 @@ class SGDTrainer:
             else 0
         )
         self.state: Optional[TrainState] = None
+        # set by resize_to: gates the per-dispatch stale-plan check on
+        # StackedBatch groups — straggler batches sharded for an old mesh
+        # can only exist once a resize happened in this process
+        self._resized = False
         self._step_fn = None
         self._multi_fn = None  # K-step fused dispatch (make_multi_step), lazy
         self._eval_fn = None
@@ -201,6 +206,11 @@ class SGDTrainer:
         # (save_dir, pass_id) of the newest checkpoint this trainer wrote or
         # loaded — lets _rollback skip a full CRC re-scan per divergence event
         self._known_good_pass: Optional[tuple] = None
+        # elastic resize bookkeeping: completed-epoch log (drain/reshard/
+        # resume latency split, surfaced per pass in EndPass metrics) and the
+        # in-flight marker consumed by the first post-reshard dispatch
+        self._resize_log: List[Dict[str, Any]] = []
+        self._resize_mark: Optional[Dict[str, Any]] = None
 
     # -- state ---------------------------------------------------------------
     def init_state(self, sample_batch: Dict[str, Any]) -> TrainState:
@@ -399,6 +409,7 @@ class SGDTrainer:
         keep_last_n: Optional[int] = None,
         steps_per_dispatch: int = 1,
         async_checkpoint: bool = True,
+        resize_barrier: Optional[Callable] = None,
     ) -> TrainState:
         """reader yields batches (lists of samples if feeder given, else dicts
         of arrays). One call = `num_passes` passes (v1 --num_passes).
@@ -422,6 +433,16 @@ class SGDTrainer:
         are not collected on the fused path). A trailing remainder (pass end,
         shape change, reader exhaustion) runs through single-step dispatches,
         so a K-fused pass applies exactly the same updates as K=1.
+
+        resize_barrier: fleet hook for elastic resize (see resize_to /
+        runtime.master.ResizeClient). When a resize is requested
+        (preempt.request_resize, set locally or by a master heartbeat
+        watcher), the loop drains at the next dispatch boundary, writes a
+        mid-pass checkpoint, calls `resize_barrier(req, pass_id,
+        batches_done)` — which acks the master's drain barrier, blocks until
+        every live trainer drained, and returns the final world size — then
+        re-shards and CONTINUES the pass on the new mesh. None (default)
+        resizes immediately to the requested world (single-trainer mode).
 
         async_checkpoint (default on): pass-boundary and preempt-drain saves
         copy the state to host with non-blocking fetches and hand all file
@@ -476,7 +497,7 @@ class SGDTrainer:
                     reader, pass_id, event_handler, feeder, test_reader,
                     save_dir, log_period, keep_last_n, steps_per_dispatch,
                     async_checkpoint, resume_pass, resume_mid, resume_skip,
-                    resume_pending,
+                    resume_pending, resize_barrier,
                 )
             if resume_pending:
                 # every requested pass was already checkpointed — nothing ran,
@@ -535,6 +556,7 @@ class SGDTrainer:
         resume_mid: bool,
         resume_skip: int,
         resume_pending: bool,
+        resize_barrier: Optional[Callable] = None,
     ) -> bool:
         """One training pass of the async execution runtime. Returns the
         (possibly cleared) resume_pending flag.
@@ -563,6 +585,7 @@ class SGDTrainer:
         # whenever the prefetcher does the padding
         pass_pad0 = stats.DATA_EVENTS.get("padded_batches")
         pass_div0 = self._diverged_seen
+        pass_rz0 = len(self._resize_log)  # resize epochs completed this pass
         steps_since_poll = 0
         pending: List[tuple] = []  # [(logical batch id, feed-ready batch)]
         pending_sig: Optional[tuple] = None  # shared signature of `pending`
@@ -624,6 +647,10 @@ class SGDTrainer:
                         cost, extras = costs[-1], {}
                     if stats.GLOBAL_STATS.enabled:
                         jax.block_until_ready(cost)  # sync-ok: opt-in timing only
+            if self._resize_mark is not None:
+                # first dispatch on the post-resize mesh returned (compile
+                # included): close the resume leg of the resize latency split
+                self._note_resize_resumed()
             # pass-cost accumulation never syncs: in guard mode the compiled
             # step itself accumulates state["cost_acc"] (with the divergence
             # revert masking poisoned entries), otherwise accumulate with one
@@ -682,6 +709,32 @@ class SGDTrainer:
                 self._drain_preempt(
                     save_dir, pass_id, done, keep_last_n, async_checkpoint
                 )
+            if self.state is not None and preempt.resize_requested():
+                # elastic resize at the same boundary discipline, but
+                # COOPERATIVE: buffered batches flush on the old mesh first
+                # (they were padded for its data axis), then _drain_resize
+                # checkpoints, passes the fleet barrier, re-shards, and
+                # returns — the current raw batch runs on the NEW mesh
+                flush_pending()
+                done = boundary
+                if resume_mid and pass_id == resume_pass:
+                    done = max(done, resume_skip)
+                self._drain_resize(
+                    save_dir, pass_id, done, keep_last_n, async_checkpoint,
+                    resize_barrier,
+                )
+                rebind = getattr(reader, "rebind_parallel", None)
+                if rebind is not None:
+                    # a DevicePrefetcher keeps padding/sharding for the mesh
+                    # it was built with — point it at the post-resize plan so
+                    # only its <= depth in-flight batches take the straggler
+                    # rebuild path, not the rest of the run (no-op when the
+                    # resize was rejected or claimed elsewhere)
+                    rebind(self.parallel)
+                if cost_sum_dev is not None and self.parallel is not None:
+                    # migrate the pass-cost accumulator: an array committed
+                    # to the old mesh cannot join new-mesh computations
+                    cost_sum_dev = self.parallel.replicate(cost_sum_dev)
             if (
                 resume_skip
                 and pass_id == resume_pass
@@ -706,12 +759,31 @@ class SGDTrainer:
                 skip = 0
                 if resume_skip and pass_id == resume_pass and idx0 < resume_skip:
                     skip = resume_skip - idx0  # group straddles the boundary
-                if skip:
+                mismatched = (
+                    self._resized
+                    and self.parallel is not None
+                    and not self.parallel.is_sharded_batches(dict(raw))
+                )
+                if skip or mismatched:
                     for j in range(skip, k_item):
-                        dispatch(
-                            idx0 + j, idx0 + j,
-                            {k: v[j] for k, v in raw.items()}, 1,
-                        )
+                        b = {k: v[j] for k, v in raw.items()}
+                        if mismatched:
+                            # post-resize straggler from a prefetcher still
+                            # bound to the OLD mesh: its slots are committed
+                            # to old-mesh devices and padded to the old
+                            # shard multiple — rebuild each sub-batch on
+                            # host and re-pad/re-shard for the current plan
+                            # instead of feeding the new compiled program
+                            # incompatible arrays
+                            b = {k: np.asarray(v) for k, v in b.items()}
+                            b = self.parallel.maybe_pad_batch(
+                                b,
+                                where=f"train batch {idx0 + j} (post-resize)",
+                            )
+                            if b is None:
+                                continue
+                            b = self.parallel.shard_batch(b)
+                        dispatch(idx0 + j, idx0 + j, b, 1)
                 else:
                     # plain dict: the subclass is a marker, not a pytree node
                     dispatch(idx0, idx0 + k_item - 1, dict(raw), k_item)
@@ -784,6 +856,10 @@ class SGDTrainer:
                 del pending[:]
                 pending_sig = None
         flush_pending()  # trailing remainder: fewer than K batches left
+        if self._resize_mark is not None:
+            # resize landed at the pass's last boundary — no dispatch after
+            # it; close the split with the (near-zero) resume leg here
+            self._note_resize_resumed()
         # final guard poll: the bounded reaction window never crosses a pass
         # boundary (the pass-end checkpoint must not absorb unexamined NaNs)
         if guard_on and self.state is not None:
@@ -808,6 +884,14 @@ class SGDTrainer:
                 stats.DATA_EVENTS.get("padded_batches") - pass_pad0
             ),
         }
+        pass_resizes = self._resize_log[pass_rz0:]
+        if pass_resizes:
+            # elastic resize observability: epochs completed this pass and
+            # their drain/re-shard/resume latency split (chaos_bench --mode
+            # resize reads these; the fleet aggregate gets the same numbers
+            # via obs_metrics.observe_resize on the heartbeat snapshot)
+            metrics["resize_epochs"] = len(pass_resizes)
+            metrics["resizes"] = pass_resizes
         if self.parallel is not None and self.state is not None:
             # memory/comms observability for the sharded update: per-chip
             # resident bytes from sharding METADATA (no device sync — hot-loop
@@ -935,6 +1019,197 @@ class SGDTrainer:
             f"checkpointed to {saved}" if saved else "no checkpoint",
         )
         raise Preempted(pass_id, batches_done, saved, guard.reason)
+
+    # -- elastic resize (ISSUE 8) --------------------------------------------
+    def resize_to(self, world: int, devices: Optional[Sequence] = None) -> None:
+        """Re-shard the LIVE train state onto a mesh whose data axis spans
+        `world` chips — the elastic-resize seam. Values are preserved
+        exactly: params/states/counters are replicated (placement-only move),
+        and optimizer slots cross through the updater's canonical per-param
+        layout (PR 5's checkpoint-portability seam) before re-flattening for
+        the new shard count, so a resized run resumes bitwise from where the
+        old mesh stopped. Compiled step/eval programs are dropped and rebuilt
+        lazily for the new mesh. Composes with shard_update (the
+        ShardedUpdater rebinds its [n, chunk] geometry) and K-step dispatch
+        (the multi-step program rebuilds too)."""
+        assert self.state is not None, "resize_to needs live state"
+        if self.parallel is None:
+            raise ValueError(
+                "resize_to needs a DataParallel trainer "
+                "(SGDTrainer(parallel=...)): there is no mesh to re-shape"
+            )
+        from paddle_tpu.core.init_ctx import detach_compilation_cache
+        from paddle_tpu.parallel import DataParallel
+        from paddle_tpu.parallel.mesh import resize_mesh
+
+        old = self.parallel
+        new_mesh = resize_mesh(old.mesh, old.batch_axis, world, devices)
+        new_parallel = DataParallel(
+            new_mesh, batch_axis=old.batch_axis, param_attrs=old.param_attrs
+        )
+        # A resized process must never again execute a persistent-cache-
+        # DESERIALIZED multi-device program: the re-shard's eager programs
+        # and the train loop's small unsalted helpers (cost-sum adds) repeat
+        # byte-identically across trainer generations, and on jax 0.4.37
+        # CPU a deserialized one corrupts memory or segfaults (see __init__
+        # _cache_salt note). Sticky by design — a scoped opt-out around the
+        # re-shard alone proved insufficient.
+        detach_compilation_cache("elastic resize")
+        # canonical layout is the portable waypoint: gather ZeRO-flat
+        # slots back to parameter shapes on the OLD updater...
+        canonical = self.updater.to_canonical(self.state["opt"])
+        if faults.get().fire("reshard_kill"):
+            # chaos hook: the process dies mid-re-shard — after the
+            # drain checkpoint, before the new mesh runs; auto_resume
+            # must replay the pass from the drained boundary on the new
+            # world size
+            raise faults.InjectedKill("injected reshard_kill (chaos)")
+        # ...then re-flatten for the NEW shard count and place every
+        # leaf on its new-mesh sharding (ZeRO leaves land directly
+        # 1/n-resident)
+        new_updater = self.updater.rebind(new_parallel, self.state["params"])
+        state = dict(self.state)
+        state["opt"] = new_updater.from_canonical(canonical)
+        self.parallel = new_parallel
+        self.updater = new_updater
+        self.state = new_parallel.shard_state(
+            state, opt_sharding=new_updater.opt_leaf_sharding
+        )
+        self._step_fn = None
+        self._multi_fn = None
+        self._eval_fn = None
+        self._resized = True
+
+    def _drain_resize(
+        self,
+        save_dir: Optional[str],
+        pass_id: int,
+        batches_done: int,
+        keep_last_n: Optional[int],
+        async_checkpoint: bool,
+        barrier: Optional[Callable] = None,
+    ) -> None:
+        """Cooperative resize drain at a dispatch boundary (NO process exit):
+        fold any open guard window, persist a durable mid-pass checkpoint (a
+        crash during the re-shard resumes from exactly this boundary), pass
+        the fleet drain barrier (when master-coordinated), re-shard onto the
+        new world size, and return to the train loop — the interrupted pass
+        continues on the new mesh with the very next batch."""
+        req = preempt.get().take_resize()
+        if req is None:
+            return  # another poller claimed it
+        if self.parallel is None:
+            log.warning(
+                "resize request (%s) ignored: this trainer has no "
+                "DataParallel mesh to re-shape", req.reason,
+            )
+            return
+        if self.state is not None and self.divergence_policy is not None:
+            # unexamined guard window folds into telemetry before the state
+            # crosses the mesh boundary (no policy reaction mid-drain)
+            self._poll_guard(pass_id, batches_done, save_dir, react=False)
+        saved: Optional[str] = None
+        if self.state is not None and save_dir is not None:
+            saved = self.save(
+                save_dir, pass_id, keep_last_n=keep_last_n,
+                mid_pass_batches=batches_done, async_=async_checkpoint,
+            )
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.wait()  # durable BEFORE the mesh moves
+            self._known_good_pass = (save_dir, pass_id)
+        if barrier is None:
+            # local mode has no _drain_barrier leg, so the stall site hooks
+            # here; fleet mode stalls inside the barrier itself (one hook
+            # point per drain, never both)
+            faults.maybe_stall("resize_drain_stall")
+            world = req.world
+        else:
+            # fleet mode: ack `resize_drained` and block until the master's
+            # go (every live trainer drained or was evicted); the returned
+            # world supersedes the announced one after membership churn
+            world = int(barrier(req, pass_id, batches_done))
+        t_drained = time.monotonic()
+        trace.span_from_monotonic(
+            "train.resize.drain", req.requested_at,
+            attrs={"epoch": req.epoch, "pass": pass_id, "batch": batches_done},
+        )
+        stats.FT_EVENTS.incr("resize_drain")
+        if world == self.parallel.data_axis_size:
+            # drain-only epoch (membership churn cancelled out, or the
+            # fleet decided the size this trainer already runs): nothing to
+            # re-shard — and no reason to pay the irreversible compile-cache
+            # detach or a recompile for a no-op
+            log.info(
+                "resize epoch %d: already at world %d — drain-only, no "
+                "re-shard", req.epoch, world,
+            )
+        else:
+            try:
+                self.resize_to(world)
+            except ValueError as e:
+                # a bad announce (e.g. join/evict policy counting TRAINERS
+                # on a host without that many devices) must reject the
+                # resize, not kill a drained-and-checkpointed trainer
+                # mid-pass; training continues on the current mesh
+                stats.FT_EVENTS.incr("resize_rejected")
+                log.error(
+                    "resize epoch %d to world=%d rejected: %s — continuing "
+                    "the pass on the current %d-chip mesh",
+                    req.epoch, world, e, self.parallel.data_axis_size,
+                )
+                return
+        t_resharded = time.monotonic()
+        trace.span_from_monotonic(
+            "train.resize.reshard", t_drained, attrs={"world": world},
+        )
+        log.warning(
+            "resize drain at pass %d batch %d (%s): %s; data axis now %d "
+            "chip(s) (epoch %d) — resuming the interrupted pass",
+            pass_id, batches_done, req.reason,
+            f"checkpointed to {saved}" if saved else "no checkpoint",
+            world, req.epoch,
+        )
+        self._resize_mark = {
+            "epoch": req.epoch,
+            "world": world,
+            "pass": pass_id,
+            "batch": batches_done,
+            "drain_s": t_drained - req.requested_at,
+            "reshard_s": t_resharded - t_drained,
+            "t_resharded": t_resharded,
+        }
+
+    def _note_resize_resumed(self) -> None:
+        """Close out an in-flight resize once the first post-re-shard
+        dispatch returned (or at pass end when the resize was the pass's
+        last boundary): records the resume leg of the latency split, the
+        resize span/metrics, and the per-pass log entry."""
+        m, self._resize_mark = self._resize_mark, None
+        resume_s = time.monotonic() - m["t_resharded"]
+        trace.span_from_monotonic(
+            "train.resize.resume", m["t_resharded"],
+            attrs={"epoch": m["epoch"], "world": m["world"]},
+        )
+        split = {
+            "drain": m["drain_s"], "reshard": m["reshard_s"],
+            "resume": resume_s,
+        }
+        obs_metrics.observe_resize(split)
+        stats.FT_EVENTS.incr("resize_epoch")
+        self._resize_log.append({
+            "epoch": m["epoch"],
+            "world": m["world"],
+            "pass": m["pass"],
+            "batch": m["batch"],
+            "drain_s": round(split["drain"], 6),
+            "reshard_s": round(split["reshard"], 6),
+            "resume_s": round(split["resume"], 6),
+        })
+        log.info(
+            "resize epoch %d complete: world=%d drain=%.3fs reshard=%.3fs "
+            "resume=%.3fs", m["epoch"], m["world"], split["drain"],
+            split["reshard"], split["resume"],
+        )
 
     def _rollback(self, save_dir: Optional[str], pass_id: int, batch_id: int) -> None:
         """Divergence rollback: restore the newest valid checkpoint and halve
@@ -1064,6 +1339,14 @@ class SGDTrainer:
             extra_meta = {
                 "samples": int(self.state["samples"]),
                 "lr_scale": float(self.state["lr_scale"]),
+                # world-size provenance: canonical checkpoints LOAD across
+                # world sizes (the resize story), but load() uses this to
+                # give a precise error when a non-canonical/foreign opt tree
+                # sneaks in with the wrong shard count
+                "world_size": (
+                    self.parallel.data_axis_size
+                    if self.parallel is not None else 1
+                ),
             }
             if mid_pass_batches is not None:
                 extra_meta["mid_pass"] = True
@@ -1121,6 +1404,70 @@ class SGDTrainer:
             template = {"opt": self.updater.to_canonical(self.state["opt"])}
             if self.state["avg"]:
                 template["avg"] = self.state["avg"]
+            # pin the cross-world-size contract: canonical checkpoints load
+            # on ANY world size, so a shape mismatch here means the opt tree
+            # was written as raw per-shard state (pre-canonical or foreign)
+            # — restore_tree would silently keep freshly-initialized slots,
+            # which is a wrong resume; fail loudly instead, naming shapes
+            # and shard counts
+            def _raw_shard_error(reason: str) -> ValueError:
+                found_world = manifest.get("extra", {}).get("world_size")
+                mine = (
+                    self.parallel.data_axis_size
+                    if self.parallel is not None else 1
+                )
+                return ValueError(
+                    f"checkpoint under {save_dir!r} holds optimizer state "
+                    f"that does not match this trainer's canonical layout: "
+                    f"{reason}. The checkpoint records world_size="
+                    f"{found_world}, this trainer runs world_size={mine}; "
+                    f"canonical checkpoints are world-size-portable, so the "
+                    f"opt tree was saved as raw per-shard state — re-export "
+                    f"it through the updater's to_canonical seam before "
+                    f"resuming"
+                )
+
+            def _clip(items):
+                more = f" (+{len(items) - 4} more)" if len(items) > 4 else ""
+                return items[:4], more
+
+            mism = ckpt_mod.tree_shape_mismatches(template, opt_flat)
+            if mism:
+                head, more = _clip(mism)
+                detail = "; ".join(
+                    f"{k}: expected {exp} found {got}"
+                    for k, exp, got in head
+                )
+                raise _raw_shard_error(f"{detail}{more}")
+            missing = [
+                k for k in ckpt_mod.tree_missing_keys(template, opt_flat)
+                if k.startswith("opt")
+            ]
+            if missing:
+                all_opt = ckpt_mod.tree_missing_keys(
+                    {"opt": template["opt"]}, {}
+                )
+                head, more = _clip(missing)
+                names = ", ".join(head)
+                if len(missing) == len(all_opt):
+                    # zero key overlap: restore_tree would restore NOTHING
+                    # and the trainer would resume on entirely fresh slots
+                    # — the foreign-writer / raw-per-shard failure mode the
+                    # shape guard cannot see (no common key to compare)
+                    raise _raw_shard_error(
+                        f"no entry for {names}{more}, so restore_tree "
+                        f"would silently keep freshly-initialized slots"
+                    )
+                # partial overlap is the documented lenient contract:
+                # slots resume when the structure matches, structure new
+                # since the save (e.g. momentum turned on) starts fresh —
+                # say so instead of doing it silently
+                log.warning(
+                    "checkpoint %s: optimizer tree has no entry for %s%s; "
+                    "those slots start freshly initialized, everything "
+                    "else resumes",
+                    save_dir, names, more,
+                )
             restored = ckpt_mod.restore_tree(template, opt_flat)
             self.state["opt"] = self.updater.from_canonical(restored["opt"])
             if "avg" in restored:

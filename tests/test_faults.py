@@ -81,6 +81,10 @@ def test_fault_spec_parsing():
     assert spec["nan_loss"].step == 37
     assert faults.parse_spec("io_delay:1.5s")["io_delay"].delay_s == 1.5
     assert faults.parse_spec("") == {}
+    # the elastic-resize sites (ISSUE 8) ride the same grammar/seeding
+    rz = faults.parse_spec("resize_drain_stall:step=0,reshard_kill:0.5")
+    assert rz["resize_drain_stall"].step == 0
+    assert rz["reshard_kill"].prob == 0.5
     # durations are only meaningful on *_delay sites ("kill:5s" would
     # otherwise silently mean "kill every batch")
     for bad in ("nan_loss", "x:1.5", "x:-0.1", "x:abc", "x:step=q",

@@ -394,6 +394,127 @@ def test_checkpoint_roundtrip_async_sharded(tmp_path):
     _assert_bitwise(_params(tr3), _params(tr2), "async sharded resume")
 
 
+def test_cross_world_size_load_is_exact_and_records_world(tmp_path):
+    """The POSITIVE half of the world-size contract: canonical checkpoints
+    are world-size-portable — a 2-chip sharded save resumes on a 4-chip
+    sharded trainer with identical values — and the manifest records the
+    writer's world size."""
+    from paddle_tpu.trainer import checkpoint as ckpt_mod
+
+    reset_name_scope()
+    x, y = _data(64)
+    dp = DataParallel(make_mesh({"data": 2}))
+    tr1 = SGDTrainer(_build(), SGD(learning_rate=0.125, momentum=0.5),
+                     parallel=dp, seed=5, shard_update=True)
+    tr1.train(_reader(x, y), num_passes=1, save_dir=str(tmp_path))
+    tr1.checkpoint_wait()
+    assert ckpt_mod.pass_manifest(str(tmp_path), 0)["extra"]["world_size"] == 2
+
+    reset_name_scope()
+    dp4 = DataParallel(make_mesh({"data": 4}))
+    tr2 = SGDTrainer(_build(), SGD(learning_rate=0.125, momentum=0.5),
+                     parallel=dp4, seed=5, shard_update=True)
+    tr2.init_state(dp4.shard_batch({"x": x[:32], "label": y[:32]}))
+    tr2.load(str(tmp_path), 0)
+    _assert_bitwise(_params(tr1), _params(tr2), "2->4 canonical load")
+    c1 = tr1.updater.to_canonical(tr1.state["opt"])
+    c2 = tr2.updater.to_canonical(tr2.state["opt"])
+    for k, slots in c1["slots"].items():
+        for a, b in zip(slots, c2["slots"][k]):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), k
+
+
+def test_mismatched_world_size_opt_state_fails_loudly(tmp_path):
+    """The NEGATIVE half (ISSUE 8 satellite): an opt tree written as RAW
+    per-shard state (bypassing the to_canonical seam — the pre-canonical /
+    foreign-writer failure mode) must fail the resume with an error naming
+    the expected vs found shapes and both world sizes. Before this contract,
+    restore_tree silently kept freshly-initialized slots — a wrong resume
+    that trained on, or crashed deep in jax."""
+    from paddle_tpu.trainer import checkpoint as ckpt_mod
+
+    reset_name_scope()
+    x, y = _data(64)
+    dp = DataParallel(make_mesh({"data": 4}))
+    tr1 = SGDTrainer(_build(), SGD(learning_rate=0.125, momentum=0.5),
+                     parallel=dp, seed=5, shard_update=True)
+    tr1.train(_reader(x, y), num_passes=1)
+    # write the RAW flat [4, chunk] slots, NOT the canonical layout
+    ckpt_mod.save_pass(
+        str(tmp_path), 0, tr1.state["params"], tr1.state["states"],
+        {"opt": tr1.state["opt"]},
+        extra_meta={"samples": 64, "world_size": 4},
+    )
+
+    reset_name_scope()
+    dp2 = DataParallel(make_mesh({"data": 2}))
+    tr2 = SGDTrainer(_build(), SGD(learning_rate=0.125, momentum=0.5),
+                     parallel=dp2, seed=5, shard_update=True)
+    tr2.init_state(dp2.shard_batch({"x": x[:32], "label": y[:32]}))
+    with pytest.raises(ValueError) as ei:
+        tr2.load(str(tmp_path), 0)
+    msg = str(ei.value)
+    assert "expected" in msg and "found" in msg
+    assert "world_size=4" in msg and "world_size=2" in msg
+    assert "to_canonical" in msg
+
+
+def test_disjoint_key_opt_state_fails_loudly(tmp_path):
+    """The shape guard's blind spot: a raw opt tree whose key PATHS don't
+    overlap the canonical template at all (e.g. a foreign writer's naming)
+    produces zero shape mismatches — every template leaf is simply missing
+    from the checkpoint, and restore_tree silently keeps freshly-initialized
+    slots. The missing-keys guard must turn that into the same loud error."""
+    from paddle_tpu.trainer import checkpoint as ckpt_mod
+
+    reset_name_scope()
+    x, y = _data(64)
+    dp = DataParallel(make_mesh({"data": 2}))
+    tr1 = SGDTrainer(_build(), SGD(learning_rate=0.125, momentum=0.5),
+                     parallel=dp, seed=5, shard_update=True)
+    tr1.train(_reader(x, y), num_passes=1)
+    # alien key layout: truthy opt tree, zero keys in common with canonical
+    ckpt_mod.save_pass(
+        str(tmp_path), 0, tr1.state["params"], tr1.state["states"],
+        {"opt": {"alien_slot": np.zeros(3, np.float32)}},
+        extra_meta={"samples": 64, "world_size": 4},
+    )
+
+    reset_name_scope()
+    dp2 = DataParallel(make_mesh({"data": 2}))
+    tr2 = SGDTrainer(_build(), SGD(learning_rate=0.125, momentum=0.5),
+                     parallel=dp2, seed=5, shard_update=True)
+    tr2.init_state(dp2.shard_batch({"x": x[:32], "label": y[:32]}))
+    with pytest.raises(ValueError) as ei:
+        tr2.load(str(tmp_path), 0)
+    msg = str(ei.value)
+    assert "no entry for" in msg
+    assert "world_size=4" in msg and "world_size=2" in msg
+    assert "to_canonical" in msg
+
+
+def test_optimizer_structure_growth_still_resumes(tmp_path):
+    """The POSITIVE half of the missing-keys guard: partial key overlap is
+    the documented structure-change contract (docstring of load: 'optimizer
+    slots (when the structure matches)'). A checkpoint saved before momentum
+    was turned on must still resume — new slots start fresh with a warning,
+    everything else (params, step counter) restores — instead of tripping
+    the raw-per-shard error meant for zero-overlap foreign trees."""
+    reset_name_scope()
+    x, y = _data(64)
+    tr1 = SGDTrainer(_build(), SGD(learning_rate=0.125, momentum=0.0), seed=5)
+    tr1.train(_reader(x, y), num_passes=1)
+    tr1.save(str(tmp_path), 0)
+    p1 = {k: np.array(v) for k, v in tr1.state["params"].items()}
+
+    reset_name_scope()
+    tr2 = SGDTrainer(_build(), SGD(learning_rate=0.125, momentum=0.5), seed=6)
+    tr2.init_state({"x": x[:32], "label": y[:32]})
+    tr2.load(str(tmp_path), 0)  # must not raise
+    for k, v in tr2.state["params"].items():
+        assert np.array_equal(np.asarray(v), p1[k]), k
+
+
 # -- composition with the async execution runtime ------------------------------
 
 
