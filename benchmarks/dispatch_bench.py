@@ -23,6 +23,12 @@ split host time across hostFeed / forwardBackward / ckptFetch / ckptWrite.
 Enabling timers forces a device sync per dispatch, so that pass measures the
 SPLIT, never the throughput.
 
+ISSUE 9 adds a precision × remat grid leg (`precision_remat` in the JSON):
+f32/bf16 × none/dots (plus "full" with --full), each entry platform-tagged,
+with the compiled step's top-3 HLO cost buckets before (f32/none) and after
+(bf16/dots). The heavy version of this drill runs in the nightly pytest tier
+(tests/test_precision.py::test_nightly_precision_grid_drill).
+
 Usage:
   JAX_PLATFORMS=cpu python benchmarks/dispatch_bench.py [--batches N]
       [--passes N] [--batch_size N] [--dim N] [--hidden N] [--full]
@@ -41,7 +47,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_trainer(args, guard):
+def build_trainer(args, guard, precision=None, remat=None):
     from paddle_tpu.nn import costs as C
     from paddle_tpu.nn import layers as L
     from paddle_tpu.nn.graph import reset_name_scope
@@ -58,6 +64,7 @@ def build_trainer(args, guard):
         cost, SGD(learning_rate=0.01), seed=0,
         divergence_policy=policy,
         guard_check_every=1 if guard == "off" else int(guard),
+        precision=precision, remat=remat,
     )
 
 
@@ -76,13 +83,16 @@ def make_batches(args):
     ]
 
 
-def run_config(args, batches, guard: str, k: int, async_ckpt: bool) -> dict:
+def run_config(args, batches, guard: str, k: int, async_ckpt: bool,
+               precision=None, remat=None, cost_report=False) -> dict:
     """steps/sec over the timed passes (pass 0 compiles and is excluded);
     the clock stops only after train() returns, i.e. after the async
-    writer's durability barrier."""
+    writer's durability barrier. `cost_report=True` attaches the compiled
+    step's top-3 HLO cost buckets (obs.profile.trainer_cost_report on the
+    trainer this run already built — no rebuild) as `hlo_cost`."""
     from paddle_tpu.trainer import EndPass
 
-    trainer = build_trainer(args, guard)
+    trainer = build_trainer(args, guard, precision=precision, remat=remat)
     save_dir = tempfile.mkdtemp(prefix="dispatch_bench_")
     marks = []
 
@@ -106,13 +116,23 @@ def run_config(args, batches, guard: str, k: int, async_ckpt: bool) -> dict:
         shutil.rmtree(save_dir, ignore_errors=True)
     steps = args.batches * args.passes
     dt = t_end - marks[0]  # timed window starts when the warmup pass ended
-    return {
+    out = {
         "guard": guard,
         "steps_per_dispatch": k,
         "checkpoint": "async" if async_ckpt else "sync",
         "steps_per_sec": round(steps / dt, 1),
         "ms_per_step": round(1e3 * dt / steps, 4),
     }
+    if cost_report:
+        from paddle_tpu.obs.profile import trainer_cost_report
+
+        try:
+            out["hlo_cost"] = trainer_cost_report(
+                trainer, batches[0], top_k=3
+            )["executables"]["train_step"]
+        except Exception as exc:  # noqa: BLE001 — report must not kill bench
+            out["hlo_cost"] = {"error": repr(exc)[-200:]}
+    return out
 
 
 def run_timer_split(args, batches) -> dict:
@@ -131,6 +151,44 @@ def run_timer_split(args, batches) -> dict:
     finally:
         enable_timers(False)
         GLOBAL_STATS.reset()
+
+
+def run_precision_grid(args, batches, full: bool) -> dict:
+    """ISSUE 9 grid leg: precision × remat over the same reader, measured
+    through the full train loop (run_config), every entry platform-tagged so
+    trajectory tooling can exclude CPU rounds per entry (bf16 dots are
+    EMULATED on the CPU backend — expect the bf16 legs to lose there; the
+    grid exists to show the MXU-path levers and their composition cost).
+    `hlo_cost` records the compiled step's top-3 FLOP/byte buckets before
+    (f32, no remat) and after (bf16, dots) — the profile-driven-pass
+    bookkeeping of ROADMAP item 2."""
+    import jax
+
+    platform = jax.default_backend()
+    remats = ("none", "dots", "full") if full else ("none", "dots")
+    # the before/after of the profile-driven pass: cost reports come off the
+    # trainers these two grid legs already built (run_config cost_report=)
+    report_legs = {("f32", "none"): "before_f32_none",
+                   ("bf16", "dots"): "after_bf16_dots"}
+    grid, costs = [], {}
+    for precision in ("f32", "bf16"):
+        for remat in remats:
+            leg = report_legs.get((precision, remat))
+            r = run_config(
+                args, batches, guard="off", k=1, async_ckpt=True,
+                precision=precision, remat=remat, cost_report=bool(leg),
+            )
+            if leg:
+                costs[leg] = r["hlo_cost"]
+            grid.append({
+                "precision": precision,
+                "remat": remat,
+                "steps_per_sec": r["steps_per_sec"],
+                "ms_per_step": r["ms_per_step"],
+                "platform": platform,
+            })
+
+    return {"grid": grid, "hlo_cost": costs, "platform": platform}
 
 
 def main():
@@ -217,6 +275,7 @@ def main():
             "steps_per_sec": best,
         },
         "grid": results,
+        "precision_remat": run_precision_grid(args, batches, args.full),
         "tracing_enabled": tracing,
         "timer_split_instrumented": run_timer_split(args, batches),
         "batches_per_pass": args.batches,

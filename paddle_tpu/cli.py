@@ -11,6 +11,7 @@ Usage:
         [--init_model_path=DIR] [--start_pass=N] [--log_period=N] [--job=train|test|time]
         [--auto_resume=1] [--divergence_policy=skip_batch|rollback|raise]
         [--shard_update=1] [--grad_compression=none|bf16|int8]
+        [--precision=f32|bf16] [--remat=none|dots|conv_only|full]
         [--guard_check_every=N] [--steps_per_dispatch=K] [--async_checkpoint=0|1]
         [--keep_last_n=N] [--faults=SPEC]
         [--master_endpoints=a:p1,b:p2] [--preempt_grace_s=S] [--elastic=1]
@@ -57,6 +58,26 @@ def _train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--saving_period", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
+    p.add_argument(
+        "--precision", default=None, choices=[None, "f32", "bf16"],
+        help="mixed-precision policy for THIS trainer's compiled step: bf16 "
+             "casts dot/conv inputs to bfloat16 (the MXU-native path) while "
+             "parameters stay float32 masters in the optimizer and in "
+             "checkpoints — a bf16-trained checkpoint resumes bitwise into an "
+             "f32 run and vice versa. Softmax/xent, batch-norm statistics, "
+             "cost averaging and the divergence guard stay f32 regardless. "
+             "Default: f32 (or the process-wide --dtype policy when set)",
+    )
+    p.add_argument(
+        "--remat", default=None,
+        choices=[None, "none", "dots", "conv_only", "full"],
+        help="backward rematerialization policy: 'dots' keeps matmul/conv "
+             "outputs and recomputes the elementwise rest (frees activation "
+             "residual HBM for larger per-chip batch), 'conv_only' keeps "
+             "only tagged conv outputs, 'full' recomputes the whole forward. "
+             "Recomputation replays the same ops, so the applied updates "
+             "never change — only step time and residual memory",
+    )
     p.add_argument("--job", default="train", choices=["train", "test", "time"])
     p.add_argument("--num_batches", type=int, default=20, help="--job=time batches")
     p.add_argument(
@@ -428,6 +449,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         model_average=bundle.model_average,
         parallel=parallel,
         seed=args.seed,
+        remat=args.remat,
+        precision=args.precision,
         divergence_policy=args.divergence_policy,
         guard_check_every=args.guard_check_every,
         shard_update=args.shard_update,

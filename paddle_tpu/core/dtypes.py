@@ -9,6 +9,7 @@ bfloat16, and accumulation uses ``preferred_element_type=float32``.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import dataclasses
 from typing import Optional
 
@@ -26,10 +27,24 @@ class Policy:
     # tests. For bf16 compute the inputs are already bf16 — DEFAULT is right.
     precision: lax.Precision = lax.Precision.HIGHEST
 
-    def cast_compute(self, x):
+    @property
+    def name(self) -> str:
+        """Canonical short name ("f32" | "bf16") for JSON/log reporting."""
+        return "bf16" if self.compute_dtype == jnp.bfloat16 else "f32"
+
+    def cast(self, x):
+        """THE sanctioned precision-cast boundary: floating arrays move to
+        the compute dtype, everything else (ints, bools, already-converted
+        arrays) passes through. Every dot/conv input cast in the compiled
+        train step must go through here — tests/test_lint_hotloop.py bans
+        raw `.astype(` in the step body so the policy stays auditable."""
         if x.dtype != self.compute_dtype and jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(self.compute_dtype)
         return x
+
+    # pre-PR-9 name; ops call sites use cast() now, kept for any out-of-tree
+    # callers of the old spelling
+    cast_compute = cast
 
 
 _F32 = Policy()
@@ -42,27 +57,32 @@ _BF16 = Policy(
     precision=lax.Precision.DEFAULT,
 )
 
-_current: Policy = _F32
+# Context-local, not a module global: Network.init/apply wrap every trace in
+# policy_scope, and traces can run concurrently (a serving/inference thread
+# jitting a forward while the trainer traces its step) — a module global
+# would let one thread's scope leak bf16 dots into another thread's program.
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "paddle_tpu_dtype_policy", default=_F32
+)
 
 
 def current() -> Policy:
-    return _current
+    return _current.get()
 
 
 def set_policy(policy: Policy) -> None:
-    global _current
-    _current = policy
+    """Sets the ambient policy for THIS thread/context (contextvar
+    semantics: other threads keep their own ambient, defaulting to f32)."""
+    _current.set(policy)
 
 
 @contextlib.contextmanager
 def policy_scope(policy: Policy):
-    global _current
-    prev = _current
-    _current = policy
+    token = _current.set(policy)
     try:
         yield policy
     finally:
-        _current = prev
+        _current.reset(token)
 
 
 def f32_policy() -> Policy:
@@ -73,9 +93,16 @@ def bf16_policy() -> Policy:
     return _BF16
 
 
+# names accepted by get() / SGDTrainer(precision=) / the CLI --precision flag
+PRECISIONS = ("f32", "bf16")
+
+
 def get(name: Optional[str]) -> Policy:
     if name is None or name == "float32" or name == "f32":
         return _F32
     if name in ("bfloat16", "bf16", "mixed"):
         return _BF16
-    raise ValueError(f"unknown dtype policy {name!r}")
+    raise ValueError(
+        f"unknown dtype policy {name!r}; expected one of {PRECISIONS} "
+        f"(or the long spellings float32/bfloat16)"
+    )

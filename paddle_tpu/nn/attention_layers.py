@@ -5,7 +5,13 @@ RecurrentGradientMachine-driven NMT decoder (the recurrent_group +
 simple_attention + gru_step composition of demo/seq2seq; RecurrentGradientMachine.h:32
 dynamic unroll): one lax.scan over target steps with teacher forcing at train
 time. Generation/beam search lives in paddle_tpu/nn/beam_search.py using the
-same parameters."""
+same parameters.
+
+The jnp attention math here (and in ops/attention.py) is the CPU oracle for
+the fused Pallas attention kernel (ops/pallas/rnn_kernels.attention_seq_fused,
+ISSUE 9): dot_product_attention auto-dispatches to the kernel on TPU, while
+the ADDITIVE (Bahdanau) per-step attention below stays the lax.scan path —
+fusing it into the decoder step is a named ROADMAP item 2 lever."""
 
 from __future__ import annotations
 

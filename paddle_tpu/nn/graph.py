@@ -376,12 +376,22 @@ class Network:
         rng: Array,
         batch: Dict[str, Union[Argument, Array, np.ndarray]],
         train: bool = True,
+        policy: Optional[dtypes.Policy] = None,
     ) -> Tuple[Dict[str, Array], Dict[str, Array]]:
-        """Create params/states by running forward eagerly on a sample batch."""
+        """Create params/states by running forward eagerly on a sample batch.
+
+        `policy` pins the dtype policy for this trace (mixed-precision
+        trainers thread SGDTrainer(precision=...) through here); None falls
+        back to the ambient dtypes.current() global. The whole trace runs
+        under a policy_scope so nested ops that consult the ambient global
+        themselves (ops/rnn, additive attention, beam search) follow THIS
+        trace's policy, not whatever the process global happens to be."""
+        policy = policy or dtypes.current()
         params: Dict[str, Array] = {}
         states: Dict[str, Array] = {}
-        ctx = Context("init", params, states, rng, train)
-        self._run(ctx, batch)
+        with dtypes.policy_scope(policy):
+            ctx = Context("init", params, states, rng, train, policy=policy)
+            self._run(ctx, batch)
         self.param_attrs = dict(ctx.param_attrs)
         return params, states
 
@@ -393,10 +403,16 @@ class Network:
         batch: Dict[str, Any],
         train: bool = False,
         rng: Optional[Array] = None,
+        policy: Optional[dtypes.Policy] = None,
     ) -> Tuple[Dict[str, Argument], Dict[str, Array]]:
-        """Pure forward. Returns ({output_layer_name: Argument}, new_states)."""
-        ctx = Context("apply", params, states, rng, train)
-        values = self._run(ctx, batch)
+        """Pure forward. Returns ({output_layer_name: Argument}, new_states).
+
+        Like init(), the trace is wrapped in a policy_scope so every nested
+        dtypes.current() fallback resolves to this trace's policy."""
+        policy = policy or dtypes.current()
+        with dtypes.policy_scope(policy):
+            ctx = Context("apply", params, states, rng, train, policy=policy)
+            values = self._run(ctx, batch)
         new_states = dict(states)
         new_states.update(ctx.state_updates)
         outs = {l.name: values[l.name] for l in self.outputs}

@@ -42,8 +42,8 @@ def conv2d(
 ) -> Array:
     """x: [B, H, W, Cin], w: [kh, kw, Cin/groups, Cout] → [B, H', W', Cout]."""
     p = policy or dtypes.current()
-    x = p.cast_compute(x)
-    w = p.cast_compute(w)
+    x = p.cast(x)
+    w = p.cast(w)
     if isinstance(padding, str):
         pad = padding  # "SAME" / "VALID"
     else:
@@ -77,8 +77,8 @@ def conv2d_transpose(
 
     w: [kh, kw, Cout, Cin] in HWIO w.r.t. the *forward* conv of the transpose."""
     p = policy or dtypes.current()
-    x = p.cast_compute(x)
-    w = p.cast_compute(w)
+    x = p.cast(x)
+    w = p.cast(w)
     ph, pw = _pair(padding)
     sh, sw = _pair(stride)
     kh, kw = w.shape[0], w.shape[1]
@@ -211,8 +211,8 @@ def conv3d(
 ) -> Array:
     """x: [B, D, H, W, Cin], w: [kd, kh, kw, Cin/groups, Cout]."""
     p = policy or dtypes.current()
-    x = p.cast_compute(x)
-    w = p.cast_compute(w)
+    x = p.cast(x)
+    w = p.cast(w)
     pd, ph, pw = _triple(padding)
     return lax.conv_general_dilated(
         x,
@@ -236,8 +236,8 @@ def conv3d_transpose(
 ) -> Array:
     """Transposed 3D conv (DeConv3DLayer.cpp); w is DHWIO of the forward conv."""
     p = policy or dtypes.current()
-    x = p.cast_compute(x)
-    w = p.cast_compute(w)
+    x = p.cast(x)
+    w = p.cast(w)
     pd, ph, pw = _triple(padding)
     sd, sh, sw = _triple(stride)
     kd, kh, kw = w.shape[0], w.shape[1], w.shape[2]

@@ -19,8 +19,8 @@ Array = jax.Array
 def matmul(a: Array, b: Array, policy: Optional[dtypes.Policy] = None) -> Array:
     """a @ b over the last axis of a / first axis of b, MXU-friendly."""
     p = policy or dtypes.current()
-    a = p.cast_compute(a)
-    b = p.cast_compute(b)
+    a = p.cast(a)
+    b = p.cast(b)
     out = jnp.matmul(
         a, b, preferred_element_type=p.accum_dtype, precision=p.precision
     )

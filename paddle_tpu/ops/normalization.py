@@ -12,7 +12,10 @@ Design (reference behavioral contract: BatchNormLayer.cpp / CudnnBatchNorm,
 per-channel statistics over batch+spatial):
 - statistics in ONE fused pass: sum and sum-of-squares reductions over bf16
   input with the f32 convert fused INTO the reduction (no f32 activation
-  tensor exists in HBM);
+  tensor exists in HBM). This is the "batch-norm statistics stay f32" leg of
+  the mixed-precision contract (SGDTrainer(precision="bf16"), ISSUE 9): the
+  reductions here are f32 REGARDLESS of the policy's compute dtype, by
+  construction, not by Policy.cast;
 - normalize in one elementwise pass (f32 math in registers, bf16 in/out);
 - custom VJP with the minimal pass structure: one fused dual-reduction pass
   (sum(dy), sum(dy*xhat)) + one elementwise pass for dx.

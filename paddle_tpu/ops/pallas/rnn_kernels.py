@@ -440,3 +440,103 @@ def _gru_vjp_bwd(res, grads):
 
 
 gru_seq_fused.defvjp(_gru_vjp_fwd, _gru_vjp_bwd)
+
+
+# ===========================================================================
+# Fused scaled-dot attention forward (ISSUE 9)
+# ===========================================================================
+#
+# One pallas_call per batch row fuses the whole attention forward —
+# scores = scale * q @ k^T, mask, numerically-stable softmax (f32), and the
+# context matmul — so the [Tq, Tk] score/weight tensors live only in VMEM and
+# never round-trip HBM between the four ops XLA would otherwise emit. The
+# jnp path in ops/attention.dot_product_attention stays the CPU oracle (and
+# the source of the backward below: the VJP recomputes the forward in jnp
+# and differentiates it, so training through the fused op is exact-adjoint
+# against the oracle while the kernel accelerates the forward).
+
+# must equal ops/sequence.NEG_INF: a fully-masked row then degrades to the
+# same uniform weights as the oracle instead of NaN
+_ATTN_NEG_INF = -1e9
+
+
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *, scale):
+    q = q_ref[0]  # [Tq, D] f32
+    k = k_ref[0]  # [Tk, D]
+    v = v_ref[0]  # [Tk, Dv]
+    m = mask_ref[0]  # [Mq, Tk] 0/1, Mq in {1, Tq} (broadcast over rows)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    s = jnp.where(m > 0.0, s, _ATTN_NEG_INF)
+    mx = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - mx)
+    w = e / jnp.sum(e, axis=-1, keepdims=True)
+    out_ref[0] = jnp.dot(w, v, preferred_element_type=jnp.float32)
+
+
+def _attn_fwd(scale: float, q, k, v, mask):
+    b, tq, d = q.shape
+    tk = k.shape[1]
+    dv = v.shape[2]
+    mq = mask.shape[1]
+    f32 = jnp.float32
+    out = pl.pallas_call(
+        functools.partial(_attn_fwd_kernel, scale=scale),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, tq, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, tk, dv), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, mq, tk), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tq, dv), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, tq, dv), f32),
+        interpret=interpret_mode(),
+    )(q.astype(f32), k.astype(f32), v.astype(f32), mask.astype(f32))
+    return out.astype(v.dtype)
+
+
+def _attn_oracle(scale: float, q, k, v, mask):
+    """The jnp reference this kernel must match — kept in lockstep with
+    ops/attention.dot_product_attention (the public oracle); the fused op's
+    backward is the exact vjp of THIS function."""
+    logits = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    logits = jnp.where(mask > 0.0, logits, _ATTN_NEG_INF)
+    w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bqk,bkv->bqv", w, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _attn_fused(scale: float, q, k, v, mask):
+    return _attn_fwd(scale, q, k, v, mask)
+
+
+def _attn_vjp_fwd(scale, q, k, v, mask):
+    return _attn_fwd(scale, q, k, v, mask), (q, k, v, mask)
+
+
+def _attn_vjp_bwd(scale, res, g):
+    q, k, v, mask = res
+    # recompute-in-backward: differentiate the jnp oracle (cheap VPU math
+    # relative to storing [Tq, Tk] weights per row) — cotangents are the
+    # oracle's exact adjoints, in the primals' dtypes
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attn_oracle(scale, q_, k_, v_, mask), q, k, v
+    )
+    dq, dk, dv = vjp(g.astype(v.dtype))
+    return dq, dk, dv, jnp.zeros_like(mask)
+
+
+_attn_fused.defvjp(_attn_vjp_fwd, _attn_vjp_bwd)
+
+
+def attention_seq_fused(q: Array, k: Array, v: Array, mask: Array,
+                        scale: float) -> Array:
+    """Fused scaled-dot attention forward: q [B,Tq,D], k [B,Tk,D],
+    v [B,Tk,Dv], mask [B,Mq,Tk] (0/1 float; Mq in {1,Tq}) → [B,Tq,Dv] in
+    v's dtype. `scale` must be a static Python float (it is folded into the
+    kernel). Kernel math runs f32; softmax reductions are f32 regardless of
+    the input dtype (the mixed-precision contract of ops/xent.py applied to
+    attention weights)."""
+    return _attn_fused(float(scale), q, k, v, mask.astype(jnp.float32))

@@ -27,9 +27,12 @@ def mask_from_lengths(lengths: Array, max_len: int, dtype=jnp.float32) -> Array:
 
 def seq_softmax(x: Array, lengths: Array) -> Array:
     """Softmax over the valid time steps of [B, T] scores
-    (hl_sequence_softmax_forward, paddle/cuda/include/hl_matrix.h:67)."""
+    (hl_sequence_softmax_forward, paddle/cuda/include/hl_matrix.h:67).
+    The reduction is pinned f32 regardless of the score dtype (the
+    mixed-precision contract: bf16 attention scores, f32 softmax) and the
+    weights return f32 — callers cast back at their next dot boundary."""
     m = mask_from_lengths(lengths, x.shape[1], jnp.bool_)
-    x = jnp.where(m, x, NEG_INF)
+    x = jnp.where(m, x, NEG_INF).astype(jnp.float32)
     return jax.nn.softmax(x, axis=1) * m.astype(x.dtype)
 
 

@@ -74,6 +74,10 @@ class Optimizer:
         attr = self.param_attrs.get(name) or ParamAttr()
         if attr.is_static:
             return p, s
+        # the master-update boundary of mixed precision (ISSUE 9): whatever
+        # dtype the gradient flowed in (bf16 under precision="bf16"), the
+        # optimizer math and every slot run f32 against the f32 master — the
+        # "f32 masters" half of the bf16-compute contract lives on this line
         g = g.astype(jnp.float32)
         clip = attr.gradient_clipping_threshold or self.gradient_clipping_threshold
         if clip:

@@ -5,6 +5,8 @@ from paddle_tpu.trainer.events import (  # noqa: F401
     EndPass,
 )
 from paddle_tpu.trainer.trainer import (  # noqa: F401
+    DIVERGENCE_POLICIES,
+    REMAT_POLICIES,
     DivergenceError,
     Preempted,
     SGDTrainer,

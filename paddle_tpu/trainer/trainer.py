@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.core import faults, preempt, stats
+from paddle_tpu.core import dtypes, faults, preempt, stats
 from paddle_tpu.obs import metrics as obs_metrics
 from paddle_tpu.obs import trace
 from paddle_tpu.data.pipeline import StackedBatch
@@ -41,6 +41,13 @@ log = logging.getLogger("paddle_tpu.trainer")
 TrainState = Dict[str, Any]  # params / opt / states / avg / samples / rng
 
 DIVERGENCE_POLICIES = ("skip_batch", "rollback", "raise")
+
+# rematerialization policies for the compiled step's backward pass (see
+# _build_step): "dots" keeps matmul/conv outputs and recomputes everything
+# elementwise; "conv_only" keeps only the tagged conv/matmul outputs
+# (ops/conv.py / ops/linalg.py checkpoint_name); "full" recomputes the whole
+# forward. None/"none" = store every residual (jax default).
+REMAT_POLICIES = (None, "none", "dots", "conv_only", "full")
 
 
 class DivergenceError(RuntimeError):
@@ -88,7 +95,8 @@ class SGDTrainer:
         parallel: Optional[Any] = None,  # parallel.DataParallel or None
         updater: Optional[Any] = None,  # parallel.ParameterUpdater
         seed: int = 0,
-        remat: Optional[str] = None,  # None | "conv_only" | "full"
+        remat: Optional[str] = None,  # REMAT_POLICIES
+        precision: Optional[str] = None,  # None (ambient) | "f32" | "bf16"
         divergence_policy: Optional[str] = None,  # skip_batch|rollback|raise
         guard_check_every: int = 16,  # steps between divergence-guard polls
         shard_update: bool = False,  # ZeRO-1 sharded update over the data axis
@@ -99,7 +107,28 @@ class SGDTrainer:
         self.extra_names = [e.name for e in extra_outputs]
         self.network = Network(costs + list(extra_outputs))
         self.optimizer = optimizer
-        self.remat = remat
+        if remat not in REMAT_POLICIES:
+            raise ValueError(
+                f"remat must be one of {REMAT_POLICIES}, got {remat!r}"
+            )
+        self.remat = None if remat == "none" else remat
+        # Mixed-precision policy (ISSUE 9): precision="bf16" makes THIS
+        # trainer's compiled step cast dot/conv inputs to bfloat16 through
+        # Policy.cast (ops/linalg.py, ops/conv.py) while parameters stay
+        # float32 MASTERS — created f32, updated f32 by the optimizer
+        # (update_one upcasts the incoming grad), stored f32 by checkpoints.
+        # Gradients therefore flow bf16 through the backward network and land
+        # f32 at the param leaves (the cast's transpose), so a bf16-trained
+        # checkpoint resumes bitwise into an f32 trainer and vice versa.
+        # Numerically-sensitive reductions stay pinned f32 regardless of the
+        # policy: softmax/xent (ops/xent.py), batch-norm statistics
+        # (ops/normalization.py), the pass-cost average and the divergence
+        # guard's isfinite (both fed by the f32-pinned cost below).
+        # None = inherit the ambient dtypes.current() global at build time
+        # (init_ctx's dtype_policy flag / bench.py's set_policy).
+        self._policy_override = (
+            dtypes.get(precision) if precision is not None else None
+        )
         # The ParameterUpdater protocol (ParameterUpdater.h:38) is the seam
         # where parallelism plugs into the trainer: the optimizer application
         # inside the compiled step goes through updater.apply, and host-side
@@ -212,10 +241,22 @@ class SGDTrainer:
         self._resize_log: List[Dict[str, Any]] = []
         self._resize_mark: Optional[Dict[str, Any]] = None
 
+    # -- precision policy ----------------------------------------------------
+    def policy(self) -> dtypes.Policy:
+        """The dtype policy this trainer's programs trace under: the explicit
+        SGDTrainer(precision=...) override, else the ambient global."""
+        return self._policy_override or dtypes.current()
+
+    @property
+    def precision(self) -> str:
+        return self.policy().name
+
     # -- state ---------------------------------------------------------------
     def init_state(self, sample_batch: Dict[str, Any]) -> TrainState:
         rng = jax.random.PRNGKey(self.seed)
-        params, states = self.network.init(rng, sample_batch, train=True)
+        params, states = self.network.init(
+            rng, sample_batch, train=True, policy=self.policy()
+        )
         self.optimizer.param_attrs = self.network.param_attrs
         state: TrainState = {
             "params": params,
@@ -265,6 +306,7 @@ class SGDTrainer:
         updater = self.updater
         schedule = self.schedule
         avg = self.model_average
+        policy = self.policy()  # pinned at build time, like the remat choice
 
         def step(state: TrainState, batch: Dict[str, Any]):
             mask = batch.get(SAMPLE_MASK_KEY)
@@ -274,6 +316,7 @@ class SGDTrainer:
             bs = (
                 _batch_size(batch)
                 if mask is None
+                # cast-ok: int counter arithmetic, not a precision boundary
                 else jnp.sum(mask).astype(jnp.int32)
             )
             if self._cache_salt:
@@ -281,17 +324,32 @@ class SGDTrainer:
                 # is taken: embeds the per-trainer salt in mesh programs
                 # (see __init__ — persistent-cache opt-out)
                 bs = bs + jnp.asarray(self._cache_salt, jnp.int32) * 0
+            # cast-ok: int32 sample counter → f32 schedule input, policy-free
             lr = schedule(state["samples"].astype(jnp.float32)) * state["lr_scale"]
             step_rng = jax.random.fold_in(state["rng"], state["samples"])
 
             def loss_fn(params):
                 outs, new_states = net.apply(
-                    params, state["states"], batch, train=True, rng=step_rng
+                    params, state["states"], batch, train=True, rng=step_rng,
+                    policy=policy,
                 )
                 total = sum(outs[c].value for c in cost_names)
-                return total, (outs, new_states)
+                # the pass-cost average and the divergence guard's isfinite
+                # are f32 reductions REGARDLESS of the compute policy; most
+                # cost layers already reduce in f32 (ops/xent.py), this pin
+                # is the contract for the rest
+                # cast-ok: f32 pin of a sensitive reduction, not a narrowing
+                return total.astype(jnp.float32), (outs, new_states)
 
-            if self.remat == "conv_only":
+            if self.remat == "dots":
+                # generic remat policy: keep every dot/conv output (the MXU
+                # work), recompute the elementwise rest in the backward pass
+                # — frees the activation residuals between matmuls so the
+                # saved HBM converts to larger per-chip batch
+                loss_fn = jax.checkpoint(
+                    loss_fn, policy=jax.checkpoint_policies.dots_saveable
+                )
+            elif self.remat == "conv_only":
                 # bytes lever for bandwidth-bound convnets: keep conv/matmul
                 # outputs (tagged "conv_out" in ops/conv.py and ops/linalg.py),
                 # recompute the cheap BN/relu/add epilogues in the backward
@@ -338,6 +396,7 @@ class SGDTrainer:
                 new_state = jax.tree.map(
                     lambda new, old: jnp.where(ok, new, old), new_state, state
                 )
+                # cast-ok: int event counter, not a precision boundary
                 new_state["diverged"] = state["diverged"] + jnp.where(
                     ok, 0, 1
                 ).astype(jnp.int32)
@@ -383,11 +442,14 @@ class SGDTrainer:
         cost_names = self.cost_names
         extra_names = self.extra_names
         avg = self.model_average
+        policy = self.policy()
 
         def evaluate(state: TrainState, batch: Dict[str, Any]):
             params = avg.averaged_params(state["avg"], state["params"])
-            outs, _ = net.apply(params, state["states"], batch, train=False)
-            total = sum(outs[c].value for c in cost_names)
+            outs, _ = net.apply(
+                params, state["states"], batch, train=False, policy=policy
+            )
+            total = sum(outs[c].value for c in cost_names).astype(jnp.float32)
             extras = {n: outs[n].value for n in extra_names}
             return total, extras
 
@@ -410,6 +472,7 @@ class SGDTrainer:
         steps_per_dispatch: int = 1,
         async_checkpoint: bool = True,
         resize_barrier: Optional[Callable] = None,
+        remat: Optional[str] = None,
     ) -> TrainState:
         """reader yields batches (lists of samples if feeder given, else dicts
         of arrays). One call = `num_passes` passes (v1 --num_passes).
@@ -451,12 +514,34 @@ class SGDTrainer:
         waits for the writer before returning (and in its error path), load()
         and the preempt drain wait too, so every checkpoint path this method
         reports is durable. Writer failures re-raise on the training thread
-        at the next save/wait."""
+        at the next save/wait.
+
+        remat: re-pins the backward rematerialization policy for this and
+        ALL SUBSEQUENT train() calls ("none" | "dots" | "conv_only" |
+        "full" — see REMAT_POLICIES; it sticks on the trainer exactly like
+        the constructor argument, pinned by test_train_remat_override_
+        rebuilds_step). The recomputation replays the exact same ops, so
+        switching remat changes step TIME and residual HBM, never the
+        applied updates; compiled step programs are rebuilt when the policy
+        changes. None (default) keeps the current setting."""
         event_handler = event_handler or (lambda e: None)
         if steps_per_dispatch < 1:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}"
             )
+        if remat is not None:
+            # per-call remat override (train(remat="none"|"dots"|"conv_only"|
+            # "full")): re-pins the backward rematerialization policy and
+            # drops any step programs compiled under the previous one
+            if remat not in REMAT_POLICIES:
+                raise ValueError(
+                    f"remat must be one of {REMAT_POLICIES}, got {remat!r}"
+                )
+            resolved = None if remat == "none" else remat
+            if resolved != self.remat:
+                self.remat = resolved
+                self._step_fn = None
+                self._multi_fn = None
         resume_pass: Optional[int] = None
         resume_pending = False
         resume_mid = False  # checkpoint is a preemption-drain mid-pass save

@@ -166,6 +166,51 @@ def test_span_sites_in_hot_loops_tagged_and_pinned():
         )
 
 
+# -- precision-cast sites (ISSUE 9 mixed precision) --------------------------
+#
+# Inside the COMPILED train-step body (SGDTrainer._build_step), every dtype
+# cast must go through the Policy.cast boundary (core/dtypes.py) so the
+# precision policy stays auditable — a raw `.astype(` there is either a
+# policy cast that bypassed the seam or an unreviewed numeric change. The
+# sanctioned exceptions (int counter casts, the f32 pin of the cost
+# reduction) carry a `cast-ok` tag with the count pinned below.
+
+CAST_CALL = re.compile(r"\.astype\(")
+CAST_TAG = "cast-ok"
+# (file, class, compiled-step methods, max cast-ok tags)
+CAST_HOT_LOOPS = [(TRAINER_PY, "SGDTrainer", ("_build_step",), 4)]
+
+
+def test_no_untagged_astype_in_compiled_step():
+    """Raw `.astype(` in the compiled train-step body must be tagged: dtype
+    boundaries go through Policy.cast (ops/linalg.py, ops/conv.py call it at
+    the dot/conv inputs), and the few sanctioned non-policy casts — int
+    counters, the f32 cost pin — name their justification."""
+    violations = []
+    for path, cls, methods, _budget in CAST_HOT_LOOPS:
+        v, _ = _scan(path, cls, methods, CAST_CALL, tag=CAST_TAG)
+        violations += v
+    assert not violations, (
+        "untagged `.astype(` in the compiled train-step body — route "
+        "precision casts through Policy.cast (core/dtypes.py) or, for a "
+        "genuinely policy-free cast (int counters, f32 reduction pins), tag "
+        "the line with `# cast-ok: <why>`:\n  " + "\n  ".join(violations)
+    )
+
+
+def test_sanctioned_cast_sites_stay_rare():
+    """cast-ok is a justification, not a loophole: the count is pinned so a
+    new cast site in the compiled step forces a review here."""
+    for path, cls, methods, budget in CAST_HOT_LOOPS:
+        _, tagged = _scan(path, cls, methods, CAST_CALL, tag=CAST_TAG)
+        assert len(tagged) <= budget, (
+            f"{len(tagged)} cast-ok tags in {cls}._build_step (expected <= "
+            f"{budget}): a new sanctioned cast was added to the compiled "
+            "step — confirm it is not a policy cast bypassing Policy.cast "
+            "and bump this bound deliberately"
+        )
+
+
 def test_no_file_io_in_hot_loops():
     """No open()/.write()/json.dump in any hot-loop body, tagged or not —
     span export and metric scraping happen OUTSIDE the loops (export_chrome,

@@ -169,3 +169,93 @@ def test_lstm_layer_end_to_end_with_fused(monkeypatch):
     g = jax.grad(loss)(params)
     total = sum(float(jnp.abs(v).sum()) for v in g.values())
     assert np.isfinite(total) and total > 0
+
+
+class TestAttentionFused:
+    """Fused scaled-dot attention forward (ISSUE 9) vs the jnp oracle in
+    ops/attention.dot_product_attention — forward AND end-to-end grads (the
+    fused op's backward is the oracle's exact vjp, but the composition must
+    still be verified through the custom_vjp seam)."""
+
+    def setup_method(self, _):
+        rs = np.random.RandomState(7)
+        b, tq, tk, d, dv = 3, 5, 7, 8, 6
+        self.q = jnp.asarray(rs.randn(b, tq, d), jnp.float32)
+        self.k = jnp.asarray(rs.randn(b, tk, d), jnp.float32)
+        self.v = jnp.asarray(rs.randn(b, tk, dv), jnp.float32)
+        self.mask_kv = jnp.asarray(rs.rand(b, 1, tk) > 0.3, jnp.float32)
+        self.mask_full = jnp.asarray(rs.rand(b, tq, tk) > 0.3, jnp.float32)
+
+    def _both(self, **kw):
+        from paddle_tpu.ops.attention import dot_product_attention
+
+        ref = dot_product_attention(self.q, self.k, self.v, fused=False, **kw)
+        fus = dot_product_attention(self.q, self.k, self.v, fused=True, **kw)
+        return ref, fus
+
+    def test_forward_matches_oracle(self):
+        for kw in ({}, {"mask": self.mask_kv}, {"mask": self.mask_full},
+                   {"mask": self.mask_kv, "scale": 0.5}):
+            ref, fus = self._both(**kw)
+            np.testing.assert_allclose(
+                np.asarray(ref), np.asarray(fus), atol=1e-5, err_msg=str(kw)
+            )
+
+    def test_fully_masked_row_degrades_like_oracle(self):
+        mask = self.mask_full.at[1, 2, :].set(0.0)
+        ref, fus = self._both(mask=mask)
+        assert np.isfinite(np.asarray(fus)).all()
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(fus), atol=1e-5)
+
+    def test_grads_match_oracle(self):
+        from paddle_tpu.ops.attention import dot_product_attention
+
+        def loss(fused):
+            def f(q, k, v):
+                out = dot_product_attention(
+                    q, k, v, mask=self.mask_full, fused=fused
+                )
+                return jnp.sum(out ** 2)
+
+            return jax.grad(f, argnums=(0, 1, 2))(self.q, self.k, self.v)
+
+        for name, a, c in zip("qkv", loss(False), loss(True)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-5,
+                err_msg=f"d{name}",
+            )
+
+    def test_bf16_inputs_f32_softmax(self):
+        """bf16 q/k/v: output keeps v's dtype and tracks the f32-softmax
+        oracle to bf16 resolution (the reductions never run in bf16)."""
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (self.q, self.k, self.v))
+        from paddle_tpu.ops.attention import dot_product_attention
+
+        ref = dot_product_attention(qb, kb, vb, mask=self.mask_kv, fused=False)
+        fus = dot_product_attention(qb, kb, vb, mask=self.mask_kv, fused=True)
+        assert fus.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(ref, np.float32), np.asarray(fus, np.float32),
+            atol=2e-2,
+        )
+
+    def test_auto_dispatch_honors_pallas_flag(self, monkeypatch):
+        """fused=None routes via ops.pallas.enabled(): off on CPU default,
+        on under interpret; a traced (non-static) scale falls back to jnp."""
+        from paddle_tpu.ops import attention as A
+
+        monkeypatch.setenv("PADDLE_TPU_PALLAS", "0")
+        assert not A._attn_fuse_ok(self.q, self.k, self.v, None)
+        monkeypatch.setenv("PADDLE_TPU_PALLAS", "interpret")
+        assert A._attn_fuse_ok(self.q, self.k, self.v, None)
+        assert not A._attn_fuse_ok(
+            self.q, self.k, self.v, jnp.asarray(0.5)
+        )
+        monkeypatch.setenv("PADDLE_TPU_FUSED_ATTN_MAX", "10")
+        assert not A._attn_fuse_ok(self.q, self.k, self.v, None)
+
+    def test_neg_inf_constant_in_lockstep(self):
+        from paddle_tpu.ops import sequence as seq_ops
+        from paddle_tpu.ops.pallas import rnn_kernels
+
+        assert rnn_kernels._ATTN_NEG_INF == seq_ops.NEG_INF
