@@ -1,7 +1,10 @@
 """Continuous-batching serving benchmark (ISSUE 6 acceptance).
 
-Measures tokens/sec and p50/p99 request latency at 1/4/16/64 concurrent
-streams against the SAME serving session configuration, where concurrency=1
+Measures tokens/sec and p50/p99/p999 request latency — plus the
+deadline-miss and shed columns (ISSUE 10), zero unless `--deadline_s` arms
+per-request deadlines, so overload rounds stay comparable — at 1/4/16/64
+concurrent streams against the SAME serving session configuration, where
+concurrency=1
 is the sequential per-request baseline (one request in flight at a time —
 the `run_generation` serving model: nothing overlaps). Same executables,
 same platform, same fixed shapes at every concurrency, so the measured
@@ -61,7 +64,14 @@ def run_one(args, concurrency: int, prompts):
         session, warm_prompts, args.max_new, concurrency=len(warm_prompts)
     )
     sigs_after_warmup = session.decode_shape_signatures()
-    res = run_closed_loop(session, prompts, args.max_new, concurrency)
+    # the warmup's compile-heavy per-request times must not leak into the
+    # measured run's load-aware admission (they read as second-scale service
+    # times and would shed everything against --deadline_s)
+    session.scheduler.reset_load_estimate()
+    res = run_closed_loop(
+        session, prompts, args.max_new, concurrency,
+        deadline_s=args.deadline_s or None,
+    )
     recompiles = session.decode_shape_signatures() - sigs_after_warmup
     tokens = res.pop("results")
     res.update({
@@ -79,6 +89,10 @@ def main():
     ap.add_argument("--requests", type=int, default=48,
                     help="total requests per concurrency level")
     ap.add_argument("--max_new", type=int, default=24)
+    ap.add_argument("--deadline_s", type=float, default=0.0,
+                    help="arm a per-request total-latency deadline (0 = "
+                         "none); the p999 / deadline-miss columns report "
+                         "either way so rounds stay comparable")
     ap.add_argument("--max_slots", type=int, default=16)
     ap.add_argument("--page_size", type=int, default=16)
     ap.add_argument("--vocab", type=int, default=256)
@@ -107,6 +121,8 @@ def main():
         print(
             f"[serving_bench] streams={n}: {res['tokens_per_sec']} tok/s "
             f"p50={res['p50_latency_ms']}ms p99={res['p99_latency_ms']}ms "
+            f"p999={res['p999_latency_ms']}ms "
+            f"deadline_misses={res['deadline_misses']} "
             f"recompiles={res['decode_recompiles_after_warmup']}",
             file=sys.stderr,
         )

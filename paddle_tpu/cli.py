@@ -846,6 +846,23 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--tenant_tokens_per_s", type=float, default=0.0)
     p.add_argument("--tenant_concurrent", type=int, default=0,
                    help="per-tenant concurrent-request cap (0 = unlimited)")
+    p.add_argument("--default_deadline_s", type=float, default=0.0,
+                   help="total-latency deadline for requests that do not set "
+                        "one (0 = none): expired requests are cancelled with "
+                        "reason 'deadline' and their KV pages recycled; also "
+                        "arms load-aware shedding (doomed requests rejected "
+                        "at admission with retry_after_ms)")
+    p.add_argument("--default_ttft_deadline_s", type=float, default=0.0,
+                   help="time-to-first-token deadline default (0 = none); "
+                        "misses are counted (the client-hedging signal), "
+                        "not fatal")
+    p.add_argument("--engine_restart_max", type=int, default=3,
+                   help="engine crash/stall recoveries before the server "
+                        "gives up and fails outstanding requests "
+                        "('engine_error')")
+    p.add_argument("--engine_stall_timeout_s", type=float, default=10.0,
+                   help="supervisor stall watchdog: no decode-step progress "
+                        "for this long with work pending restarts the engine")
     p.add_argument("--lease_s", type=float, default=30.0,
                    help="tenant lease; silent clients are evicted and their "
                         "queued requests cancelled")
@@ -911,6 +928,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
             max_new_limit=args.max_new_limit,
             max_queue=args.max_queue,
             quotas=quotas,
+            default_deadline_s=args.default_deadline_s or None,
+            default_ttft_deadline_s=args.default_ttft_deadline_s or None,
+            engine_restart_max=args.engine_restart_max,
+            engine_stall_timeout_s=args.engine_stall_timeout_s,
         )
         if args.load:
             from paddle_tpu.serving.model import ServableLM

@@ -45,6 +45,18 @@ Known sites (hooks live next to the code they sabotage):
                    drain checkpoint and barrier — auto_resume
                    must replay the pass from the drained
                    boundary on the NEW mesh
+    decode_raise   serving engine raises mid-decode — the     (serving.session._decode_once)
+                   session supervisor must restart the
+                   engine, re-init the page pool and replay
+                   in-flight requests (result-transparent)
+    page_exhaust   KV page pool fails at admission            (serving.session._admit)
+                   (exhaustion/corruption analog); same
+                   supervisor recovery as decode_raise
+    engine_stall   serving engine thread wedges between       (serving.session._engine_loop)
+                   steps — no fault raised, no progress; the
+                   supervisor's stall watchdog must supersede
+                   and restart it; stall length via
+                   PADDLE_TPU_SERVING_STALL_S (default 300)
 
 Seeding: `PADDLE_TPU_FAULTS_SEED` (or the `seed` argument). Each site gets
 its own `random.Random(f"{seed}:{site}")` stream, so the fire pattern of one
@@ -220,19 +232,20 @@ def maybe_stall(
     env: str = "PADDLE_TPU_RESIZE_STALL_S",
     default_s: float = 300.0,
 ) -> bool:
-    """Wedge-the-process hook shared by the resize drain sites: when `site`
-    fires, sleep for `$env` seconds (default `default_s`) — long enough for
-    the master's barrier timeout / lease eviction to remove the member —
-    then return True. One definition so the trainer drain and the
-    reader/client barrier stall identically."""
+    """Wedge-the-thread hook shared by the stall sites (resize drain,
+    serving engine): when `site` fires, sleep for `$env` seconds (default
+    `default_s`) — long enough for whichever watchdog owns this thread
+    (master barrier timeout, lease eviction, serving stall supervisor) to
+    remove or supersede it — then return True. One definition so every
+    stall drill wedges identically."""
     if not (ACTIVE.active and ACTIVE.fire(site)):
         return False
     stall_s = float(os.environ.get(env, str(default_s)))
     import logging
 
     logging.getLogger("paddle_tpu.faults").warning(
-        "chaos: %s fired — wedging %.0fs (no ack; the barrier timeout or "
-        "lease eviction must remove this member)", site, stall_s,
+        "chaos: %s fired — wedging %.0fs (no ack, no progress; the owning "
+        "watchdog must remove or supersede this thread)", site, stall_s,
     )
     time.sleep(stall_s)
     return True

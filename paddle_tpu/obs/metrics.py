@@ -33,6 +33,10 @@ __all__ = [
     "REGISTRY",
     "Sample",
     "aggregate_snapshots",
+    "observe_deadline_miss",
+    "observe_engine_restart",
+    "observe_pages_recycled",
+    "observe_shed",
     "snapshot",
     "to_prometheus_text",
 ]
@@ -243,6 +247,52 @@ def observe_resize(phase_seconds: Mapping[str, float]) -> None:
     )
     for phase, s in phase_seconds.items():
         lat.inc(float(s), phase=phase)
+
+
+# -- serving resilience (ISSUE 10) -------------------------------------------
+#
+# One naming authority for the serving failure-path counters, so the
+# scheduler/session/server increment the same metrics chaos_bench and the
+# `metrics` RPC read back. All counters (never gauges): they ride heartbeat
+# snapshots and fleet aggregation sums them key-by-key.
+
+
+def observe_deadline_miss(kind: str) -> None:
+    """One request missed a deadline; kind is 'ttft' (first token landed
+    late — the client-hedging signal) or 'total' (request cancelled)."""
+    REGISTRY.counter(
+        "paddle_tpu_serving_deadline_misses_total",
+        "serving requests past a deadline, by kind (ttft|total)",
+    ).inc(kind=kind)
+
+
+def observe_shed(reason: str) -> None:
+    """One request rejected by load shedding (queue bound, already-expired
+    deadline, or load-aware overload check) — the named reason matches the
+    QuotaExceeded the caller saw."""
+    REGISTRY.counter(
+        "paddle_tpu_serving_shed_total",
+        "serving requests shed at admission, by named reason",
+    ).inc(reason=reason)
+
+
+def observe_engine_restart(cause: str) -> None:
+    """The serving supervisor restarted the decode engine; cause is 'fault'
+    (engine thread raised) or 'stall' (no step progress past the watchdog)."""
+    REGISTRY.counter(
+        "paddle_tpu_serving_engine_restarts_total",
+        "serving engine restarts by the session supervisor, by cause",
+    ).inc(cause=cause)
+
+
+def observe_pages_recycled(n: int) -> None:
+    """KV pages returned to the free list by a cancellation (deadline expiry
+    or client abandonment), as opposed to normal retirement — the leak-watch
+    counter the serving chaos drill gates on."""
+    REGISTRY.counter(
+        "paddle_tpu_serving_pages_recycled_on_cancel_total",
+        "KV pages recycled from cancelled (not normally retired) requests",
+    ).inc(n)
 
 
 # -- heartbeat snapshots + fleet aggregation ---------------------------------

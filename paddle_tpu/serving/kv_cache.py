@@ -117,6 +117,16 @@ class PagedKVCache:
         self._table[slot, :] = 0
         return len(pages)
 
+    def reset(self) -> None:
+        """Rebuild the allocator to its just-constructed state (engine crash
+        recovery): every page free, every slot empty, table zeroed. The
+        device pools are NOT touched here — the session re-creates them via
+        make_pools(), because a failed donated decode/commit step has already
+        consumed the old buffers."""
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._slot_pages = [[] for _ in range(self.max_slots)]
+        self._table[:] = 0
+
     def slot_pages(self, slot: int) -> List[int]:
         return list(self._slot_pages[slot])
 

@@ -7,7 +7,13 @@ and rejected — never silently queued forever — when the tenant is over
 budget, over its concurrency cap, or the global queue is full. The token
 bucket refills continuously (tokens_per_s up to a burst capacity), the
 standard shape for "heavy traffic from millions of users" fairness; the
-clock is injectable so tests are deterministic."""
+clock is injectable so tests are deterministic.
+
+Since ISSUE 10 this is also where request *deadlines* get their defaults:
+a tenant may carry a default total-latency and/or TTFT deadline
+(`set_quota(deadline_s=, ttft_deadline_s=)`), applied to any request that
+does not name its own — the scheduler enforces them at admission, in the
+queue, and at decode-step boundaries."""
 
 from __future__ import annotations
 
@@ -18,11 +24,17 @@ from typing import Callable, Dict, Optional
 
 class QuotaExceeded(Exception):
     """Rejected by admission control; `reason` is machine-readable
-    ('tokens' | 'concurrency' | 'queue')."""
+    ('tokens' | 'concurrency' | 'queue' | 'overload' | 'deadline' |
+    'unregistered'). `retry_after_ms` — set on load sheds — is the server's
+    estimate of when retrying could succeed, derived from the current queue
+    wait and free-page pressure; a client that honors it converts a goodput
+    collapse into bounded backoff."""
 
-    def __init__(self, msg: str, reason: str):
+    def __init__(self, msg: str, reason: str,
+                 retry_after_ms: Optional[int] = None):
         super().__init__(msg)
         self.reason = reason
+        self.retry_after_ms = retry_after_ms
 
 
 class _Bucket:
@@ -49,12 +61,19 @@ class TenantQuotas:
         tokens_per_s: float = 0.0,
         max_concurrent: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
+        default_deadline_s: Optional[float] = None,
+        default_ttft_deadline_s: Optional[float] = None,
     ):
         self._default = (token_capacity, float(tokens_per_s), max_concurrent)
+        # tenant-configurable request deadlines (ISSUE 10): requests that do
+        # not name their own total-latency / time-to-first-token deadline
+        # inherit the tenant's, falling back to these fleet-wide defaults
+        self._default_deadlines = (default_deadline_s, default_ttft_deadline_s)
         self._clock = clock
         self._lock = threading.Lock()
         self._buckets: Dict[str, _Bucket] = {}
         self._caps: Dict[str, Optional[int]] = {}
+        self._deadlines: Dict[str, tuple] = {}
         # concurrency holds for tenants with no token bucket
         self._hold_counts: Dict[str, int] = {}
 
@@ -64,12 +83,25 @@ class TenantQuotas:
         token_capacity: Optional[float] = None,
         tokens_per_s: float = 0.0,
         max_concurrent: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        ttft_deadline_s: Optional[float] = None,
     ) -> None:
         with self._lock:
             if token_capacity is not None:
                 b = _Bucket(token_capacity, float(tokens_per_s), self._clock())
                 self._buckets[tenant] = b
             self._caps[tenant] = max_concurrent
+            if deadline_s is not None or ttft_deadline_s is not None:
+                self._deadlines[tenant] = (deadline_s, ttft_deadline_s)
+
+    def deadlines_for(self, tenant: str) -> tuple:
+        """(total_deadline_s, ttft_deadline_s) this tenant's requests default
+        to — per-tenant override where set, else the fleet-wide defaults;
+        either element may be None (no deadline)."""
+        with self._lock:
+            d, td = self._deadlines.get(tenant, (None, None))
+            dd, dtd = self._default_deadlines
+            return (d if d is not None else dd, td if td is not None else dtd)
 
     def _bucket(self, tenant: str) -> Optional[_Bucket]:
         b = self._buckets.get(tenant)

@@ -2,9 +2,17 @@
 
 The host-side half of the serving runtime. A request's life:
 
-    submit -> admission control (queue bound + tenant quota) -> waiting
-    -> [step boundary] slot + KV pages reserved, prefill -> decoding
-    -> EOS / token budget -> retired (pages recycled, handle completed)
+    submit -> admission control (queue bound + load-aware shed + tenant
+    quota) -> waiting -> [step boundary] slot + KV pages reserved, prefill
+    -> decoding -> EOS / token budget -> retired (pages recycled, handle
+    completed)
+
+and since ISSUE 10 every exit from that pipeline is *named*: a request that
+cannot make its deadline is shed at the front door (`overload`, with a
+`retry_after_ms` hint), expires in the queue or at a decode-step boundary
+(`deadline`), is cancelled by its abandoning client (`client_timeout`), or
+is failed by a dead engine (`engine_error`) — never silently dropped, and
+its KV pages are recycled the moment it leaves.
 
 The defining property of continuous batching is that admissions and
 retirements happen at *decode step boundaries*, never inside one: a new
@@ -12,6 +20,9 @@ request joins the very next step after a slot frees up, and a finished
 sequence stops occupying its slot immediately — the batch never stalls
 waiting for its longest member (the per-request RPC round-trip model this
 replaces is the fleet-size cap named in "RPC Considered Harmful", PAPERS.md).
+Deadline checks obey the same discipline: ONE wall-clock read per engine
+step (taken by the session) feeds expiry for every queued and running
+request — enforced by tests/test_lint_hotloop.py's clock lint.
 
 This module is pure host bookkeeping (deterministic, unit-testable); the
 device work lives in session.ServingSession."""
@@ -22,7 +33,7 @@ import collections
 import itertools
 import threading
 import time
-from typing import Deque, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.obs import metrics as obs_metrics
 from paddle_tpu.serving.kv_cache import PagedKVCache
@@ -40,14 +51,21 @@ class FinishReason:
     EOS = "eos"
     LENGTH = "length"
     CANCELLED = "cancelled"
+    DEADLINE = "deadline"          # total-latency deadline expired
+    CLIENT_TIMEOUT = "client_timeout"  # result(timeout=) abandoned the work
+    ENGINE_ERROR = "engine_error"  # engine died past its restart budget
 
 
 class RequestHandle:
     """Caller-facing future for one generation request.
 
     `result()` blocks until the request finishes and returns the generated
-    token ids; a cancelled request raises. Timing fields feed the latency
-    bench (t_submit/t_first_token/t_done, all time.monotonic)."""
+    token ids; a cancelled request raises. By default a `result(timeout=)`
+    expiry also CANCELS the request server-side — the pre-ISSUE-10 behavior
+    (client times out, request keeps decoding and holding KV pages) leaked
+    work nobody would collect. Timing fields feed the latency bench
+    (t_submit/t_first_token/t_done, all time.monotonic); t_deadline /
+    t_ttft_deadline are absolute monotonic deadlines (None = none)."""
 
     QUEUED = "queued"
     RUNNING = "running"
@@ -55,7 +73,9 @@ class RequestHandle:
     CANCELLED = "cancelled"
 
     def __init__(self, request_id: int, tenant: str, prompt_len: int,
-                 max_new_tokens: int):
+                 max_new_tokens: int,
+                 deadline_s: Optional[float] = None,
+                 ttft_deadline_s: Optional[float] = None):
         self.request_id = request_id
         self.tenant = tenant
         self.prompt_len = prompt_len
@@ -66,20 +86,48 @@ class RequestHandle:
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
+        self.t_deadline = (
+            None if deadline_s is None else self.t_submit + float(deadline_s)
+        )
+        self.t_ttft_deadline = (
+            None if ttft_deadline_s is None
+            else self.t_submit + float(ttft_deadline_s)
+        )
         # trace context ({"t": trace_id, "s": span_id}) captured at submit
         # time (ServingSession.submit) so engine-thread spans — queue-wait,
         # prefill, ttft — stitch under the submitting RPC's trace id
         self.trace_ctx: Optional[dict] = None
+        # back-reference for cancel(); set by Scheduler.submit
+        self._scheduler: Optional["Scheduler"] = None
+        # TTFT histogram/miss-counter latch: a crash-replayed request gets a
+        # fresh t_first_token but must be OBSERVED exactly once (session._admit)
+        self.ttft_observed = False
         self._event = threading.Event()
 
     @property
     def done(self) -> bool:
         return self._event.is_set()
 
-    def result(self, timeout: Optional[float] = None) -> List[int]:
+    def cancel(self, reason: str = FinishReason.CANCELLED) -> bool:
+        """Cancel this request: a queued request completes CANCELLED
+        immediately; a running one is retired (pages recycled) at the next
+        decode-step boundary. False when already finished."""
+        if self._scheduler is None or self.done:
+            return False
+        return self._scheduler.cancel(self.request_id, reason)
+
+    def result(self, timeout: Optional[float] = None,
+               cancel_on_timeout: bool = True) -> List[int]:
         if not self._event.wait(timeout):
+            if cancel_on_timeout:
+                # the fix for the classic leak: an abandoning client must not
+                # leave its request decoding into the void while holding KV
+                # pages — cancel it so the slot + pages recycle at the next
+                # step boundary (serving/scheduler.py reap)
+                self.cancel(FinishReason.CLIENT_TIMEOUT)
             raise TimeoutError(
                 f"request {self.request_id} not done after {timeout}s"
+                + ("; cancelled server-side" if cancel_on_timeout else "")
             )
         if self.status == self.CANCELLED:
             raise RuntimeError(
@@ -106,7 +154,8 @@ class ActiveSeq:
     """One occupied decode slot: the sequence's last token + position ride
     into every decode step; everything else is retained host-side."""
 
-    __slots__ = ("handle", "prompt", "last_token", "next_pos", "generated")
+    __slots__ = ("handle", "prompt", "last_token", "next_pos", "generated",
+                 "t_started")
 
     def __init__(self, handle: RequestHandle, prompt: List[int]):
         self.handle = handle
@@ -114,11 +163,13 @@ class ActiveSeq:
         self.last_token: int = -1  # set by prefill
         self.next_pos: int = len(prompt)  # position the last token occupies
         self.generated: int = 0
+        self.t_started: Optional[float] = None  # set at admission
 
     def append(self, token: int) -> None:
         self.handle.tokens.append(int(token))
         self.generated += 1
         if self.generated == 1:
+            # clock-ok: once per REQUEST (not per token) — the TTFT stamp
             self.handle.t_first_token = time.monotonic()
         else:
             self.next_pos += 1
@@ -135,6 +186,10 @@ class ActiveSeq:
 class Scheduler:
     """Slot + queue management; thread-safe against concurrent submits."""
 
+    # EWMA smoothing for the observed per-request service time that feeds
+    # the queue-wait estimate (load-aware shedding)
+    SERVICE_EWMA_ALPHA = 0.3
+
     def __init__(
         self,
         cache: PagedKVCache,
@@ -148,10 +203,18 @@ class Scheduler:
         self.waiting: Deque[_Waiting] = collections.deque()
         self.slots: List[Optional[ActiveSeq]] = [None] * cache.max_slots
         self._ids = itertools.count()
+        # cancellations requested for RUNNING sequences; honored at the next
+        # decode-step boundary (reap) so they never interrupt a step
+        self._cancel_req: Dict[int, str] = {}
+        # EWMA of admission→done wall time, the basis of estimate_wait_s
+        self._ewma_service_s: Optional[float] = None
         # counters surfaced through session.stats()
         self.completed = 0
         self.rejected = 0
         self.cancelled = 0
+        self.shed = 0
+        self.deadline_misses = 0
+        self.pages_recycled_on_cancel = 0
 
     # -- intake -------------------------------------------------------------
     def submit(
@@ -160,40 +223,256 @@ class Scheduler:
         max_new_tokens: int,
         tenant: str,
         trace_ctx: Optional[dict] = None,
+        deadline_s: Optional[float] = None,
+        ttft_deadline_s: Optional[float] = None,
     ) -> RequestHandle:
         """Admission control happens HERE, synchronously: the caller learns
-        'no' at the front door, not by timing out in a silent queue.
+        'no' at the front door, not by timing out in a silent queue. Three
+        gates, in order: the queue bound, the load-aware deadline check (a
+        request whose estimated queue wait already exceeds its deadline
+        budget is doomed — admitting it would burn a slot on work nobody can
+        use; shed it with `retry_after_ms` instead), then the tenant quota.
         trace_ctx must ride in (not be set on the returned handle after):
         the engine thread can pop the request the instant it is queued, so
         the context has to be on the handle BEFORE it becomes visible."""
         prompt = [int(t) for t in prompt]
+        total = len(prompt) + max_new_tokens
         with self.lock:
             if len(self.waiting) >= self.max_queue:
                 self.rejected += 1
+                self.shed += 1
+                obs_metrics.observe_shed("queue")
                 raise QuotaExceeded(
-                    f"request queue full ({self.max_queue})", "queue"
+                    f"request queue full ({self.max_queue})", "queue",
+                    retry_after_ms=self._retry_hint_ms(total),
                 )
+            if deadline_s is not None:
+                if deadline_s <= 0:
+                    self.rejected += 1
+                    self.shed += 1
+                    obs_metrics.observe_shed("deadline")
+                    raise QuotaExceeded(
+                        f"deadline of {deadline_s}s already expired at "
+                        f"admission", "deadline",
+                        retry_after_ms=self._retry_hint_ms(total),
+                    )
+                est = self._estimate_wait_s(total)
+                if est > deadline_s:
+                    self.rejected += 1
+                    self.shed += 1
+                    obs_metrics.observe_shed("overload")
+                    raise QuotaExceeded(
+                        f"overloaded: estimated completion {est:.2f}s exceeds "
+                        f"the request's {deadline_s:.2f}s deadline budget",
+                        "overload",
+                        retry_after_ms=self._retry_hint_ms(total),
+                    )
+            # the TTFT budget is compared against the QUEUE-WAIT estimate,
+            # never the completion estimate: a TTFT deadline shorter than one
+            # service time must not shed requests on an idle server (TTFT ≈
+            # queue wait + prefill, and the contract is "counted, not fatal"
+            # — an already-expired TTFT budget just counts a miss later)
+            if ttft_deadline_s is not None and ttft_deadline_s > 0:
+                est_ttft = self._estimate_ttft_wait_s(total)
+                if est_ttft > ttft_deadline_s:
+                    self.rejected += 1
+                    self.shed += 1
+                    obs_metrics.observe_shed("overload")
+                    raise QuotaExceeded(
+                        f"overloaded: estimated queue wait {est_ttft:.2f}s "
+                        f"exceeds the request's {ttft_deadline_s:.2f}s TTFT "
+                        f"budget", "overload",
+                        retry_after_ms=self._retry_hint_ms(total),
+                    )
             if self.quotas is not None:
                 try:
-                    self.quotas.admit(tenant, len(prompt) + max_new_tokens)
+                    self.quotas.admit(tenant, total)
                 except QuotaExceeded:
                     self.rejected += 1
                     raise
             handle = RequestHandle(
-                next(self._ids), tenant, len(prompt), max_new_tokens
+                next(self._ids), tenant, len(prompt), max_new_tokens,
+                deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
             )
             handle.trace_ctx = trace_ctx
+            handle._scheduler = self
             self.waiting.append(_Waiting(handle, prompt))
             return handle
 
+    # -- load estimate ------------------------------------------------------
+    def _estimate_wait_s(self, total_len: int) -> float:
+        """Expected time for a request of `total_len` tokens to COMPLETE
+        (queue wait + its own service), under self.lock — what a deadline
+        budget must cover. The queue drains in waves of up to max_slots
+        requests, each taking ~one EWMA service time; the request's own
+        decode is one more wave, and free-page pressure (pool cannot host it
+        right now) adds another. Optimistic (0) until the first retirement
+        seeds the EWMA — cold starts admit."""
+        svc = self._ewma_service_s
+        if svc is None:
+            return 0.0
+        free_slot = any(a is None for a in self.slots)
+        fits_now = free_slot and self.cache.can_reserve(total_len)
+        depth = len(self.waiting)
+        if depth == 0 and fits_now:
+            return svc  # empty queue: just its own decode time
+        waves = depth / max(1, self.cache.max_slots) + 1.0
+        if not fits_now:
+            waves += 1.0
+        return waves * svc
+
+    def _estimate_ttft_wait_s(self, total_len: int) -> float:
+        """Expected wait until the FIRST token (under self.lock): the
+        completion estimate minus the request's own decode wave — i.e. the
+        queue-drain time ahead of it (prefill is a small constant on top).
+        0 on an idle server with room."""
+        svc = self._ewma_service_s
+        if svc is None:
+            return 0.0
+        return max(0.0, self._estimate_wait_s(total_len) - svc)
+
+    def _retry_hint_ms(self, total_len: int) -> int:
+        # under self.lock; the hint is "when could this plausibly fit":
+        # the estimated wait, floored at one service time (or 10ms cold)
+        est = self._estimate_wait_s(total_len)
+        floor = self._ewma_service_s or 0.01
+        return max(1, int(1000 * max(est, floor)))
+
+    def estimate_wait_s(self, total_len: int = 0) -> float:
+        with self.lock:
+            return self._estimate_wait_s(total_len)
+
+    def reset_load_estimate(self) -> None:
+        """Forget the observed service-time EWMA. Benches and warmup paths
+        need this: a compile-heavy first round observes second-scale
+        'service times' that would make the load-aware admission check shed
+        everything against a millisecond-scale deadline budget until enough
+        steady-state retirements wash the EWMA out."""
+        with self.lock:
+            self._ewma_service_s = None
+
+    # -- cancellation + deadline reaping ------------------------------------
+    def _finalize(self, handle: RequestHandle, reason: str,
+                  refund_tokens: int, freed_pages: int) -> None:
+        """The ONE completion path for every cancellation exit (queued
+        cancel, reap expiry, doomed-at-admission, crash requeue): refund the
+        tenant quota, emit the page-recycle / deadline-miss metrics, wake
+        the caller. Must run OUTSIDE self.lock (quota has its own lock and
+        _complete wakes waiters)."""
+        if self.quotas is not None:
+            self.quotas.release(handle.tenant, refund_tokens)
+        if freed_pages:
+            obs_metrics.observe_pages_recycled(freed_pages)
+        if reason == FinishReason.DEADLINE:
+            obs_metrics.observe_deadline_miss("total")
+        handle._complete(RequestHandle.CANCELLED, reason)
+
+    def cancel(self, request_id: int,
+               reason: str = FinishReason.CANCELLED) -> bool:
+        """Cancel one request by id. Queued → completed CANCELLED now (quota
+        refunded, nothing was reserved); running → marked, retired with its
+        pages recycled at the next decode-step boundary (reap). False when
+        unknown or already finished."""
+        victim: Optional[_Waiting] = None
+        with self.lock:
+            for w in self.waiting:
+                if w.handle.request_id == request_id:
+                    victim = w
+                    break
+            if victim is not None:
+                self.waiting.remove(victim)
+                self.cancelled += 1
+            else:
+                for act in self.slots:
+                    if act is not None and act.handle.request_id == request_id:
+                        self._cancel_req[request_id] = reason
+                        return True
+                return False
+        h = victim.handle
+        self._finalize(h, reason, h.prompt_len + h.max_new_tokens, 0)
+        return True
+
+    def reap(self, now: Optional[float] = None) -> int:
+        """Step-boundary sweep, called once per engine step with that step's
+        single timestamp: expire queued + running requests past their total
+        deadline and honor pending cancellations, recycling KV pages
+        immediately. Returns how many requests were removed."""
+        # clock-ok: fallback for direct (test) calls — the engine passes its
+        # single per-step timestamp, so expiry never reads per request
+        now = time.monotonic() if now is None else now
+        removed: List[Tuple[RequestHandle, str, int, int]] = []
+        with self.lock:
+            if self.waiting and any(
+                w.handle.t_deadline is not None for w in self.waiting
+            ):
+                keep: Deque[_Waiting] = collections.deque()
+                for w in self.waiting:
+                    h = w.handle
+                    if h.t_deadline is not None and now >= h.t_deadline:
+                        self.cancelled += 1
+                        self.deadline_misses += 1
+                        removed.append(
+                            (h, FinishReason.DEADLINE,
+                             h.prompt_len + h.max_new_tokens, 0)
+                        )
+                    else:
+                        keep.append(w)
+                self.waiting = keep
+            for slot, act in enumerate(self.slots):
+                if act is None:
+                    continue
+                h = act.handle
+                reason = self._cancel_req.pop(h.request_id, None)
+                if (reason is None and h.t_deadline is not None
+                        and now >= h.t_deadline):
+                    reason = FinishReason.DEADLINE
+                if reason is None:
+                    continue
+                self.slots[slot] = None
+                freed = self.cache.release(slot)
+                self.pages_recycled_on_cancel += freed
+                self.cancelled += 1
+                if reason == FinishReason.DEADLINE:
+                    self.deadline_misses += 1
+                removed.append(
+                    (h, reason,
+                     max(0, h.max_new_tokens - act.generated), freed)
+                )
+        for h, reason, refund, freed in removed:
+            self._finalize(h, reason, refund, freed)
+        return len(removed)
+
     # -- step-boundary transitions ------------------------------------------
-    def pop_admissions(self) -> List[Tuple[int, ActiveSeq]]:
+    def pop_admissions(
+        self, now: Optional[float] = None
+    ) -> List[Tuple[int, ActiveSeq]]:
         """Move waiting requests into free slots while KV pages allow —
         called once per engine step, so joins land exactly at step
-        boundaries. Returns [(slot, ActiveSeq)] needing prefill."""
+        boundaries. A queued request whose remaining deadline budget no
+        longer covers one service time is DOOMED: it is failed here
+        ('deadline') instead of being handed a slot it would die holding —
+        under overload that one check is most of what keeps goodput flat
+        (slot time only goes to requests that can still finish). Returns
+        [(slot, ActiveSeq)] needing prefill."""
+        # clock-ok: fallback for direct (test) calls — the engine passes its
+        # single per-step timestamp
+        now = time.monotonic() if now is None else now
         admitted: List[Tuple[int, ActiveSeq]] = []
+        doomed: List[RequestHandle] = []
         with self.lock:
+            svc = self._ewma_service_s
             for slot in range(len(self.slots)):
+                while self.waiting:
+                    w = self.waiting[0]
+                    h = w.handle
+                    if (h.t_deadline is not None and svc is not None
+                            and h.t_deadline - now < svc):
+                        self.waiting.popleft()
+                        self.cancelled += 1
+                        self.deadline_misses += 1
+                        doomed.append(h)
+                        continue
+                    break
                 if not self.waiting:
                     break
                 if self.slots[slot] is not None:
@@ -205,9 +484,13 @@ class Scheduler:
                 self.waiting.popleft()
                 self.cache.reserve(slot, total)
                 act = ActiveSeq(w.handle, w.prompt)
+                act.t_started = now
                 act.handle.status = RequestHandle.RUNNING
                 self.slots[slot] = act
                 admitted.append((slot, act))
+        for h in doomed:
+            self._finalize(h, FinishReason.DEADLINE,
+                           h.prompt_len + h.max_new_tokens, 0)
         return admitted
 
     def retire(self, slot: int, reason: str) -> None:
@@ -217,11 +500,66 @@ class Scheduler:
             self.slots[slot] = None
             self.cache.release(slot)
             self.completed += 1
+            self._cancel_req.pop(act.handle.request_id, None)
         if self.quotas is not None:
             unused = act.handle.max_new_tokens - act.generated
             self.quotas.release(act.handle.tenant, max(0, unused))
         act.handle._complete(RequestHandle.DONE, reason)
         REQUEST_HISTOGRAM.observe(act.handle.t_done - act.handle.t_submit)
+        svc = act.handle.t_done - (act.t_started or act.handle.t_submit)
+        with self.lock:
+            a = self.SERVICE_EWMA_ALPHA
+            self._ewma_service_s = (
+                svc if self._ewma_service_s is None
+                else (1 - a) * self._ewma_service_s + a * svc
+            )
+
+    # -- engine crash recovery ----------------------------------------------
+    def requeue_active(self, now: Optional[float] = None) -> Tuple[int, int]:
+        """Engine recovery (ISSUE 10): push every RUNNING sequence back to
+        the FRONT of the queue in original submit order with its progress
+        reset — greedy decode is deterministic, so the replay regenerates
+        the same tokens and the restart is result-transparent. Requests
+        already past their total deadline fail now with the named reason
+        instead of wasting the fresh engine's steps. Slots are emptied but
+        the page free-list is NOT touched: the caller re-initializes the
+        whole pool (cache.reset()) because the dead engine's donated buffers
+        are gone regardless. Returns (requeued, expired)."""
+        # clock-ok: once per engine restart (the supervisor's recovery stamp)
+        now = time.monotonic() if now is None else now
+        requeued = 0
+        expired: List[Tuple[RequestHandle, str, int]] = []
+        with self.lock:
+            active = [(i, a) for i, a in enumerate(self.slots)
+                      if a is not None]
+            for i, _ in active:
+                self.slots[i] = None
+            # appendleft in descending id order -> queue head ends up in
+            # ascending (original) order, ahead of not-yet-admitted work
+            for _, act in sorted(
+                active, key=lambda t: t[1].handle.request_id, reverse=True,
+            ):
+                h = act.handle
+                reason = self._cancel_req.pop(h.request_id, None)
+                if reason is None and h.t_deadline is not None \
+                        and now >= h.t_deadline:
+                    reason = FinishReason.DEADLINE
+                if reason is not None:
+                    self.cancelled += 1
+                    if reason == FinishReason.DEADLINE:
+                        self.deadline_misses += 1
+                    expired.append(
+                        (h, reason, max(0, h.max_new_tokens - act.generated))
+                    )
+                    continue
+                h.tokens = []
+                h.t_first_token = None
+                h.status = RequestHandle.QUEUED
+                self.waiting.appendleft(_Waiting(h, act.prompt))
+                requeued += 1
+        for h, reason, refund in expired:
+            self._finalize(h, reason, refund, 0)
+        return requeued, len(expired)
 
     def cancel_tenant(self, tenant: str) -> int:
         """Drop a (evicted/deregistered) tenant's QUEUED requests; running

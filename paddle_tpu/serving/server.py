@@ -15,8 +15,9 @@ request-routing plane):
     at `submit`/`generate` time; a rejection is an RPC-level error naming
     the reason, not a timeout.
 
-Methods: register | heartbeat | deregister | submit | poll | generate
-(blocking submit+wait) | stats. A config-driven `GenerationSession` can ride
+Methods: register | heartbeat | deregister | submit | poll | cancel |
+generate (blocking submit+wait) | stats. A config-driven `GenerationSession`
+can ride
 alongside the token engine (method `generate_config`) so v1-config golden
 models are served by the same long-lived process."""
 
@@ -71,6 +72,10 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = srv.dispatch(req.get("method"), req, tenant_id)
             except QuotaExceeded as e:
                 resp = {"err": str(e), "rejected": e.reason}
+                if getattr(e, "retry_after_ms", None) is not None:
+                    # load-shed hint: when retrying could plausibly succeed,
+                    # derived from queue wait + free-page pressure
+                    resp["retry_after_ms"] = e.retry_after_ms
             except Exception as e:  # a bad request must not kill the server
                 log.warning("serving RPC failed: %r", e)
                 resp = {"err": f"{type(e).__name__}: {e}"}
@@ -199,6 +204,8 @@ class ServingServer:
                     req["prompt"],
                     req.get("max_new_tokens"),
                     tenant=tenant,
+                    deadline_s=req.get("deadline_s"),
+                    ttft_deadline_s=req.get("ttft_deadline_s"),
                 )
                 with self._handles_lock:
                     self._handles[handle.request_id] = handle
@@ -207,7 +214,12 @@ class ServingServer:
             if method == "submit":
                 return {"request_id": handle.request_id}
             try:
-                handle.result(timeout=float(req.get("timeout_s", 120.0)))
+                # cancel_on_timeout=False: the blocking-generate contract is
+                # "still running; poll request_id later" — the caller chose
+                # to wait, not to abandon (ServingClient abandonment goes
+                # through result()'s default cancel path / the cancel RPC)
+                handle.result(timeout=float(req.get("timeout_s", 120.0)),
+                              cancel_on_timeout=False)
             except TimeoutError:
                 # the request keeps running; the handle stays registered so
                 # the caller can poll for the tokens it already paid for
@@ -216,19 +228,32 @@ class ServingServer:
                     "request_id": handle.request_id,
                     "done": False,
                 }
+            except RuntimeError:
+                pass  # cancelled: _completion below names the reason
             return dict(self._completion(handle),
                         request_id=handle.request_id)
-        if method == "poll":
+        if method in ("poll", "cancel"):
             with self._handles_lock:
                 handle = self._handles.get(int(req["request_id"]))
             if handle is None:
                 return {"err": f"unknown request_id {req['request_id']}"}
-            # request ids are sequential — poll must enforce the SAME tenancy
-            # as submit, or guessing ids reads other tenants' tokens
+            # request ids are sequential — poll/cancel must enforce the SAME
+            # tenancy as submit, or guessing ids reads (or kills) other
+            # tenants' requests
             if handle.tenant != self._tenant_for(tenant_id):
                 return {"err": "request belongs to another tenant"}
+            if method == "cancel":
+                return {"cancelled": handle.cancel(), "done": handle.done}
             if not handle.done:
-                return {"done": False, "tokens_so_far": len(handle.tokens)}
+                # incremental delivery: the tokens generated SO FAR ride
+                # every poll (the cheap first step toward streaming, and
+                # what makes a TTFT-deadline miss client-observable)
+                toks = list(handle.tokens)
+                return {
+                    "done": False,
+                    "tokens_so_far": len(toks),
+                    "tokens": toks,
+                }
             # non-destructive: a lost response must be re-readable; the
             # reaper GCs finished handles after handle_ttl_s
             return self._completion(handle)
@@ -406,6 +431,17 @@ class ServingServer:
             self.session.stop()
 
 
+class Rejected(RuntimeError):
+    """A submit/generate the server refused with a named reason; on load
+    sheds `retry_after_ms` carries the server's backoff hint."""
+
+    def __init__(self, msg: str, reason: Optional[str] = None,
+                 retry_after_ms: Optional[int] = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_ms = retry_after_ms
+
+
 class ServingClient:
     """Ergonomic wrapper over MasterClient (which supplies reconnect,
     failover lists, backoff and the conn_reset chaos site for free).
@@ -416,12 +452,17 @@ class ServingClient:
     instead of queueing and quota-charging a duplicate. `generate` is
     implemented as submit + poll — short retry-exact RPCs — rather than one
     long blocking read that would trip the socket timeout on a loaded
-    server."""
+    server. The same dedup key is what makes HEDGING safe: `generate` with
+    `hedge_ttft_s` re-issues the submit when no token has arrived by that
+    deadline — if the original landed, the server reattaches (exactly one
+    engine execution); if it was lost in a partition/failover, the hedge IS
+    the request."""
 
     def __init__(self, address: EndpointsLike, **client_kw):
         self._client = MasterClient(address, **client_kw)
         self.tenant_id: Optional[str] = None
         self.lease_s: float = 30.0
+        self.hedges = 0  # hedged retries issued (TTFT-deadline misses)
 
     def register(self) -> str:
         resp = self._client.call("register")
@@ -438,18 +479,50 @@ class ServingClient:
         max_new_tokens: Optional[int] = None,
         timeout_s: float = 120.0,
         poll_interval_s: float = 0.02,
+        deadline_s: Optional[float] = None,
+        ttft_deadline_s: Optional[float] = None,
+        hedge_ttft_s: Optional[float] = None,
     ) -> dict:
         import time as _time
 
-        rid = self.submit(prompt, max_new_tokens)
-        deadline = _time.monotonic() + timeout_s
+        key = uuid.uuid4().hex
+        kw = dict(deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+                  client_req_id=key)
+        rid = self.submit(prompt, max_new_tokens, **kw)
+        t0 = _time.monotonic()
+        deadline = t0 + timeout_s
+        hedged = False
         while True:
             resp = self.poll(rid)
             if "err" in resp:
+                if hedge_ttft_s is not None and not hedged:
+                    # the lost-request case hedging exists for: the server no
+                    # longer knows rid (failover to a peer, handle GC) — the
+                    # hedge IS the request, re-issued under the same
+                    # idempotency key, instead of a client-visible failure
+                    hedged = True
+                    self.hedges += 1
+                    rid = self.submit(prompt, max_new_tokens, **kw)
+                    continue
                 raise RuntimeError(f"generate failed: {resp['err']}")
             if resp.get("done"):
                 return resp
-            if _time.monotonic() > deadline:
+            now = _time.monotonic()
+            if (hedge_ttft_s is not None and not hedged
+                    and not resp.get("tokens_so_far")
+                    and now - t0 > hedge_ttft_s):
+                # TTFT deadline missed with zero tokens delivered: hedge by
+                # re-issuing the submit under the SAME idempotency key. The
+                # server's (tenant, client_req_id) dedup reattaches when the
+                # original landed — exactly one engine execution — and only
+                # a lost original makes this a fresh request.
+                hedged = True
+                self.hedges += 1
+                try:
+                    rid = self.submit(prompt, max_new_tokens, **kw)
+                except Rejected:
+                    pass  # shed hedge: keep polling the original
+            if now > deadline:
                 raise TimeoutError(
                     f"generate: request {rid} not done after {timeout_s}s "
                     f"({resp.get('tokens_so_far', 0)} tokens so far); poll "
@@ -457,17 +530,36 @@ class ServingClient:
                 )
             _time.sleep(poll_interval_s)
 
-    def submit(self, prompt, max_new_tokens: Optional[int] = None) -> int:
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        ttft_deadline_s: Optional[float] = None,
+        client_req_id: Optional[str] = None,
+    ) -> int:
         resp = self._client.call(
             "submit", prompt=list(prompt), max_new_tokens=max_new_tokens,
-            client_req_id=uuid.uuid4().hex, **self._id_kw(),
+            deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+            client_req_id=client_req_id or uuid.uuid4().hex, **self._id_kw(),
         )
         if "err" in resp:
-            raise RuntimeError(f"submit rejected: {resp['err']}")
+            raise Rejected(
+                f"submit rejected: {resp['err']}",
+                reason=resp.get("rejected"),
+                retry_after_ms=resp.get("retry_after_ms"),
+            )
         return int(resp["request_id"])
 
     def poll(self, request_id: int) -> dict:
         return self._client.call("poll", request_id=request_id, **self._id_kw())
+
+    def cancel(self, request_id: int) -> dict:
+        """Cancel a submitted request server-side (pages recycle at the next
+        decode-step boundary); idempotent once the request finished."""
+        return self._client.call(
+            "cancel", request_id=request_id, **self._id_kw()
+        )
 
     def heartbeat(self) -> dict:
         return self._client.call("heartbeat", **self._id_kw())

@@ -21,16 +21,32 @@ benchmarks/serving_bench.py.
 Hot-loop discipline matches the trainer's (README "Async execution"): the
 decode loop performs exactly ONE device->host fetch per step — the sampled
 token ids, which the autoregressive loop inherently needs to detect EOS and
-stream results. tests/test_lint_hotloop.py lints this loop body the same way
-it lints the train loop."""
+stream results — and, since ISSUE 10, exactly ONE wall-clock read per step
+(the step-boundary timestamp that batches every deadline/cancellation
+check). tests/test_lint_hotloop.py lints this loop body the same way it
+lints the train loop.
+
+Resilience (ISSUE 10): in server mode the engine thread runs under a
+SUPERVISOR. When the engine faults (seeded sites `decode_raise` /
+`page_exhaust`) or stalls past `engine_stall_timeout_s` without a step
+(seeded site `engine_stall`), the supervisor supersedes it, re-initializes
+the page pool (a failed donated step consumed the old buffers anyway), and
+replays every in-flight request from its prompt — greedy decode is
+deterministic, so completed requests are unaffected and replayed ones are
+result-transparent; requests past their deadline fail with the named reason
+`deadline`. Past `engine_restart_max` restarts the engine gives up and every
+outstanding request fails `engine_error` (the pre-supervisor behavior)."""
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu.core import faults as _faults
 from paddle_tpu.core import stats
 from paddle_tpu.obs import metrics as obs_metrics
 from paddle_tpu.obs import trace
@@ -72,6 +88,10 @@ class ServingSession:
         max_new_limit: int = 64,
         max_queue: int = 256,
         quotas: Optional[TenantQuotas] = None,
+        default_deadline_s: Optional[float] = None,
+        default_ttft_deadline_s: Optional[float] = None,
+        engine_restart_max: int = 3,
+        engine_stall_timeout_s: float = 10.0,
     ):
         import jax
 
@@ -110,7 +130,30 @@ class ServingSession:
         self.recompiles = stats.RecompileStats(warn_threshold=2)
         self.decode_steps = 0
         self.tokens_generated = 0
+        # session-level request deadline defaults; per-tenant quota defaults
+        # (quota.py deadlines_for) take precedence, explicit per-request
+        # values beat both
+        self.default_deadline_s = default_deadline_s
+        self.default_ttft_deadline_s = default_ttft_deadline_s
+        # supervisor state (server mode): restart budget, stall watchdog,
+        # and the engine GENERATION — a superseded (stalled) engine thread
+        # re-checks the generation when it wakes and exits without touching
+        # session state, so recovery never races a zombie
+        self.engine_restart_max = int(engine_restart_max)
+        self.engine_stall_timeout_s = float(engine_stall_timeout_s)
+        self.engine_restarts = 0
         self.engine_error: Optional[BaseException] = None
+        self._engine_gen = 0
+        # serializes the supersede handshake: the engine flips
+        # _engine_in_step only after re-checking its generation UNDER this
+        # lock, and the stall recovery bumps the generation under the same
+        # lock only while the engine is BETWEEN steps — so a wedged thread
+        # that wakes at the wrong moment can never run a step concurrently
+        # with the supervisor's pool re-init (check-then-act closed)
+        self._gen_lock = threading.Lock()
+        self._engine_fault: Optional[BaseException] = None
+        self._engine_in_step = False
+        self._last_progress = time.monotonic()
         self._stop = threading.Event()
         self._work = threading.Condition()
         self._thread: Optional[threading.Thread] = None
@@ -121,9 +164,14 @@ class ServingSession:
         prompt: Sequence[int],
         max_new_tokens: Optional[int] = None,
         tenant: str = "default",
+        deadline_s: Optional[float] = None,
+        ttft_deadline_s: Optional[float] = None,
     ) -> RequestHandle:
         """Queue one generation request; raises QuotaExceeded at the front
-        door when admission control says no. Thread-safe."""
+        door when admission control says no (including a load-aware shed
+        when the estimated queue wait exceeds the request's deadline
+        budget). Deadlines resolve explicit arg → tenant quota default →
+        session default; None all the way down means none. Thread-safe."""
         if self.engine_error is not None:
             raise RuntimeError(
                 "serving engine died; no new requests accepted"
@@ -146,13 +194,24 @@ class ServingSession:
                 f"request needs {need} KV pages; pool allows "
                 f"{min(self.cache.max_pages_per_seq, self.cache.num_pages - 1)}"
             )
+        if deadline_s is None or ttft_deadline_s is None:
+            qd = qtd = None
+            if self.scheduler.quotas is not None:
+                qd, qtd = self.scheduler.quotas.deadlines_for(tenant)
+            if deadline_s is None:
+                deadline_s = qd if qd is not None else self.default_deadline_s
+            if ttft_deadline_s is None:
+                ttft_deadline_s = (
+                    qtd if qtd is not None else self.default_ttft_deadline_s
+                )
         # request trace context: the submitter's current span (the RPC
         # handler's server span, or whatever the caller has open) — the
         # engine thread's queue-wait/prefill/ttft spans stitch under it.
         # Captured BEFORE submit: the engine can admit the request the
         # moment it is queued, so a post-submit assignment would race
         handle = self.scheduler.submit(
-            prompt, max_new, tenant, trace_ctx=trace.wire_context()
+            prompt, max_new, tenant, trace_ctx=trace.wire_context(),
+            deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
         )
         SERVING_EVENTS.incr("serving_submitted")
         with self._work:
@@ -160,11 +219,17 @@ class ServingSession:
         return handle
 
     # -- engine steps -------------------------------------------------------
-    def _admit(self) -> None:
+    def _admit(self, now: Optional[float] = None) -> None:
         """Run prefill for every request joining at this step boundary."""
         import jax.numpy as jnp
 
-        for slot, act in self.scheduler.pop_admissions():
+        if _faults.get().active and self.scheduler.queue_depth():
+            # chaos site: the page pool fails at admission (exhaustion /
+            # corruption analog) — the supervisor must re-init the pool and
+            # replay; gated on queued work so step=N counts admission
+            # ATTEMPTS, not idle engine spins
+            _faults.get().maybe_raise("page_exhaust")
+        for slot, act in self.scheduler.pop_admissions(now):
             h = act.handle
             ctx = h.trace_ctx
             # queue-wait: submit → this admission boundary, under the
@@ -193,9 +258,21 @@ class ServingSession:
                     # the prompt's first sampled token — argmax on device
                     act.append(int(first_tok[0]))
             # time-to-first-token: prefill emits the first sampled token, so
-            # TTFT completes here — span under the request trace + histogram
-            ttft_s = (h.t_first_token or h.t_submit) - h.t_submit
-            TTFT_HISTOGRAM.observe(ttft_s)
+            # TTFT completes here — span under the request trace + histogram.
+            # Latched once per REQUEST: a crash-replayed admission must not
+            # observe a second sample (or double-count a miss) for the same id
+            if not h.ttft_observed:
+                h.ttft_observed = True
+                ttft_s = (h.t_first_token or h.t_submit) - h.t_submit
+                TTFT_HISTOGRAM.observe(ttft_s)
+                if (h.t_ttft_deadline is not None
+                        and h.t_first_token is not None
+                        and h.t_first_token > h.t_ttft_deadline):
+                    # TTFT deadline missed: counted (the client-hedging
+                    # signal) but NOT fatal — the request has its first token
+                    # now and only the total deadline cancels work
+                    obs_metrics.observe_deadline_miss("ttft")
+                    SERVING_EVENTS.incr("serving_ttft_deadline_missed")
             trace.span_from_monotonic(
                 "serving.ttft", h.t_submit,
                 trace_id=ctx and ctx.get("t"), parent_id=ctx and ctx.get("s"),
@@ -212,6 +289,11 @@ class ServingSession:
         active = self.scheduler.active_slots()
         if not active:
             return
+        if _faults.get().active:
+            # chaos site: the engine faults mid-decode — the supervisor must
+            # restart it, re-init the page pool and replay in-flight work;
+            # gated on live slots so step=N counts real decode attempts
+            _faults.get().maybe_raise("decode_raise")
         s = self.cache.max_slots
         tokens = np.zeros(s, np.int32)
         positions = np.zeros(s, np.int32)
@@ -251,10 +333,19 @@ class ServingSession:
             if reason is not None:
                 self.scheduler.retire(slot, reason)
 
-    def step(self) -> bool:
-        """One engine iteration: retire/admit at the boundary, then one
-        decode step. Returns True when any work was done."""
-        self._admit()
+    def step(self, now: Optional[float] = None) -> bool:
+        """One engine iteration: reap expired/cancelled requests, then
+        retire/admit at the boundary, then one decode step. Returns True
+        when any work was done."""
+        if now is None:
+            # clock-ok: the ONE sanctioned wall-clock read per engine step —
+            # deadline expiry, cancellation reaping and admission stamps all
+            # batch off this single timestamp (a per-request read would scale
+            # with occupancy; tests/test_lint_hotloop.py pins this site)
+            now = time.monotonic()
+        self._last_progress = now  # supervisor stall-watchdog heartbeat
+        self.scheduler.reap(now)
+        self._admit(now)
         before = self.decode_steps
         self._decode_once()
         return self.decode_steps != before or bool(self.scheduler.active_slots())
@@ -265,41 +356,169 @@ class ServingSession:
         while self.scheduler.has_work():
             self.step()
 
-    # -- background engine thread (server mode) -----------------------------
+    # -- supervised engine thread (server mode) -----------------------------
     def serve_forever(self) -> "ServingSession":
-        def _loop():
-            while not self._stop.is_set():
-                if not self.scheduler.has_work():
-                    with self._work:
-                        self._work.wait(timeout=0.05)
-                    continue
-                try:
-                    self.step()
-                except Exception as e:  # noqa: BLE001 — a dead engine thread
-                    # must not look like a healthy-but-slow server: record the
-                    # error (new submits raise it), fail every outstanding
-                    # handle so blocked callers wake NOW, and stop. The state
-                    # may be unrecoverable anyway — a failed _decode consumed
-                    # the donated page buffers. (The trainer's precedent:
-                    # AsyncCheckpointer re-raises on the training thread.)
-                    import logging
+        """Start the SUPERVISED engine: a supervisor thread spawns the
+        engine thread and watches it — a fault or stall triggers recovery
+        (pool re-init + in-flight replay) up to `engine_restart_max` times,
+        after which every outstanding request fails `engine_error` (the
+        trainer's precedent: fail loudly, never look healthy-but-slow).
 
-                    logging.getLogger("paddle_tpu.serving").exception(
-                        "serving engine step failed; failing %d outstanding "
-                        "request(s) and stopping",
-                        len(self.scheduler.active_slots())
-                        + self.scheduler.queue_depth(),
-                    )
-                    self.engine_error = e
-                    self._fail_outstanding()
-                    self._stop.set()
-                    return
-
+        Idempotent: a second call while supervised is a no-op — two
+        supervisors would race two engine threads over the same donated
+        page pools (ServingServer.start + a manual caller is the easy way
+        to get here)."""
+        if self._thread is not None:
+            return self
         self._thread = threading.Thread(
-            target=_loop, name="serving-engine", daemon=True
+            target=self._supervise, name="serving-supervisor", daemon=True
         )
         self._thread.start()
         return self
+
+    def _engine_loop(self, gen: int) -> None:
+        """The engine proper, pinned to generation `gen`: superseded threads
+        (a stall recovery bumped the generation while this one was wedged)
+        notice at the loop guard and exit WITHOUT touching session state."""
+        while not self._stop.is_set() and self._engine_gen == gen:
+            if not self.scheduler.has_work():
+                with self._work:
+                    self._work.wait(timeout=0.05)
+                continue
+            if _faults.maybe_stall(
+                "engine_stall", env="PADDLE_TPU_SERVING_STALL_S",
+                default_s=300.0,
+            ):
+                continue  # woke superseded: the loop guard re-checks gen
+            # _engine_in_step gates the stall watchdog: a slow step (first-
+            # step jit compile can take seconds) must never read as a stall —
+            # only a wedge BETWEEN steps (the seeded site above, the only
+            # place recovery can safely supersede this thread) counts. The
+            # gen re-check and the flag flip are ATOMIC under _gen_lock: a
+            # zombie waking between the loop guard and here would otherwise
+            # race the supervisor's bump-then-recover into a concurrent step
+            with self._gen_lock:
+                if self._stop.is_set() or self._engine_gen != gen:
+                    return
+                self._engine_in_step = True
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — hand the fault to the
+                # supervisor (recovery or give-up happens there, off the
+                # engine thread); BaseException stays fatal on purpose
+                self._engine_fault = e
+                return
+            finally:
+                self._engine_in_step = False
+
+    def _supervise(self) -> None:
+        log = logging.getLogger("paddle_tpu.serving")
+        poll_s = max(0.02, min(0.25, self.engine_stall_timeout_s / 4.0))
+        while not self._stop.is_set():
+            gen = self._engine_gen
+            self._engine_fault = None
+            # clock-ok: once per engine (re)start — the watchdog anchor
+            self._last_progress = time.monotonic()
+            eng = threading.Thread(
+                target=self._engine_loop, args=(gen,),
+                name="serving-engine", daemon=True,
+            )
+            eng.start()
+            cause: Optional[str] = None
+            busy_since: Optional[float] = None
+            stale_polls = 0
+            while not self._stop.is_set():
+                eng.join(timeout=poll_s)
+                if not eng.is_alive():
+                    if self._engine_fault is None:
+                        return  # clean stop
+                    cause = "fault"
+                    break
+                # stall watchdog: only meaningful while work is pending AND
+                # the engine sits between steps (an in-flight step may be a
+                # multi-second first compile — and a mid-step thread cannot
+                # be superseded safely anyway); anchored at the LATER of
+                # last step start / when the queue last became non-empty, so
+                # idle periods never read as stalls and a flood of submits
+                # cannot mask a real one. Two consecutive stale samples
+                # required, closing the microsecond between-steps window.
+                now = time.monotonic()  # clock-ok: watchdog poll (4-16 Hz)
+                if not self.scheduler.has_work():
+                    busy_since = None
+                    stale_polls = 0
+                    continue
+                if busy_since is None:
+                    busy_since = now
+                if (not self._engine_in_step
+                        and now - max(self._last_progress, busy_since)
+                        > self.engine_stall_timeout_s):
+                    stale_polls += 1
+                    if stale_polls >= 2:
+                        # atomic supersede: bump the generation under the
+                        # same lock the engine takes to enter a step, and
+                        # only while it is still BETWEEN steps — a zombie
+                        # that slipped into step() since the last sample
+                        # keeps its generation and we go back to watching
+                        # instead of re-initializing pools under its feet
+                        with self._gen_lock:
+                            if not self._engine_in_step:
+                                self._engine_gen += 1
+                                cause = "stall"
+                        if cause is not None:
+                            break
+                        stale_polls = 0
+                else:
+                    stale_polls = 0
+            if self._stop.is_set():
+                return
+            if cause == "fault":
+                # the engine thread exited on its own (we saw it dead), so
+                # no zombie can race recovery — bump for uniform invariants
+                with self._gen_lock:
+                    self._engine_gen += 1
+            err = self._engine_fault
+            if self.engine_restarts >= self.engine_restart_max:
+                self.engine_error = err or RuntimeError(
+                    f"serving engine stalled >"
+                    f"{self.engine_stall_timeout_s}s and the restart budget "
+                    f"({self.engine_restart_max}) is exhausted"
+                )
+                log.error(
+                    "serving engine %s and restart budget (%d) exhausted; "
+                    "failing %d outstanding request(s) and stopping",
+                    cause, self.engine_restart_max,
+                    len(self.scheduler.active_slots())
+                    + self.scheduler.queue_depth(),
+                )
+                self._fail_outstanding()
+                self._stop.set()
+                return
+            self._recover(cause, err, log)
+
+    def _recover(self, cause: str, err: Optional[BaseException],
+                 log: logging.Logger) -> None:
+        """Engine restart: fresh page pool (the dead engine's donated
+        buffers are consumed), in-flight requests replayed from their
+        prompts (greedy decode is deterministic — result-transparent),
+        past-deadline ones failed with the named reason."""
+        t0 = time.monotonic()  # clock-ok: once per engine restart
+        self.engine_restarts += 1
+        SERVING_EVENTS.incr("serving_engine_restarts")
+        obs_metrics.observe_engine_restart(cause)
+        requeued, expired = self.scheduler.requeue_active(t0)
+        self.cache.reset()
+        self.k_pages, self.v_pages = self.cache.make_pools()
+        SERVING_EVENTS.incr("serving_requests_replayed", requeued)
+        trace.span_from_monotonic(
+            "serving.engine_restart", t0,
+            attrs={"cause": cause, "requeued": requeued, "expired": expired},
+        )
+        log.warning(
+            "serving engine %s (%r); restart %d/%d: page pool re-initialized, "
+            "%d in-flight request(s) replayed, %d failed past-deadline",
+            cause, err, self.engine_restarts, self.engine_restart_max,
+            requeued, expired,
+        )
 
     def _fail_outstanding(self) -> None:
         """Complete every waiting + running handle as CANCELLED('engine_error')
@@ -324,6 +543,8 @@ class ServingSession:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._gen_lock:
+            self._engine_gen += 1  # supersede any wedged engine thread
         with self._work:
             self._work.notify_all()
         if self._thread is not None:
@@ -352,6 +573,11 @@ class ServingSession:
             "completed": sch.completed,
             "rejected": sch.rejected,
             "cancelled": sch.cancelled,
+            "shed": sch.shed,
+            "deadline_misses": sch.deadline_misses,
+            "pages_recycled_on_cancel": sch.pages_recycled_on_cancel,
+            "engine_restarts": self.engine_restarts,
+            "estimated_queue_wait_s": round(sch.estimate_wait_s(), 4),
             "prefill_buckets": list(self.buckets),
         }
 

@@ -42,32 +42,54 @@ def run_closed_loop(
     max_new_tokens: int,
     concurrency: int,
     tenant: str = "default",
+    deadline_s: Optional[float] = None,
+    ttft_deadline_s: Optional[float] = None,
 ) -> Dict:
     """Drive `session` single-threaded: keep up to `concurrency` requests in
     flight, stepping the engine until all prompts complete. Returns
-    tokens/sec plus p50/p99 request latency."""
+    tokens/sec plus p50/p99/p999 request latency and (when deadlines are
+    armed) the deadline-miss and shed columns — present either way, so
+    bench rounds stay comparable. Throughput and the percentiles count only
+    requests that COMPLETED: a deadline-cancelled request's partial tokens
+    and truncated latency would otherwise flatter the overloaded run
+    (higher tok/s, lower p99) exactly when it is failing."""
+    from paddle_tpu.serving.quota import QuotaExceeded
+
     pending = list(enumerate(prompts))
     in_flight = {}  # request_id -> (index, handle)
     latencies_ms: List[float] = []
     tokens_out = 0
+    shed = 0
+    deadline_missed = 0
     results: List[Optional[List[int]]] = [None] * len(prompts)
 
     t0 = time.monotonic()
     while pending or in_flight:
         while pending and len(in_flight) < concurrency:
             idx, prompt = pending.pop(0)
-            h = session.submit(prompt, max_new_tokens, tenant=tenant)
+            try:
+                h = session.submit(
+                    prompt, max_new_tokens, tenant=tenant,
+                    deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+                )
+            except QuotaExceeded:
+                shed += 1
+                continue
             in_flight[h.request_id] = (idx, h)
         session.step()
         done = [rid for rid, (_, h) in in_flight.items() if h.done]
         for rid in done:
             idx, h = in_flight.pop(rid)
-            results[idx] = h.tokens
-            tokens_out += len(h.tokens)
-            latencies_ms.append((h.t_done - h.t_submit) * 1e3)
+            if h.status == h.DONE:
+                results[idx] = h.tokens
+                tokens_out += len(h.tokens)
+                latencies_ms.append((h.t_done - h.t_submit) * 1e3)
+            elif h.finish_reason == "deadline":
+                deadline_missed += 1
     dt = time.monotonic() - t0
 
-    lat = np.asarray(latencies_ms)
+    lat = np.asarray(latencies_ms) if latencies_ms else np.asarray([0.0])
+    accepted = len(latencies_ms) + deadline_missed
     return {
         "concurrency": concurrency,
         "requests": len(prompts),
@@ -76,5 +98,71 @@ def run_closed_loop(
         "tokens_per_sec": round(tokens_out / dt, 1) if dt > 0 else 0.0,
         "p50_latency_ms": round(float(np.percentile(lat, 50)), 2),
         "p99_latency_ms": round(float(np.percentile(lat, 99)), 2),
+        "p999_latency_ms": round(float(np.percentile(lat, 99.9)), 2),
+        "shed": shed,
+        "deadline_misses": deadline_missed,
+        "deadline_miss_ratio": round(deadline_missed / accepted, 4)
+        if accepted else 0.0,
         "results": results,
+    }
+
+
+def run_open_loop(
+    session,
+    prompts: List[List[int]],
+    max_new_tokens: int,
+    rate_rps: float,
+    tenants: Sequence[str] = ("default",),
+    deadline_s: Optional[float] = None,
+    ttft_deadline_s: Optional[float] = None,
+) -> Dict:
+    """Open-loop (offered-load) driver — the overload model: arrivals land
+    at `rate_rps` REGARDLESS of completions, so offered load above capacity
+    builds a queue instead of throttling itself (the closed loop can never
+    overload a server; this is what exercises shedding). The engine is
+    driven inline on this thread, one step per iteration, arrivals replayed
+    from a fixed schedule, so a run is reproducible modulo host timing.
+
+    Goodput = requests that completed WITHIN their deadline per second of
+    wall clock — the number the chaos bench's 2× overload gate compares
+    against the at-capacity run."""
+    from paddle_tpu.serving.quota import QuotaExceeded
+
+    n = len(prompts)
+    interval = 1.0 / float(rate_rps)
+    handles = []
+    shed = 0
+    i = 0
+    t0 = time.monotonic()
+    while i < n or session.scheduler.has_work():
+        now = time.monotonic()
+        while i < n and t0 + i * interval <= now:
+            try:
+                handles.append(session.submit(
+                    prompts[i], max_new_tokens,
+                    tenant=tenants[i % len(tenants)],
+                    deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+                ))
+            except QuotaExceeded:
+                shed += 1
+            i += 1
+        if session.scheduler.has_work():
+            session.step(now)
+        elif i < n:
+            time.sleep(max(0.0, min(0.002, t0 + i * interval - now)))
+    dt = time.monotonic() - t0
+
+    completed_ok = sum(1 for h in handles if h.status == h.DONE)
+    missed = sum(1 for h in handles if h.finish_reason == "deadline")
+    return {
+        "offered_rps": round(rate_rps, 2),
+        "requests_offered": n,
+        "accepted": len(handles),
+        "shed": shed,
+        "completed_ok": completed_ok,
+        "deadline_misses": missed,
+        "deadline_miss_ratio": round(missed / len(handles), 4)
+        if handles else 0.0,
+        "goodput_rps": round(completed_ok / dt, 2) if dt > 0 else 0.0,
+        "wall_s": round(dt, 4),
     }

@@ -23,7 +23,12 @@ This test fails the build if a sync-forcing call — float(...),
 np.isfinite(...), .item(...), jax.device_get(...), block_until_ready(...),
 and for the serving loop also np.asarray(...) — appears inside a hot-loop
 body without a `sync-ok` tag on the line or within the few lines above it,
-so a per-step sync cannot sneak back in as an innocent-looking one-liner."""
+so a per-step sync cannot sneak back in as an innocent-looking one-liner.
+
+ISSUE 10 added a sibling discipline for the serving request path: deadline
+enforcement batches off ONE wall-clock read per engine step, so untagged
+time.monotonic()/time.time() in the engine/scheduler/supervisor bodies trip
+the `clock-ok` lint below."""
 
 import ast
 import os
@@ -32,6 +37,7 @@ import re
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRAINER_PY = os.path.join(_REPO, "paddle_tpu", "trainer", "trainer.py")
 SERVING_PY = os.path.join(_REPO, "paddle_tpu", "serving", "session.py")
+SCHEDULER_PY = os.path.join(_REPO, "paddle_tpu", "serving", "scheduler.py")
 
 # calls that force a device sync when applied to a device array; jnp.* ops
 # (async, traced) are deliberately NOT matched — hence the lookbehinds
@@ -208,6 +214,64 @@ def test_sanctioned_cast_sites_stay_rare():
             f"{budget}): a new sanctioned cast was added to the compiled "
             "step — confirm it is not a policy cast bypassing Policy.cast "
             "and bump this bound deliberately"
+        )
+
+
+# -- wall-clock sites (ISSUE 10 serving resilience) ---------------------------
+#
+# Deadline enforcement batches off ONE wall-clock read per engine step: the
+# session's step() takes the timestamp and hands it to reap / pop_admissions
+# / the admission stamps, so expiry cost never scales with occupancy or
+# queue depth. A per-request time.monotonic() in these bodies is exactly the
+# regression this lint exists to catch. The sanctioned reads — the step
+# stamp, the supervisor's watchdog poll (4-16 Hz, off the engine thread),
+# the once-per-restart recovery stamp, the once-per-request TTFT stamp, and
+# the test-only `now is None` fallbacks — carry `clock-ok` tags with the
+# counts pinned below.
+
+CLOCK_CALL = re.compile(
+    r"(?<![\w.])time\.monotonic\(|(?<![\w.])time\.time\("
+)
+CLOCK_TAG = "clock-ok"
+# (file, class, methods on the request path, max clock-ok tags)
+CLOCK_HOT_LOOPS = [
+    (SERVING_PY, "ServingSession",
+     ("step", "_admit", "_decode_once", "_engine_loop", "_supervise",
+      "_recover"), 4),
+    (SCHEDULER_PY, "Scheduler",
+     ("reap", "pop_admissions", "requeue_active", "retire"), 3),
+    (SCHEDULER_PY, "ActiveSeq", ("append", "finished"), 1),
+]
+
+
+def test_no_untagged_wallclock_in_serving_loops():
+    """Wall-clock syscalls in the serving engine/scheduler request path must
+    be tagged: deadline checks batch off the single per-step timestamp, so
+    an untagged read is either a per-request syscall (the cost regression)
+    or a second clock that lets expiry decisions disagree within one step."""
+    violations = []
+    for path, cls, methods, _budget in CLOCK_HOT_LOOPS:
+        v, _ = _scan(path, cls, methods, CLOCK_CALL, tag=CLOCK_TAG)
+        violations += v
+    assert not violations, (
+        "untagged wall-clock read in the serving request path — thread the "
+        "step() timestamp through instead (one read per engine step feeds "
+        "every deadline/cancellation check), or tag a genuinely "
+        "non-per-request site with `# clock-ok: <why>`:\n  "
+        + "\n  ".join(violations)
+    )
+
+
+def test_sanctioned_clock_sites_stay_rare():
+    """clock-ok is a justification, not a loophole: the count is pinned so a
+    new clock read in the serving request path forces a review here."""
+    for path, cls, methods, budget in CLOCK_HOT_LOOPS:
+        _, tagged = _scan(path, cls, methods, CLOCK_CALL, tag=CLOCK_TAG)
+        assert len(tagged) <= budget, (
+            f"{len(tagged)} clock-ok tags in the {cls} request path "
+            f"(expected <= {budget}): a new sanctioned wall-clock site was "
+            "added — confirm it is not per-request/per-step-per-slot and "
+            "bump this bound deliberately"
         )
 
 
