@@ -394,6 +394,27 @@ def run_serving(cpu_fallback: bool) -> dict:
         if seq["tokens_per_sec"]
         else 0.0
     )
+
+    # chunked-prefill ITL column (ISSUE 11): the same 16-stream run with
+    # long prompts joining mid-stream, chunked — p99 inter-token latency is
+    # the no-stall number the serving_bench mixed-length leg gates at 0.5x
+    # of the whole-prompt baseline; here the chunked leg alone rides the
+    # cross-round metric (cheap), the full A/B lives in serving_bench
+    from paddle_tpu.serving.workload import make_mixed_prompts
+
+    chunk_session = make_demo_session(
+        vocab=256, n_layers=2, d_model=64, n_heads=2, seed=0,
+        max_slots=16, page_size=16, prefill_buckets=(16, 32),
+        max_new_limit=max_new, max_len=96 + max_new, prefill_chunk=16,
+    )
+    run_closed_loop(chunk_session, warm_prompts, max_new, concurrency=2)
+    mixed = make_mixed_prompts(
+        requests, short_lengths=(5, 11, 16), long_len=96, long_every=8,
+        burst=2, vocab=256, bos_id=1, seed=1,
+    )
+    chunks_before = chunk_session.prefill_chunks_committed  # warmup's chunks
+    chunk_res = run_closed_loop(chunk_session, mixed, max_new, concurrency=16)
+
     return {
         "metric": "serving_tokens_per_sec_16_streams",
         "value": bat["tokens_per_sec"],
@@ -404,6 +425,10 @@ def run_serving(cpu_fallback: bool) -> dict:
         "platform": jax.devices()[0].platform,
         "p50_latency_ms": bat["p50_latency_ms"],
         "p99_latency_ms": bat["p99_latency_ms"],
+        "p99_inter_token_ms": bat["p99_inter_token_ms"],
+        "mixed_chunked_p99_inter_token_ms": chunk_res["p99_inter_token_ms"],
+        "mixed_chunked_prefill_chunks":
+            chunk_session.prefill_chunks_committed - chunks_before,
         "sequential_tokens_per_sec": seq["tokens_per_sec"],
         "sequential_p50_latency_ms": seq["p50_latency_ms"],
         "decode_recompiles_after_warmup": bat["decode_recompiles_after_warmup"],

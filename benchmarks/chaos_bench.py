@@ -47,7 +47,12 @@ LM, every leg carrying its own "platform" tag:
     offers 1× and 2× that rate with per-request deadlines armed — the gate
     is goodput (completed-within-deadline/s) at 2× within 20% of the
     at-capacity run, i.e. load-aware shedding keeps goodput flat instead of
-    letting the queue drag every request past its deadline.
+    letting the queue drag every request past its deadline;
+  * sampling-replay leg (ISSUE 11): the decode_raise crash drill repeated
+    with on-device sampling armed (temperature 0.8, top_k 20) — the gate is
+    the faulted run's tokens BITWISE-equal to an unfaulted run's, proving
+    the per-request seed + token-step key makes crash replay
+    result-transparent beyond greedy.
 
 Usage:
   JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py
@@ -626,6 +631,72 @@ def serving_crash_leg(args, site: str, spec: str, backend: str) -> dict:
     }
 
 
+def serving_sampling_replay_leg(args, backend: str) -> dict:
+    """ISSUE 11: SAMPLED decode (temperature/top-k through per-request
+    seeded keys) must stay result-transparent across an engine crash — the
+    supervisor's replay reuses each request's seed and token step indices,
+    so the faulted run's tokens are BITWISE-equal to an unfaulted run's."""
+    import time as _time
+
+    from paddle_tpu.core import faults
+    from paddle_tpu.serving.workload import make_prompts
+
+    prompts = make_prompts(
+        args.serving_requests, lengths=(5, 8, 11, 16), vocab=128, bos_id=1,
+        seed=args.seed,
+    )
+
+    def run(spec):
+        s = _serving_session(
+            args, engine_stall_timeout_s=args.serving_stall_timeout_s,
+            engine_restart_max=5,
+        )
+        handles = []
+        s.serve_forever()
+        inj_cm = faults.inject(spec, seed=args.seed) if spec else None
+        try:
+            if inj_cm is not None:
+                inj = inj_cm.__enter__()
+            for i, p in enumerate(prompts):
+                # per-request seeds default from the request id: both runs
+                # submit in the same order, so seeds match across runs
+                handles.append(s.submit(
+                    p, args.serving_max_new, tenant=f"tenant{i % 3}",
+                    deadline_s=120.0, temperature=0.8, top_k=20,
+                ))
+                _time.sleep(args.serving_submit_gap_ms / 1e3)
+            deadline = _time.time() + 120
+            for h in handles:
+                h._event.wait(max(0.1, deadline - _time.time()))
+            fired = dict(inj.fired) if inj_cm is not None else {}
+        finally:
+            if inj_cm is not None:
+                inj_cm.__exit__(None, None, None)
+        s.stop()
+        return ([h.tokens for h in handles],
+                [h.finish_reason for h in handles], fired, s.engine_restarts)
+
+    clean_toks, _, _, _ = run(None)
+    spec = f"decode_raise:step={args.serving_kill_step}"
+    fault_toks, reasons, fired, restarts = run(spec)
+    named = _named_reasons()
+    bitwise = clean_toks == fault_toks
+    return {
+        "spec": spec,
+        "platform": backend,
+        "temperature": 0.8,
+        "top_k": 20,
+        "fault_fired": fired.get("decode_raise", 0),
+        "engine_restarts": restarts,
+        "sampled_replay_bitwise_equal": bool(bitwise),
+        "all_named": all(r in named for r in reasons),
+        "all_gates_pass": bool(
+            bitwise and restarts >= 1 and fired.get("decode_raise", 0) >= 1
+            and all(r in named for r in reasons)
+        ),
+    }
+
+
 def serving_overload_leg(args, backend: str) -> dict:
     """Capacity closed-loop, then open-loop at 1× and 2× capacity with
     deadlines armed: the goodput-retention gate (2× within 20% of the
@@ -727,6 +798,9 @@ def run_serving(args) -> dict:
             args, "page_exhaust", "page_exhaust:step=0", backend,
         ),
     }
+    # ISSUE 11: crash replay must stay bitwise WITH sampling enabled (the
+    # per-request seed + token-step key contract)
+    legs["sampling_replay"] = serving_sampling_replay_leg(args, backend)
     overload = serving_overload_leg(args, backend)
     # the resilience counters must be READABLE off the obs plane — the same
     # registry the serving `metrics` RPC serves

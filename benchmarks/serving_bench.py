@@ -19,6 +19,9 @@ paged KV cache).
 Acceptance gates (printed in the JSON line):
   * speedup_16 >= 3.0      tokens/sec at 16 streams vs sequential
   * decode_recompiles_after_warmup == 0 over the mixed-length stream
+  * mixed-length leg (ISSUE 11): p99 INTER-TOKEN latency with chunked
+    prefill <= 0.5x the whole-prompt-prefill baseline at 16 streams when
+    long prompts join mid-stream, with identical tokens across the legs
 
 Usage:
   JAX_PLATFORMS=cpu python benchmarks/serving_bench.py
@@ -83,6 +86,143 @@ def run_one(args, concurrency: int, prompts):
     return res, tokens
 
 
+def run_mixed_length(args):
+    """Chunked-prefill no-stall gate (ISSUE 11): 16 short-prompt streams with
+    LONG prompts joining mid-stream, measured as p99 inter-token latency.
+    Two legs over identical geometry and workload: whole-prompt prefill (the
+    long prompt's full forward runs inside one engine step, stalling every
+    running stream's next token) vs chunked prefill (the same prompt commits
+    `--prefill_chunk` tokens per step, interleaved with decode). The gate is
+    chunked p99 ITL <= 0.5x the whole-prompt baseline — the stall is the
+    thing being measured, so this only means anything on the SAME platform
+    tag. Tokens must also be identical across the legs (chunked prefill is
+    result-transparent)."""
+    import jax
+
+    from paddle_tpu.serving.session import make_demo_session
+    from paddle_tpu.serving.workload import (
+        make_mixed_prompts, make_prompts, run_closed_loop,
+    )
+
+    long_len = args.mixed_long_len
+    buckets = (16, 32, long_len)  # baseline needs a bucket covering the long prompts
+
+    def leg(prefill_chunk):
+        # the leg uses its own (bigger) model than the throughput grid: the
+        # stall being measured is the long prompt's whole-context forward,
+        # which must dominate per-dispatch overhead for the ratio to mean
+        # anything — at toy dims the measurement is all dispatch noise
+        # page pool sized for the REAL mix (16 short streams + 2 concurrent
+        # long prompts), not the worst case of every slot at full context:
+        # admission control already queues a long prompt the pool cannot
+        # host, and on CPU (no buffer donation) every pool-touching program
+        # copies the whole pool, so worst-case sizing would swamp the very
+        # stall this leg measures — same pool for BOTH legs, so the ratio
+        # isolates chunking
+        short_pages = -(-(16 + args.max_new) // args.page_size)
+        long_pages = -(-(long_len + args.max_new) // args.page_size)
+        num_pages = 20 * short_pages + 2 * args.mixed_burst * long_pages + 1
+        # max_slots > stream count: spare slots + a page budget for the burst
+        # mean a long prompt admits at the NEXT boundary while all 16 short
+        # streams keep decoding — otherwise the burst queues at the FIFO
+        # head, admissions behind it stall, and the batch drains before the
+        # big prefill even runs (the stall would land on an empty batch and
+        # the ITL percentiles would never see it)
+        session = make_demo_session(
+            vocab=args.vocab, n_layers=args.n_layers,
+            d_model=args.mixed_d_model, n_heads=args.mixed_n_heads, seed=0,
+            max_slots=20, page_size=args.page_size, num_pages=num_pages,
+            prefill_buckets=buckets, max_new_limit=args.max_new,
+            max_len=long_len + args.max_new,
+            prefill_chunk=prefill_chunk,
+        )
+        # warmup touches every executable (all buckets + the chunk program +
+        # decode) so compile time never pollutes the measured ITL
+        warm = make_prompts(
+            len(buckets), lengths=buckets, vocab=args.vocab, bos_id=1, seed=7,
+        )
+        run_closed_loop(session, warm, args.max_new, concurrency=len(warm))
+        sigs0 = session.decode_shape_signatures()
+        session.scheduler.reset_load_estimate()
+        prompts = make_mixed_prompts(
+            args.requests, short_lengths=(5, 11, 16), long_len=long_len,
+            long_every=12, burst=args.mixed_burst, vocab=args.vocab,
+            bos_id=1, seed=1,
+        )
+        # per-request token budgets STAGGER retirements: with one shared
+        # budget every stream retires in the same step, admissions ride the
+        # wave boundary, and the whole-prompt stall lands on an empty batch
+        # instead of the 16 live streams it is supposed to be measured against
+        spread = max(1, args.max_new - 5)
+        budgets = [
+            args.max_new if len(p) > 16
+            else min(args.max_new, 6 + (7 * i) % spread)
+            for i, p in enumerate(prompts)
+        ]
+        # the ITL tail is the measurement: collect BEFORE and hold GC off
+        # DURING the run so collector pauses from earlier legs' garbage
+        # (the 64-stream grid runs first in a default invocation) don't
+        # masquerade as scheduling stalls in either leg's p99
+        import gc
+
+        gc.collect()
+        gc.disable()
+        try:
+            res = run_closed_loop(session, prompts, budgets, concurrency=16)
+        finally:
+            gc.enable()
+        tokens = res.pop("results")
+        res.update({
+            "platform": jax.devices()[0].platform,
+            "prefill_chunk": prefill_chunk,
+            "long_len": long_len,
+            "decode_recompiles_after_warmup":
+                session.decode_shape_signatures() - sigs0,
+            "prefill_chunks_committed": session.prefill_chunks_committed,
+        })
+        return res, tokens
+
+    # best-of-N per leg: host noise (GC pauses, CPU contention) lands
+    # straight in a single run's p99 tail — the MIN across repeats keeps the
+    # deterministic stall component, which is the thing under measurement
+    # (alternate the legs so slow host phases hit both)
+    whole_runs, chunked_runs = [], []
+    for _ in range(args.mixed_repeats):
+        whole_runs.append(leg(None))
+        chunked_runs.append(leg(args.prefill_chunk))
+    whole, whole_tokens = min(
+        whole_runs, key=lambda rt: rt[0]["p99_inter_token_ms"]
+    )
+    chunked, chunked_tokens = min(
+        chunked_runs, key=lambda rt: rt[0]["p99_inter_token_ms"]
+    )
+    ratio = (
+        chunked["p99_inter_token_ms"] / whole["p99_inter_token_ms"]
+        if whole["p99_inter_token_ms"] > 0 else 0.0
+    )
+    out = {
+        "whole_prompt": whole,
+        "chunked": chunked,
+        "whole_p99_runs": [r[0]["p99_inter_token_ms"] for r in whole_runs],
+        "chunked_p99_runs": [r[0]["p99_inter_token_ms"] for r in chunked_runs],
+        "p99_itl_ratio_chunked_vs_whole": round(ratio, 3),
+        "chunked_itl_le_half": bool(ratio <= 0.5),
+        "chunked_result_transparent": bool(chunked_tokens == whole_tokens),
+        "zero_decode_recompiles": bool(
+            whole["decode_recompiles_after_warmup"] == 0
+            and chunked["decode_recompiles_after_warmup"] == 0
+        ),
+    }
+    print(
+        f"[serving_bench] mixed-length: whole p99_itl="
+        f"{whole['p99_inter_token_ms']}ms chunked p99_itl="
+        f"{chunked['p99_inter_token_ms']}ms ratio={out['p99_itl_ratio_chunked_vs_whole']} "
+        f"transparent={out['chunked_result_transparent']}",
+        file=sys.stderr,
+    )
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--streams", default="1,4,16,64")
@@ -95,6 +235,20 @@ def main():
                          "either way so rounds stay comparable")
     ap.add_argument("--max_slots", type=int, default=16)
     ap.add_argument("--page_size", type=int, default=16)
+    ap.add_argument("--prefill_chunk", type=int, default=16,
+                    help="chunk size for the mixed-length leg's chunked side")
+    ap.add_argument("--mixed_long_len", type=int, default=640,
+                    help="long-prompt length joining mid-stream in the "
+                         "mixed-length leg")
+    ap.add_argument("--mixed_d_model", type=int, default=256)
+    ap.add_argument("--mixed_burst", type=int, default=3,
+                    help="long prompts arriving together in each burst")
+    ap.add_argument("--mixed_repeats", type=int, default=3,
+                    help="repeats per mixed-length leg; min-p99 is reported "
+                         "(filters host-noise spikes out of the tail)")
+    ap.add_argument("--mixed_n_heads", type=int, default=4)
+    ap.add_argument("--skip_mixed", action="store_true",
+                    help="skip the mixed-length chunked-prefill leg")
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--n_layers", type=int, default=2)
     ap.add_argument("--d_model", type=int, default=64)
@@ -138,6 +292,7 @@ def main():
     # concurrency level produced identical tokens for every request
     consistent = all(t == token_sets[min(token_sets)] for t in token_sets.values())
     speedup_16 = by_n.get(16, {}).get("speedup_vs_sequential", 0.0)
+    mixed = None if args.skip_mixed else run_mixed_length(args)
     gates = {
         "speedup_16_vs_sequential": speedup_16,
         "speedup_16_ge_3x": bool(speedup_16 >= 3.0),
@@ -147,6 +302,15 @@ def main():
         "batching_bitwise_transparent": bool(consistent),
     }
     ok = gates["speedup_16_ge_3x"] and gates["zero_decode_recompiles"] and consistent
+    if mixed is not None:
+        gates["mixed_chunked_itl_le_half_whole"] = mixed["chunked_itl_le_half"]
+        gates["mixed_chunked_result_transparent"] = (
+            mixed["chunked_result_transparent"]
+        )
+        gates["mixed_zero_decode_recompiles"] = mixed["zero_decode_recompiles"]
+        ok = (ok and mixed["chunked_itl_le_half"]
+              and mixed["chunked_result_transparent"]
+              and mixed["zero_decode_recompiles"])
     print(json.dumps({
         "metric": "serving_bench",
         "value": speedup_16,
@@ -154,6 +318,7 @@ def main():
         "all_gates_pass": bool(ok),
         "gates": gates,
         "results": results,
+        "mixed_length": mixed,
     }))
 
 

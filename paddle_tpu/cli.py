@@ -839,6 +839,21 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
                    help="KV page pool size (0 = worst case for max_slots)")
     p.add_argument("--prefill_buckets", default="16,32,64",
                    help="padded prompt lengths; one prefill compile each")
+    p.add_argument("--prefill_chunk", type=int, default=0,
+                   help="chunked prefill (0 = off): prompts longer than this "
+                        "commit their KV one C-token chunk per engine step, "
+                        "interleaved with decode, so a long prompt joining "
+                        "mid-stream never stalls running streams' inter-token "
+                        "latency; also lifts the bucket cap on prompt length "
+                        "(any prompt up to the model's max_len is admissible)")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="default sampling temperature for requests that do "
+                        "not set one (0 = greedy argmax); sampling is "
+                        "on-device through a per-request seeded key, so "
+                        "engine-crash replay regenerates identical tokens")
+    p.add_argument("--top_k", type=int, default=0,
+                   help="default top-k truncation for requests that do not "
+                        "set one (0 = off)")
     p.add_argument("--max_new_limit", type=int, default=64)
     p.add_argument("--max_queue", type=int, default=256)
     p.add_argument("--tenant_tokens", type=float, default=0.0,
@@ -875,6 +890,11 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
              "server's stats() so deployments see control-plane degradation",
     )
     # demo model shape knobs (ignored with --load)
+    p.add_argument("--max_len", type=int, default=0,
+                   help="demo model position-embedding capacity (0 = largest "
+                        "bucket + max_new_limit); raise it with "
+                        "--prefill_chunk so chunked prefill has headroom for "
+                        "prompts beyond the buckets")
     p.add_argument("--vocab", type=int, default=128)
     p.add_argument("--n_layers", type=int, default=2)
     p.add_argument("--d_model", type=int, default=32)
@@ -925,6 +945,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
             page_size=args.page_size,
             num_pages=args.num_pages or None,
             prefill_buckets=buckets,
+            prefill_chunk=args.prefill_chunk or None,
+            default_temperature=args.temperature,
+            default_top_k=args.top_k,
             max_new_limit=args.max_new_limit,
             max_queue=args.max_queue,
             quotas=quotas,
@@ -942,6 +965,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             session = make_demo_session(
                 vocab=args.vocab, n_layers=args.n_layers,
                 d_model=args.d_model, n_heads=args.n_heads, seed=args.seed,
+                max_len=args.max_len or None,
                 **session_kw,
             )
 

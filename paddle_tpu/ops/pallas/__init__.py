@@ -1,10 +1,11 @@
 """Pallas TPU kernels for the hot fused ops (SURVEY §7: "Pallas kernels only
-where fusion matters — LSTM/GRU step").
+where fusion matters — LSTM/GRU step"; ISSUE 9 fused attention; ISSUE 11
+ragged paged-attention decode, `paged_attention.py`).
 
 Dispatch policy: `enabled()` is on when running on TPU (or when
 PADDLE_TPU_PALLAS=1/interpret is forced); the lax.scan implementations in
-ops/rnn.py remain the oracle and the fallback for exotic activations /
-peepholes."""
+ops/rnn.py and the jnp gather path in serving/model.py remain the oracles
+and the fallback for exotic activations / peepholes / non-TPU backends."""
 
 from __future__ import annotations
 

@@ -1,0 +1,156 @@
+"""Ragged paged-attention decode kernel ("Ragged Paged Attention", PAPERS.md).
+
+The serving decode step's inner loop is attention over a paged KV cache:
+every slot owns a row of the block table mapping logical page j -> physical
+page id, and attends over its own committed tokens only (per-slot length
+masking — sequence length is *data*, never *shape*). The jnp path in
+`serving/model.ServableLM.decode_step` materializes that as a dense gather
+`k_pages[block_table]` — [S, P, PS, KD] per layer per step round-tripping
+HBM — before a masked softmax. This kernel is the TPU shape of the same
+computation:
+
+  * grid = (slots, pages_per_seq); the PAGE loop is the inner grid dim;
+  * the block table rides in as a SCALAR-PREFETCH operand, so each grid
+    step's k/v BlockSpec index map picks the slot's PHYSICAL page straight
+    out of it — the gather happens in the DMA engine, one [PS, KD] page at
+    a time, and the dense [S, P, PS, KD] intermediate never exists;
+  * per-slot length masking against the slot's own position (logical token
+    index <= position), so ragged mixed-age batches share the executable;
+  * numerically-stable ONLINE softmax in f32: running max / denominator /
+    weighted-value accumulator live in VMEM scratch across the page loop
+    (the flash-attention recurrence), flushed to the output on the last
+    page.
+
+Unused block-table entries point at dump page 0 and their logical indices
+exceed the slot's position, so they contribute exp(-1e9 - m) == 0 exactly —
+bitwise the same masking contract as the oracle.
+
+The jnp gather path remains the CPU oracle: `paged_attention_decode` must
+match it to float tolerance (argmax-equal under greedy decode) for every
+mixed length / block-table layout — asserted in interpret mode on CPU by
+tests/test_decode_fastpath.py, the same discipline as PR 9's fused
+attention kernel. Dispatch policy lives in `ops.pallas.enabled()`:
+TPU on by default, CPU oracle otherwise, PADDLE_TPU_PALLAS=interpret forces
+the kernel through the Pallas interpreter for the equality tests."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas import interpret_mode
+
+Array = jax.Array
+
+# must equal serving/model.NEG_INF: fully-masked pages then degrade to a
+# zero contribution exactly as the oracle's softmax does
+NEG_INF = -1e9
+
+
+def _paged_decode_kernel(
+    bt_ref,    # scalar prefetch: [S, P] block table (SMEM)
+    pos_ref,   # scalar prefetch: [S] positions (SMEM)
+    q_ref,     # [1, H, hd] — this slot's query, pre-scaled
+    k_ref,     # [1, PS, KD] — this grid step's physical page
+    v_ref,     # [1, PS, KD]
+    out_ref,   # [1, KD]
+    m_scr,     # VMEM [H, 1] running max
+    l_scr,     # VMEM [H, 1] running denominator
+    acc_scr,   # VMEM [H, hd] running weighted values
+    *,
+    page_size: int,
+    n_heads: int,
+    head_dim: int,
+):
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)  # [H, hd]
+    k = k_ref[0].reshape(page_size, n_heads, head_dim).astype(jnp.float32)
+    v = v_ref[0].reshape(page_size, n_heads, head_dim).astype(jnp.float32)
+    # scores for this page, per head: [H, PS] (q pre-scaled by the caller)
+    sc = jax.lax.dot_general(
+        q.reshape(n_heads, 1, head_dim), k.transpose(1, 2, 0),
+        (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32,
+    ).reshape(n_heads, page_size)
+    # ragged masking: logical token index within THIS slot's sequence
+    idx = p * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+    sc = jnp.where(idx <= pos_ref[s], sc, NEG_INF)
+    # online-softmax recurrence (f32 throughout)
+    m_prev = m_scr[:]
+    m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    probs = jnp.exp(sc - m_new)  # [H, PS]
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        probs.reshape(n_heads, 1, page_size), v.transpose(1, 0, 2),
+        (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32,
+    ).reshape(n_heads, head_dim)
+    acc_scr[:] = acc_scr[:] * alpha + pv
+    m_scr[:] = m_new
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _flush():
+        # l >= exp(0 - m) > 0 always: logical index 0 is <= every position
+        out_ref[0] = (acc_scr[:] / l_scr[:]).reshape(n_heads * head_dim)
+
+
+def paged_attention_decode(
+    q: Array,            # [S, KD] — one query token per slot
+    k_pages: Array,      # [NP, PS, KD] — one layer's physical page pool
+    v_pages: Array,      # [NP, PS, KD]
+    block_table: Array,  # [S, P] int32 logical->physical page map
+    positions: Array,    # [S] int32 — each slot's current token position
+    *,
+    scale: float,
+    n_heads: int,
+) -> Array:
+    """One decode step of ragged paged attention for all slots: [S, KD] f32
+    context, numerically equivalent to the jnp gather oracle in
+    `ServableLM.decode_step` (same masking, f32 softmax; the online
+    recurrence reassociates the sum so equality is to float tolerance,
+    argmax/token-exact under greedy decode)."""
+    s, kd = q.shape
+    ps = k_pages.shape[1]
+    pmax = block_table.shape[1]
+    hd = kd // n_heads
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, pmax),
+        in_specs=[
+            pl.BlockSpec((1, n_heads, hd), lambda i, j, bt, pos: (i, 0, 0)),
+            # the ragged gather: the block table (prefetched to SMEM before
+            # the body runs) drives which physical page the DMA fetches
+            pl.BlockSpec((1, ps, kd), lambda i, j, bt, pos: (bt[i, j], 0, 0)),
+            pl.BlockSpec((1, ps, kd), lambda i, j, bt, pos: (bt[i, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, kd), lambda i, j, bt, pos: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_heads, 1), jnp.float32),
+            pltpu.VMEM((n_heads, 1), jnp.float32),
+            pltpu.VMEM((n_heads, hd), jnp.float32),
+        ],
+    )
+    qs = (q.astype(jnp.float32) * scale).reshape(s, n_heads, hd)
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, page_size=ps, n_heads=n_heads, head_dim=hd
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, kd), jnp.float32),
+        interpret=interpret_mode(),
+    )(
+        block_table.astype(jnp.int32), positions.astype(jnp.int32),
+        qs, k_pages.astype(jnp.float32), v_pages.astype(jnp.float32),
+    )
+    return out

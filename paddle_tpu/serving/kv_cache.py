@@ -132,5 +132,12 @@ class PagedKVCache:
 
     def block_table(self) -> np.ndarray:
         """The [max_slots, max_pages_per_seq] int32 table (live view — copy
-        is taken by the device transfer itself)."""
+        is taken by the device transfer itself). On TPU this same table is
+        the SCALAR-PREFETCH operand of the ragged paged-attention kernel
+        (ops/pallas/paged_attention.py): its rows drive the page-gather DMA."""
         return self._table
+
+    def slot_row(self, slot: int) -> np.ndarray:
+        """One slot's [1, max_pages_per_seq] block-table row — the shape the
+        per-slot prefill/commit/chunk executables take (live view)."""
+        return self._table[slot : slot + 1]

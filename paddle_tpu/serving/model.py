@@ -7,13 +7,34 @@ The serving runtime is split the way TPU inference engines split it
                     [B, T_bucket]; returns the first sampled token plus the
                     per-position K/V to commit into the page pool. One
                     executable per bucket (a handful, fixed up front).
-  * `commit_prefill` — scatters the prompt K/V into the slot's pages.
+  * `prefill_chunk` — ONE fixed-size chunk [1, C] of a long prompt: attends
+                    over the slot's already-committed pages (positions <
+                    chunk start) plus causally within the chunk, so a prompt
+                    of any length is committed C tokens per engine step
+                    interleaved with decode (ISSUE 11 chunked prefill; the
+                    Orca-style continuous-batching refinement, PAPERS.md).
+                    One executable for every prompt length.
+  * `commit_prefill` — scatters prompt K/V into the slot's pages at an
+                    arbitrary `starts` offset (whole prompts and chunks
+                    share this one scatter).
   * `decode_step` — ONE token for ALL slots at the fixed [max_slots] shape:
                     write the step K/V into each slot's current page, gather
                     each slot's pages through its block-table row, masked
                     attention up to its own position. Sequence length, batch
                     occupancy and sequence age are data, not shape — the
                     whole serving lifetime runs this single executable.
+
+On TPU the decode gather+softmax runs as the Pallas ragged paged-attention
+kernel (ops/pallas/paged_attention.py) — the jnp gather path here stays the
+CPU oracle, asserted equivalent in interpret mode (tests/test_decode_fastpath).
+
+Sampling (ISSUE 11) happens ON DEVICE in every token-emitting executable:
+`_sample` draws through a per-request key `fold_in(PRNGKey(seed), step)` with
+per-slot temperature / top-k riding as DATA, so the one compiled decode
+program serves greedy (temperature 0 — bitwise the old argmax) and sampled
+requests side by side, and an engine-crash replay that reuses the request's
+seed and step index regenerates bitwise-identical tokens (PR 10's
+result-transparent restart extends to sampling).
 
 Per-slot computation is strictly batched-independent (every einsum keeps the
 slot dimension; no cross-slot reduction), which is what makes continuous
@@ -116,6 +137,47 @@ class ServableLM:
             }
         return cls(cfg), params
 
+    # -- on-device sampling -------------------------------------------------
+    def _sample(
+        self,
+        logits: Array,   # [B, V]
+        seeds: Array,    # [B] uint32 per-request seed
+        steps: Array,    # [B] int32 token index within the request (0 = first)
+        temps: Array,    # [B] f32; 0 = greedy argmax (bitwise the old path)
+        top_ks: Array,   # [B] int32; 0 = no top-k truncation
+    ) -> Array:
+        """Per-slot token sampling, batched-independent (vmap keeps the slot
+        dimension, so a slot's token never depends on its batch-mates — the
+        continuous-batching transparency contract extends to sampling). The
+        key is `fold_in(PRNGKey(seed), step)`: a crash replay that re-runs
+        (seed, step) draws the same gumbel noise, hence the same token.
+
+        The sampled branch (per-slot full-vocab sort + gumbel draw) sits
+        behind a lax.cond on `any(temps > 0)`: an all-greedy batch — the
+        default serving config — skips it entirely at runtime, so sampling
+        support costs the greedy decode hot loop nothing."""
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+
+        def _sampled(_):
+            def one(lg, seed, step, temp, k):
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+                # top-k as a threshold: keep logits >= the k-th largest
+                # (ties keep all — deterministic, no index shuffling)
+                thr = jnp.sort(lg)[::-1][jnp.clip(k, 1, lg.shape[-1]) - 1]
+                keep = (k <= 0) | (lg >= thr)
+                safe_t = jnp.where(temp > 0, temp, 1.0)
+                z = jnp.where(keep, lg / safe_t, NEG_INF).astype(jnp.float32)
+                # gumbel-max: argmax(z + g) ~ softmax(z) — one pass, no cumsum
+                u = jax.random.uniform(key, lg.shape, jnp.float32, 1e-20, 1.0)
+                return jnp.argmax(z - jnp.log(-jnp.log(u))).astype(jnp.int32)
+
+            sampled = jax.vmap(one)(logits, seeds, steps, temps, top_ks)
+            return jnp.where(temps > 0.0, sampled, greedy)
+
+        return jax.lax.cond(
+            jnp.any(temps > 0.0), _sampled, lambda _: greedy, operand=None
+        )
+
     # -- shared block body --------------------------------------------------
     def _mlp(self, params, i: int, x: Array) -> Array:
         h = _rms(x, params[f"l{i}.ln2"])
@@ -155,52 +217,142 @@ class ServableLM:
         logits = _rms(x, params["lnf"]) @ params["unembed"]
         return logits, jnp.stack(kcs), jnp.stack(vcs)
 
-    def forward_logits(self, params, tokens: Array, lengths: Array) -> Array:
+    def forward_logits(self, params, tokens: Array) -> Array:
         """Causal forward over padded [B, T] prompts -> logits [B, T, V].
         Padding positions produce garbage logits but cannot leak into valid
         ones: causal masking means position t only sees positions <= t, all
-        of which are real tokens whenever t is. (`lengths` kept for API
-        symmetry; masking is positional.)"""
-        del lengths
+        of which are real tokens whenever t is — masking is positional, so
+        no lengths argument exists (ISSUE 11 removed the dead parameter)."""
         return self._context_forward(params, tokens)[0]
 
     def prefill(
-        self, params, tokens: Array, lengths: Array
+        self, params, tokens: Array, lengths: Array,
+        seeds: Array, temps: Array, top_ks: Array,
     ) -> Tuple[Array, Array, Array]:
         """Bucket-padded prompt forward.
 
         tokens [B, T_bucket] int32, lengths [B] -> (first_tok [B] int32 —
-        greedy argmax at each prompt's last valid position, so the host never
+        sampled on device at each prompt's last valid position (step 0 of
+        the request's key; temperature 0 = greedy argmax), so the host never
         fetches a logits tensor — kc, vc [L, B, T, kv_dim] to commit)."""
         logits, kc, vc = self._context_forward(params, tokens)
         last = jnp.take_along_axis(
             logits, (lengths - 1)[:, None, None], axis=1
         )[:, 0]  # [B, V]
-        first_tok = jnp.argmax(last, -1).astype(jnp.int32)
+        first_tok = self._sample(
+            last, seeds, jnp.zeros_like(lengths), temps, top_ks
+        )
         return first_tok, kc, vc
+
+    # -- chunked prefill (ISSUE 11) -----------------------------------------
+    def prefill_chunk(
+        self,
+        params,
+        k_pages: Array,      # [L, NP, PS, KD] (donated: chunk KV commits here)
+        v_pages: Array,
+        tokens: Array,       # [1, C] int32 — chunk tokens, zero-padded
+        starts: Array,       # [1] int32 — chunk's first position
+        lengths: Array,      # [1] int32 — the FULL prompt length
+        block_rows: Array,   # [1, max_pages_per_seq] int32 — the slot's row
+        seeds: Array,        # [1] uint32   (sampling: used on the final chunk)
+        temps: Array,        # [1] f32
+        top_ks: Array,       # [1] int32
+    ) -> Tuple[Array, Array, Array]:
+        """One C-token chunk of a long prompt: attention = (already-committed
+        pages, masked to positions < start) ++ (causal within the chunk), so
+        iterating chunks reproduces the whole-prompt causal forward exactly —
+        the K/V committed per chunk equals the corresponding slice of
+        `prefill`'s, and the final chunk's last-position logits equal the
+        whole prompt's. ONE executable serves every prompt length (chunk
+        geometry is fixed [1, C]; start/length are data).
+
+        The chunk's K/V commits via `commit_prefill` INSIDE this program
+        (pages donated in/out, the decode_step convention): reading and
+        scattering the pool in one executable lets XLA update it in place,
+        where a separate commit dispatch would copy the whole pool — the
+        donated input would still be pinned by this program's in-flight read.
+
+        Returns (k_pages, v_pages, tok [1] int32 — sampled at position
+        length-1, meaningful only on the final chunk; the host fetches it
+        exactly once, there)."""
+        cfg = self.cfg
+        b, c = tokens.shape
+        h_, hd = cfg.n_heads, cfg.head_dim
+        ps = k_pages.shape[2]
+        pos = starts[:, None] + jnp.arange(c)[None, :]          # [1, C]
+        # padded tail may run past max_len; clamp the INDEX only (those
+        # positions are causally invisible to every valid one)
+        x = params["embed"][tokens] + params["pos"][
+            jnp.minimum(pos, cfg.max_len - 1)
+        ]
+        t_ctx = block_rows.shape[1] * ps
+        ctx_idx = jnp.arange(t_ctx)
+        # committed-context mask: this chunk sees pages strictly before it
+        past = ctx_idx[None, None, :] < starts[:, None, None]   # [1, 1, T_ctx]
+        causal = jnp.tril(jnp.ones((c, c), bool))
+        kcs, vcs = [], []
+        for i in range(cfg.n_layers):
+            h = _rms(x, params[f"l{i}.ln1"])
+            q = (h @ params[f"l{i}.wq"]).reshape(b, c, h_, hd)
+            kf = h @ params[f"l{i}.wk"]
+            vf = h @ params[f"l{i}.wv"]
+            kcs.append(kf)
+            vcs.append(vf)
+            k_self = kf.reshape(b, c, h_, hd)
+            v_self = vf.reshape(b, c, h_, hd)
+            k_past = k_pages[i][block_rows].reshape(b, t_ctx, h_, hd)
+            v_past = v_pages[i][block_rows].reshape(b, t_ctx, h_, hd)
+            sp = jnp.einsum("bqhd,bkhd->bhqk", q, k_past) * self.scale
+            sp = jnp.where(past[:, None], sp, NEG_INF)
+            ss = jnp.einsum("bqhd,bkhd->bhqk", q, k_self) * self.scale
+            ss = jnp.where(causal[None, None], ss, NEG_INF)
+            s_all = jnp.concatenate([sp, ss], -1)               # [1,H,C,T+C]
+            w = jax.nn.softmax(s_all.astype(jnp.float32), -1).astype(x.dtype)
+            ctx = (
+                jnp.einsum("bhqk,bkhd->bqhd", w[..., :t_ctx], v_past)
+                + jnp.einsum("bhqk,bkhd->bqhd", w[..., t_ctx:], v_self)
+            ).reshape(b, c, -1)
+            x = x + ctx @ params[f"l{i}.wo"]
+            x = self._mlp(params, i, x)
+        logits = _rms(x, params["lnf"]) @ params["unembed"]
+        # last valid position falls in this chunk only on the final chunk;
+        # clamp keeps the index in range for the earlier ones (tok unused)
+        last_in_chunk = jnp.clip(lengths - 1 - starts, 0, c - 1)
+        last = jnp.take_along_axis(
+            logits, last_in_chunk[:, None, None], axis=1
+        )[:, 0]
+        tok = self._sample(
+            last, seeds, jnp.zeros_like(lengths), temps, top_ks
+        )
+        k_pages, v_pages = self.commit_prefill(
+            k_pages, v_pages, jnp.stack(kcs), jnp.stack(vcs),
+            lengths, block_rows, starts,
+        )
+        return k_pages, v_pages, tok
 
     # -- page pool plumbing -------------------------------------------------
     def commit_prefill(
         self,
         k_pages: Array,  # [L, NP, PS, KD] (donated)
         v_pages: Array,
-        kc: Array,  # [L, B, T, KD] from prefill
+        kc: Array,  # [L, B, T, KD] from prefill / prefill_chunk
         vc: Array,
-        lengths: Array,  # [B]
+        lengths: Array,  # [B] — the FULL prompt length
         block_rows: Array,  # [B, max_pages_per_seq] int32
+        starts: Array,  # [B] — position of kc[..., 0, :] (0 = whole prompt)
     ) -> Tuple[Array, Array]:
-        """Scatter prompt K/V into the slots' pages. Positions past a
-        prompt's length land in dump page 0 (never read unmasked)."""
+        """Scatter prompt K/V into the slots' pages at offset `starts`
+        (whole-prompt prefill passes zeros; chunked prefill commits each
+        chunk at its own offset). Positions past a prompt's length land in
+        dump page 0 (never read unmasked)."""
         ps = k_pages.shape[2]
         l, b, t, kd = kc.shape
-        pos = jnp.arange(t)
-        valid = pos[None, :] < lengths[:, None]  # [B, T]
-        logical = pos // ps  # [T]
-        page = jnp.take_along_axis(
-            block_rows, jnp.broadcast_to(logical[None, :], (b, t)), axis=1
-        )
+        pos = starts[:, None] + jnp.arange(t)[None, :]  # [B, T] absolute
+        valid = pos < lengths[:, None]  # [B, T]
+        logical = jnp.minimum(pos // ps, block_rows.shape[1] - 1)
+        page = jnp.take_along_axis(block_rows, logical, axis=1)
         page = jnp.where(valid, page, 0).reshape(-1)  # [B*T]
-        offs = jnp.broadcast_to((pos % ps)[None, :], (b, t)).reshape(-1)
+        offs = (pos % ps).reshape(-1)
         kf = kc.reshape(l, b * t, kd)
         vf = vc.reshape(l, b * t, kd)
         return (
@@ -209,6 +361,47 @@ class ServableLM:
         )
 
     # -- the ONE decode executable ------------------------------------------
+    def _paged_attention(
+        self,
+        q: Array,            # [S, KD] — this layer's queries
+        k_pages_i: Array,    # [NP, PS, KD] — this layer's page pools
+        v_pages_i: Array,
+        block_table: Array,  # [S, P]
+        positions: Array,    # [S]
+    ) -> Array:
+        """Ragged paged attention for one layer's decode step: [S, KD] ctx.
+
+        Two numerically-equivalent paths behind one seam: the Pallas kernel
+        (ops/pallas/paged_attention.py — block table drives the page gathers
+        in the DMA engine, online f32 softmax in VMEM) when `pallas.enabled()`
+        (TPU, or PADDLE_TPU_PALLAS=1/interpret), else the dense jnp gather —
+        which is also the kernel's CPU ORACLE: interpret-mode equality across
+        mixed lengths/block tables is pinned in tests/test_decode_fastpath."""
+        from paddle_tpu.ops import pallas as _pallas
+
+        s = q.shape[0]
+        h_, hd = self.cfg.n_heads, self.cfg.head_dim
+        if _pallas.enabled():
+            from paddle_tpu.ops.pallas.paged_attention import (
+                paged_attention_decode,
+            )
+
+            return paged_attention_decode(
+                q, k_pages_i, v_pages_i, block_table, positions,
+                scale=self.scale, n_heads=h_,
+            ).astype(q.dtype)
+        ps = k_pages_i.shape[1]
+        qh = q.reshape(s, h_, hd)
+        # dense gather: [S, P, PS, KD] -> [S, T_ctx, H, hd]
+        k_seq = k_pages_i[block_table].reshape(s, -1, h_, hd)
+        v_seq = v_pages_i[block_table].reshape(s, -1, h_, hd)
+        ctx_idx = jnp.arange(block_table.shape[1] * ps)
+        att_mask = ctx_idx[None, :] <= positions[:, None]  # [S, T_ctx]
+        sc = jnp.einsum("shd,sthd->sht", qh, k_seq) * self.scale
+        sc = jnp.where(att_mask[:, None, :], sc, NEG_INF)
+        w = jax.nn.softmax(sc.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("sht,sthd->shd", w, v_seq).reshape(s, -1)
+
     def decode_step(
         self,
         params,
@@ -218,18 +411,22 @@ class ServableLM:
         positions: Array,  # [S] int32: that token's position
         active: Array,  # [S] bool
         block_table: Array,  # [S, max_pages_per_seq] int32
+        seeds: Array,  # [S] uint32 per-request sampling seed
+        steps: Array,  # [S] int32 token index within the request
+        temps: Array,  # [S] f32 temperature (0 = greedy)
+        top_ks: Array,  # [S] int32 top-k truncation (0 = off)
     ) -> Tuple[Array, Array, Array]:
         """One decode step for all slots at the fixed [max_slots] shape.
 
         Writes each active slot's step K/V into its current page (inactive
         slots dump into page 0), then attends over the slot's own gathered
-        pages masked to positions <= its own. Returns (k_pages, v_pages,
-        next_tok [S] int32 — greedy). Every op keeps the slot dimension
-        batched (no cross-slot reduction), so a slot's result is bitwise
-        independent of the rest of the batch."""
+        pages masked to positions <= its own (the _paged_attention seam:
+        Pallas ragged kernel on TPU, jnp gather oracle elsewhere) and samples
+        on device through each request's own key. Returns (k_pages, v_pages,
+        next_tok [S] int32). Every op keeps the slot dimension batched (no
+        cross-slot reduction), so a slot's result is bitwise independent of
+        the rest of the batch."""
         cfg = self.cfg
-        s = tokens.shape[0]
-        h_, hd = cfg.n_heads, cfg.head_dim
         ps = k_pages.shape[2]
         x = params["embed"][tokens] + params["pos"][positions]
         cur_page = jnp.take_along_axis(
@@ -237,24 +434,18 @@ class ServableLM:
         )[:, 0]
         cur_page = jnp.where(active, cur_page, 0)
         offs = positions % ps
-        ctx_idx = jnp.arange(block_table.shape[1] * ps)
-        att_mask = ctx_idx[None, :] <= positions[:, None]  # [S, T_ctx]
         for i in range(cfg.n_layers):
             h = _rms(x, params[f"l{i}.ln1"])
-            q = (h @ params[f"l{i}.wq"]).reshape(s, h_, hd)
+            q = h @ params[f"l{i}.wq"]  # [S, KD]
             k_new = h @ params[f"l{i}.wk"]  # [S, KD]
             v_new = h @ params[f"l{i}.wv"]
             k_pages = k_pages.at[i, cur_page, offs].set(k_new)
             v_pages = v_pages.at[i, cur_page, offs].set(v_new)
-            # gather this slot's pages: [S, P, PS, KD] -> [S, T_ctx, H, hd]
-            k_seq = k_pages[i][block_table].reshape(s, -1, h_, hd)
-            v_seq = v_pages[i][block_table].reshape(s, -1, h_, hd)
-            sc = jnp.einsum("shd,sthd->sht", q, k_seq) * self.scale
-            sc = jnp.where(att_mask[:, None, :], sc, NEG_INF)
-            w = jax.nn.softmax(sc.astype(jnp.float32), -1).astype(x.dtype)
-            ctx = jnp.einsum("sht,sthd->shd", w, v_seq).reshape(s, -1)
+            ctx = self._paged_attention(
+                q, k_pages[i], v_pages[i], block_table, positions
+            )
             x = x + ctx @ params[f"l{i}.wo"]
             x = self._mlp(params, i, x)
         logits = _rms(x, params["lnf"]) @ params["unembed"]
-        next_tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        next_tok = self._sample(logits, seeds, steps, temps, top_ks)
         return k_pages, v_pages, next_tok

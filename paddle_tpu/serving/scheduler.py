@@ -75,11 +75,22 @@ class RequestHandle:
     def __init__(self, request_id: int, tenant: str, prompt_len: int,
                  max_new_tokens: int,
                  deadline_s: Optional[float] = None,
-                 ttft_deadline_s: Optional[float] = None):
+                 ttft_deadline_s: Optional[float] = None,
+                 seed: Optional[int] = None,
+                 temperature: float = 0.0,
+                 top_k: int = 0):
         self.request_id = request_id
         self.tenant = tenant
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
+        # sampling identity (ISSUE 11): the per-request seed is part of the
+        # REQUEST, not the engine — a crash-replayed request reuses it (with
+        # the token's step index) so restart recovery regenerates bitwise-
+        # identical tokens even at temperature > 0. Default: the request id,
+        # stable across replay and across same-order submission streams.
+        self.seed = int(request_id if seed is None else seed) & 0xFFFFFFFF
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
         self.status = self.QUEUED
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
@@ -152,10 +163,17 @@ class _Waiting:
 
 class ActiveSeq:
     """One occupied decode slot: the sequence's last token + position ride
-    into every decode step; everything else is retained host-side."""
+    into every decode step; everything else is retained host-side.
+
+    Chunked prefill (ISSUE 11): `prefill_pos` counts the prompt tokens whose
+    K/V is committed so far. The session's chunked path admits long prompts
+    with prefill_pos=0 and advances one chunk per engine step; a slot is
+    `prefilling` until the whole prompt is committed and joins decode steps
+    only after — so a long prompt never steals a decode step from the
+    already-decoding slots."""
 
     __slots__ = ("handle", "prompt", "last_token", "next_pos", "generated",
-                 "t_started")
+                 "t_started", "prefill_pos")
 
     def __init__(self, handle: RequestHandle, prompt: List[int]):
         self.handle = handle
@@ -164,6 +182,11 @@ class ActiveSeq:
         self.next_pos: int = len(prompt)  # position the last token occupies
         self.generated: int = 0
         self.t_started: Optional[float] = None  # set at admission
+        self.prefill_pos: int = len(prompt)  # chunked path resets to 0
+
+    @property
+    def prefilling(self) -> bool:
+        return self.prefill_pos < len(self.prompt)
 
     def append(self, token: int) -> None:
         self.handle.tokens.append(int(token))
@@ -195,10 +218,19 @@ class Scheduler:
         cache: PagedKVCache,
         max_queue: int = 256,
         quotas: Optional[TenantQuotas] = None,
+        prefill_chunk: Optional[int] = None,
+        largest_bucket: Optional[int] = None,
     ):
         self.cache = cache
         self.max_queue = max_queue
         self.quotas = quotas
+        # chunked-prefill geometry (None = whole-prompt prefill): the load
+        # estimator charges each chunk one engine step, so a flood of long
+        # prompts raises the wait estimate the way it raises real TTFT;
+        # largest_bucket mirrors the session's routing (a prompt beyond
+        # every bucket chunks even when it fits one chunk)
+        self.prefill_chunk = prefill_chunk
+        self.largest_bucket = largest_bucket
         self.lock = threading.Lock()
         self.waiting: Deque[_Waiting] = collections.deque()
         self.slots: List[Optional[ActiveSeq]] = [None] * cache.max_slots
@@ -206,8 +238,11 @@ class Scheduler:
         # cancellations requested for RUNNING sequences; honored at the next
         # decode-step boundary (reap) so they never interrupt a step
         self._cancel_req: Dict[int, str] = {}
-        # EWMA of admission→done wall time, the basis of estimate_wait_s
+        # EWMA of admission→done wall time, the basis of estimate_wait_s,
+        # plus an EWMA of per-ENGINE-STEP time (service / steps observed at
+        # retirement) that prices prefill chunks into the estimates
         self._ewma_service_s: Optional[float] = None
+        self._ewma_step_s: Optional[float] = None
         # counters surfaced through session.stats()
         self.completed = 0
         self.rejected = 0
@@ -225,6 +260,9 @@ class Scheduler:
         trace_ctx: Optional[dict] = None,
         deadline_s: Optional[float] = None,
         ttft_deadline_s: Optional[float] = None,
+        seed: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
     ) -> RequestHandle:
         """Admission control happens HERE, synchronously: the caller learns
         'no' at the front door, not by timing out in a silent queue. Three
@@ -244,7 +282,7 @@ class Scheduler:
                 obs_metrics.observe_shed("queue")
                 raise QuotaExceeded(
                     f"request queue full ({self.max_queue})", "queue",
-                    retry_after_ms=self._retry_hint_ms(total),
+                    retry_after_ms=self._retry_hint_ms(total, len(prompt)),
                 )
             if deadline_s is not None:
                 if deadline_s <= 0:
@@ -254,9 +292,9 @@ class Scheduler:
                     raise QuotaExceeded(
                         f"deadline of {deadline_s}s already expired at "
                         f"admission", "deadline",
-                        retry_after_ms=self._retry_hint_ms(total),
+                        retry_after_ms=self._retry_hint_ms(total, len(prompt)),
                     )
-                est = self._estimate_wait_s(total)
+                est = self._estimate_wait_s(total, len(prompt))
                 if est > deadline_s:
                     self.rejected += 1
                     self.shed += 1
@@ -265,7 +303,7 @@ class Scheduler:
                         f"overloaded: estimated completion {est:.2f}s exceeds "
                         f"the request's {deadline_s:.2f}s deadline budget",
                         "overload",
-                        retry_after_ms=self._retry_hint_ms(total),
+                        retry_after_ms=self._retry_hint_ms(total, len(prompt)),
                     )
             # the TTFT budget is compared against the QUEUE-WAIT estimate,
             # never the completion estimate: a TTFT deadline shorter than one
@@ -273,7 +311,7 @@ class Scheduler:
             # queue wait + prefill, and the contract is "counted, not fatal"
             # — an already-expired TTFT budget just counts a miss later)
             if ttft_deadline_s is not None and ttft_deadline_s > 0:
-                est_ttft = self._estimate_ttft_wait_s(total)
+                est_ttft = self._estimate_ttft_wait_s(total, len(prompt))
                 if est_ttft > ttft_deadline_s:
                     self.rejected += 1
                     self.shed += 1
@@ -282,7 +320,7 @@ class Scheduler:
                         f"overloaded: estimated queue wait {est_ttft:.2f}s "
                         f"exceeds the request's {ttft_deadline_s:.2f}s TTFT "
                         f"budget", "overload",
-                        retry_after_ms=self._retry_hint_ms(total),
+                        retry_after_ms=self._retry_hint_ms(total, len(prompt)),
                     )
             if self.quotas is not None:
                 try:
@@ -293,6 +331,7 @@ class Scheduler:
             handle = RequestHandle(
                 next(self._ids), tenant, len(prompt), max_new_tokens,
                 deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+                seed=seed, temperature=temperature, top_k=top_k,
             )
             handle.trace_ctx = trace_ctx
             handle._scheduler = self
@@ -300,56 +339,93 @@ class Scheduler:
             return handle
 
     # -- load estimate ------------------------------------------------------
-    def _estimate_wait_s(self, total_len: int) -> float:
+    def _chunk_steps(self, prompt_len: int) -> int:
+        """Chunk-budget engine steps a prompt's prefill costs: ceil(len/C)
+        when it routes to the chunked path (longer than one chunk, or longer
+        than every bucket — ServingSession._chunked_prompt's rule), else 0
+        (whole-prompt prefill rides its admission boundary). The SAME count
+        prices a queued prompt and, via remaining-token ceil, one already
+        mid-prefill — so the estimate never jumps across admission."""
+        c = self.prefill_chunk
+        if c is None:
+            return 0
+        routed_chunked = prompt_len > c or (
+            self.largest_bucket is not None and prompt_len > self.largest_bucket
+        )
+        if not routed_chunked:
+            return 0
+        return -(-int(prompt_len) // c)
+
+    def _estimate_wait_s(self, total_len: int, prompt_len: int = 0) -> float:
         """Expected time for a request of `total_len` tokens to COMPLETE
         (queue wait + its own service), under self.lock — what a deadline
         budget must cover. The queue drains in waves of up to max_slots
         requests, each taking ~one EWMA service time; the request's own
         decode is one more wave, and free-page pressure (pool cannot host it
-        right now) adds another. Optimistic (0) until the first retirement
-        seeds the EWMA — cold starts admit."""
+        right now) adds another. Chunked prefill is priced per chunk: every
+        extra chunk — the queue's and this request's own — occupies one
+        whole engine step (per-step EWMA observed at retirement), which is
+        exactly how long prompts actually delay everyone's wall clock.
+        Optimistic (0) until the first retirement seeds the EWMA — cold
+        starts admit."""
         svc = self._ewma_service_s
         if svc is None:
             return 0.0
         free_slot = any(a is None for a in self.slots)
         fits_now = free_slot and self.cache.can_reserve(total_len)
         depth = len(self.waiting)
+        step_s = self._ewma_step_s or 0.0
+        c = self.prefill_chunk
+        # chunks still to commit for prompts ALREADY mid-prefill in slots:
+        # each one is a whole engine step everybody waits behind, same as
+        # the queued and own chunks below
+        in_flight_chunks = 0 if c is None else sum(
+            -(-(len(a.prompt) - a.prefill_pos) // c)
+            for a in self.slots if a is not None and a.prefilling
+        )
+        chunk_cost = step_s * (
+            self._chunk_steps(prompt_len)
+            + sum(self._chunk_steps(w.handle.prompt_len) for w in self.waiting)
+            + in_flight_chunks
+        )
         if depth == 0 and fits_now:
-            return svc  # empty queue: just its own decode time
+            return svc + chunk_cost  # empty queue: its own decode + chunks
         waves = depth / max(1, self.cache.max_slots) + 1.0
         if not fits_now:
             waves += 1.0
-        return waves * svc
+        return waves * svc + chunk_cost
 
-    def _estimate_ttft_wait_s(self, total_len: int) -> float:
+    def _estimate_ttft_wait_s(self, total_len: int, prompt_len: int = 0) -> float:
         """Expected wait until the FIRST token (under self.lock): the
-        completion estimate minus the request's own decode wave — i.e. the
-        queue-drain time ahead of it (prefill is a small constant on top).
-        0 on an idle server with room."""
+        completion estimate minus the request's own decode wave — the
+        queue-drain time ahead of it plus its OWN prefill chunks (a chunked
+        long prompt's first token only lands after its last chunk). 0 on an
+        idle server with room."""
         svc = self._ewma_service_s
         if svc is None:
             return 0.0
-        return max(0.0, self._estimate_wait_s(total_len) - svc)
+        return max(0.0, self._estimate_wait_s(total_len, prompt_len) - svc)
 
-    def _retry_hint_ms(self, total_len: int) -> int:
+    def _retry_hint_ms(self, total_len: int, prompt_len: int = 0) -> int:
         # under self.lock; the hint is "when could this plausibly fit":
         # the estimated wait, floored at one service time (or 10ms cold)
-        est = self._estimate_wait_s(total_len)
+        est = self._estimate_wait_s(total_len, prompt_len)
         floor = self._ewma_service_s or 0.01
         return max(1, int(1000 * max(est, floor)))
 
-    def estimate_wait_s(self, total_len: int = 0) -> float:
+    def estimate_wait_s(self, total_len: int = 0, prompt_len: int = 0) -> float:
         with self.lock:
-            return self._estimate_wait_s(total_len)
+            return self._estimate_wait_s(total_len, prompt_len)
 
     def reset_load_estimate(self) -> None:
-        """Forget the observed service-time EWMA. Benches and warmup paths
+        """Forget the observed service-time EWMAs. Benches and warmup paths
         need this: a compile-heavy first round observes second-scale
         'service times' that would make the load-aware admission check shed
         everything against a millisecond-scale deadline budget until enough
         steady-state retirements wash the EWMA out."""
         with self.lock:
             self._ewma_service_s = None
+            self._ewma_step_s = None
 
     # -- cancellation + deadline reaping ------------------------------------
     def _finalize(self, handle: RequestHandle, reason: str,
@@ -507,19 +583,29 @@ class Scheduler:
         act.handle._complete(RequestHandle.DONE, reason)
         REQUEST_HISTOGRAM.observe(act.handle.t_done - act.handle.t_submit)
         svc = act.handle.t_done - (act.t_started or act.handle.t_submit)
+        # engine steps this request actually occupied: its decode steps plus
+        # its extra prefill chunks — prices one chunk for the load estimate
+        steps = max(1, act.generated + self._chunk_steps(act.handle.prompt_len))
         with self.lock:
             a = self.SERVICE_EWMA_ALPHA
             self._ewma_service_s = (
                 svc if self._ewma_service_s is None
                 else (1 - a) * self._ewma_service_s + a * svc
             )
+            per_step = svc / steps
+            self._ewma_step_s = (
+                per_step if self._ewma_step_s is None
+                else (1 - a) * self._ewma_step_s + a * per_step
+            )
 
     # -- engine crash recovery ----------------------------------------------
     def requeue_active(self, now: Optional[float] = None) -> Tuple[int, int]:
         """Engine recovery (ISSUE 10): push every RUNNING sequence back to
         the FRONT of the queue in original submit order with its progress
-        reset — greedy decode is deterministic, so the replay regenerates
-        the same tokens and the restart is result-transparent. Requests
+        reset — decode is deterministic (greedy trivially; sampled requests
+        replay through the SAME per-request seed and token step indices,
+        ISSUE 11), so the replay regenerates bitwise-identical tokens and
+        the restart is result-transparent. Requests
         already past their total deadline fail now with the named reason
         instead of wasting the fresh engine's steps. Slots are emptied but
         the page free-list is NOT touched: the caller re-initializes the

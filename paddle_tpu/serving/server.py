@@ -206,6 +206,9 @@ class ServingServer:
                     tenant=tenant,
                     deadline_s=req.get("deadline_s"),
                     ttft_deadline_s=req.get("ttft_deadline_s"),
+                    temperature=req.get("temperature"),
+                    top_k=req.get("top_k"),
+                    seed=req.get("seed"),
                 )
                 with self._handles_lock:
                     self._handles[handle.request_id] = handle
@@ -482,11 +485,19 @@ class ServingClient:
         deadline_s: Optional[float] = None,
         ttft_deadline_s: Optional[float] = None,
         hedge_ttft_s: Optional[float] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        seed: Optional[int] = None,
     ) -> dict:
         import time as _time
 
         key = uuid.uuid4().hex
+        # sampling identity rides the idempotency envelope: a hedged retry
+        # re-submits the SAME (seed, temperature, top_k), so even when the
+        # original was lost and the hedge IS the request, tokens match what
+        # the original would have produced (seeded per-request sampling)
         kw = dict(deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+                  temperature=temperature, top_k=top_k, seed=seed,
                   client_req_id=key)
         rid = self.submit(prompt, max_new_tokens, **kw)
         t0 = _time.monotonic()
@@ -537,10 +548,14 @@ class ServingClient:
         deadline_s: Optional[float] = None,
         ttft_deadline_s: Optional[float] = None,
         client_req_id: Optional[str] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        seed: Optional[int] = None,
     ) -> int:
         resp = self._client.call(
             "submit", prompt=list(prompt), max_new_tokens=max_new_tokens,
             deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+            temperature=temperature, top_k=top_k, seed=seed,
             client_req_id=client_req_id or uuid.uuid4().hex, **self._id_kw(),
         )
         if "err" in resp:

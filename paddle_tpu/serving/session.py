@@ -8,9 +8,22 @@ executable ONCE, and then serves any number of requests of any mixed lengths
 against them:
 
   * decode  — the single fixed-[max_slots] continuous-batching step
-              (pages donated in/out; the only executable in the hot loop)
+              (pages donated in/out; the only executable in the hot loop;
+              on TPU its attention runs the Pallas ragged paged-attention
+              kernel, the jnp gather path staying the CPU oracle)
   * prefill — one per length bucket (a handful: `prefill_buckets`)
-  * commit  — one per bucket (scatter prompt KV into pages)
+  * commit  — one per bucket + one chunk shape (scatter prompt KV into pages)
+  * chunk   — ONE [1, prefill_chunk] program serving every long prompt:
+              chunked prefill (ISSUE 11) commits a long prompt C tokens per
+              engine step interleaved with decode, so a long prompt joining
+              mid-stream never stalls the running streams' inter-token
+              latency the way a whole-prompt prefill does
+
+Sampling (ISSUE 11) is on-device and rides the SAME decode executable:
+per-request (seed, temperature, top_k) are [max_slots] data lanes, the key
+is fold_in(PRNGKey(seed), token_index), so greedy and sampled requests mix
+freely with zero recompiles and the PR 10 crash replay stays bitwise even
+at temperature > 0.
 
 Shape discipline is *asserted*, not hoped for: every decode step's input
 signature is recorded into a serving-local stats.RecompileStats (the PR-1
@@ -92,6 +105,9 @@ class ServingSession:
         default_ttft_deadline_s: Optional[float] = None,
         engine_restart_max: int = 3,
         engine_stall_timeout_s: float = 10.0,
+        prefill_chunk: Optional[int] = None,
+        default_temperature: float = 0.0,
+        default_top_k: int = 0,
     ):
         import jax
 
@@ -106,6 +122,16 @@ class ServingSession:
                 f"largest bucket + max_new_limit = {max_ctx} exceeds the "
                 f"model's max_len {self.cfg.max_len}"
             )
+        # chunked prefill (ISSUE 11) lifts the bucket cap on prompt length:
+        # any prompt up to max_len - 1 is admissible (committed one C-token
+        # chunk per engine step), so the page pool must cover max_len, not
+        # just the largest bucket
+        self.prefill_chunk = None if not prefill_chunk else int(prefill_chunk)
+        if self.prefill_chunk is not None:
+            max_ctx = self.cfg.max_len
+        # session-wide sampling defaults; per-request values win (ISSUE 11)
+        self.default_temperature = float(default_temperature)
+        self.default_top_k = int(default_top_k)
         pages_per_seq = -(-max_ctx // page_size)
         if num_pages is None:
             # worst case every slot at full context, plus the dump page
@@ -118,18 +144,25 @@ class ServingSession:
             max_slots=max_slots,
             max_pages_per_seq=pages_per_seq,
         )
-        self.scheduler = Scheduler(self.cache, max_queue=max_queue, quotas=quotas)
+        self.scheduler = Scheduler(
+            self.cache, max_queue=max_queue, quotas=quotas,
+            prefill_chunk=self.prefill_chunk, largest_bucket=self.buckets[-1],
+        )
         self.k_pages, self.v_pages = self.cache.make_pools()
 
-        # the three executables; jit's shape cache turns the bucket list into
-        # "a few padded lengths" -> a few compiles, and decode into exactly one
+        # the executables; jit's shape cache turns the bucket list into
+        # "a few padded lengths" -> a few compiles, decode into exactly one,
+        # and the chunk program ([1, C] fixed shape) into exactly one more
         self._decode = jax.jit(model.decode_step, donate_argnums=(1, 2))
         self._prefill = jax.jit(model.prefill)
         self._commit = jax.jit(model.commit_prefill, donate_argnums=(0, 1))
+        self._prefill_chunk = jax.jit(model.prefill_chunk, donate_argnums=(1, 2))
 
         self.recompiles = stats.RecompileStats(warn_threshold=2)
         self.decode_steps = 0
         self.tokens_generated = 0
+        self.prefill_chunks_committed = 0
+        self._chunk_rr_slot = -1  # round-robin cursor over prefilling slots
         # session-level request deadline defaults; per-tenant quota defaults
         # (quota.py deadlines_for) take precedence, explicit per-request
         # values beat both
@@ -166,12 +199,18 @@ class ServingSession:
         tenant: str = "default",
         deadline_s: Optional[float] = None,
         ttft_deadline_s: Optional[float] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        seed: Optional[int] = None,
     ) -> RequestHandle:
         """Queue one generation request; raises QuotaExceeded at the front
         door when admission control says no (including a load-aware shed
         when the estimated queue wait exceeds the request's deadline
         budget). Deadlines resolve explicit arg → tenant quota default →
-        session default; None all the way down means none. Thread-safe."""
+        session default; None all the way down means none. Sampling knobs
+        resolve explicit arg → session default (temperature 0 = greedy,
+        top_k 0 = off); `seed` defaults to a request-stable derivation so
+        crash replay is bitwise (ISSUE 11). Thread-safe."""
         if self.engine_error is not None:
             raise RuntimeError(
                 "serving engine died; no new requests accepted"
@@ -185,7 +224,19 @@ class ServingSession:
         )
         if max_new <= 0:
             raise ValueError("max_new_tokens must be positive")
-        _bucket_for(self.buckets, len(prompt))  # validates prompt length
+        # the silent-overflow guard (ISSUE 11 satellite): a position past
+        # max_len would index params["pos"] out of range inside jit, which
+        # XLA CLAMPS silently — wrong tokens, no error. Reject here, named.
+        if len(prompt) + max_new > self.cfg.max_len:
+            raise ValueError(
+                f"max_len exceeded: prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new}) = {len(prompt) + max_new} tokens > the model's "
+                f"max_len {self.cfg.max_len}; clamped position embeddings "
+                f"would silently corrupt the output"
+            )
+        if not self._chunked_prompt(prompt):
+            # whole-prompt (bucketed) prefill path: prompt must fit a bucket
+            _bucket_for(self.buckets, len(prompt))
         need = self.cache.pages_needed(len(prompt) + max_new)
         if need > min(self.cache.max_pages_per_seq, self.cache.num_pages - 1):
             # an undersized pool must reject at the front door, not leave the
@@ -212,6 +263,12 @@ class ServingSession:
         handle = self.scheduler.submit(
             prompt, max_new, tenant, trace_ctx=trace.wire_context(),
             deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+            seed=seed,
+            temperature=(
+                self.default_temperature if temperature is None
+                else float(temperature)
+            ),
+            top_k=self.default_top_k if top_k is None else int(top_k),
         )
         SERVING_EVENTS.incr("serving_submitted")
         with self._work:
@@ -219,8 +276,55 @@ class ServingSession:
         return handle
 
     # -- engine steps -------------------------------------------------------
+    def _chunked_prompt(self, prompt) -> bool:
+        """True when this prompt prefills chunk-by-chunk: longer than the
+        per-step chunk budget, OR longer than every bucket (with chunking
+        on, NO prompt up to max_len is unservable — a prompt in the gap
+        between the largest bucket and a larger chunk size must not be
+        rejected where a longer one would be admitted)."""
+        return self.prefill_chunk is not None and (
+            len(prompt) > self.prefill_chunk or len(prompt) > self.buckets[-1]
+        )
+
+    def _sampling_row(self, h) -> tuple:
+        """(seeds, temps, top_ks) [1]-shaped device-data for one request's
+        prefill — its sampled first token draws through
+        fold_in(PRNGKey(seed), 0)."""
+        return (
+            np.array([h.seed], np.uint32),
+            np.array([h.temperature], np.float32),
+            np.array([h.top_k], np.int32),
+        )
+
+    def _observe_ttft(self, h, ctx) -> None:
+        """Time-to-first-token bookkeeping, shared by the whole-prompt and
+        chunked prefill paths. Latched once per REQUEST: a crash-replayed
+        admission must not observe a second sample (or double-count a miss)
+        for the same id."""
+        if not h.ttft_observed:
+            h.ttft_observed = True
+            ttft_s = (h.t_first_token or h.t_submit) - h.t_submit
+            TTFT_HISTOGRAM.observe(ttft_s)
+            if (h.t_ttft_deadline is not None
+                    and h.t_first_token is not None
+                    and h.t_first_token > h.t_ttft_deadline):
+                # TTFT deadline missed: counted (the client-hedging
+                # signal) but NOT fatal — the request has its first token
+                # now and only the total deadline cancels work
+                obs_metrics.observe_deadline_miss("ttft")
+                SERVING_EVENTS.incr("serving_ttft_deadline_missed")
+        trace.span_from_monotonic(
+            "serving.ttft", h.t_submit,
+            trace_id=ctx and ctx.get("t"), parent_id=ctx and ctx.get("s"),
+            attrs={"request_id": h.request_id},
+        )
+
     def _admit(self, now: Optional[float] = None) -> None:
-        """Run prefill for every request joining at this step boundary."""
+        """Run prefill for every request joining at this step boundary.
+        Prompts longer than `prefill_chunk` (when set) only MARK the slot
+        as prefilling here — their K/V commits one chunk per engine step in
+        _prefill_chunks, interleaved with decode, so a long prompt joining
+        never stalls the already-decoding slots for a whole-prompt forward."""
         import jax.numpy as jnp
 
         if _faults.get().active and self.scheduler.queue_depth():
@@ -240,7 +344,13 @@ class ServingSession:
                 trace_id=ctx and ctx.get("t"), parent_id=ctx and ctx.get("s"),
                 attrs={"request_id": h.request_id},
             )
+            if self._chunked_prompt(act.prompt):
+                # chunked path: nothing committed yet; _prefill_chunks
+                # advances this slot one chunk per engine step from here on
+                act.prefill_pos = 0
+                continue
             bucket = _bucket_for(self.buckets, len(act.prompt))
+            seeds, temps, top_ks = self._sampling_row(h)
             with trace.activate(ctx):
                 with trace.span(
                     "serving.prefill", request_id=h.request_id, bucket=bucket
@@ -248,45 +358,98 @@ class ServingSession:
                     toks = np.zeros((1, bucket), np.int32)
                     toks[0, : len(act.prompt)] = act.prompt
                     lengths = np.array([len(act.prompt)], np.int32)
-                    first_tok, kc, vc = self._prefill(self.params, toks, lengths)
-                    rows = self.cache.block_table()[slot : slot + 1]
+                    first_tok, kc, vc = self._prefill(
+                        self.params, toks, lengths, seeds, temps, top_ks
+                    )
+                    rows = self.cache.slot_row(slot)
                     self.k_pages, self.v_pages = self._commit(
                         self.k_pages, self.v_pages, kc, vc,
                         jnp.asarray(lengths), jnp.asarray(rows),
+                        jnp.zeros((1,), jnp.int32),
                     )
                     # one tiny host fetch per ADMISSION (not per decode step):
-                    # the prompt's first sampled token — argmax on device
+                    # the prompt's first token — sampled on device
                     act.append(int(first_tok[0]))
             # time-to-first-token: prefill emits the first sampled token, so
-            # TTFT completes here — span under the request trace + histogram.
-            # Latched once per REQUEST: a crash-replayed admission must not
-            # observe a second sample (or double-count a miss) for the same id
-            if not h.ttft_observed:
-                h.ttft_observed = True
-                ttft_s = (h.t_first_token or h.t_submit) - h.t_submit
-                TTFT_HISTOGRAM.observe(ttft_s)
-                if (h.t_ttft_deadline is not None
-                        and h.t_first_token is not None
-                        and h.t_first_token > h.t_ttft_deadline):
-                    # TTFT deadline missed: counted (the client-hedging
-                    # signal) but NOT fatal — the request has its first token
-                    # now and only the total deadline cancels work
-                    obs_metrics.observe_deadline_miss("ttft")
-                    SERVING_EVENTS.incr("serving_ttft_deadline_missed")
-            trace.span_from_monotonic(
-                "serving.ttft", h.t_submit,
-                trace_id=ctx and ctx.get("t"), parent_id=ctx and ctx.get("s"),
-                attrs={"request_id": h.request_id},
-            )
+            # TTFT completes here — span under the request trace + histogram
+            self._observe_ttft(h, ctx)
             SERVING_EVENTS.incr("serving_prefills")
             reason = act.finished(self.cfg.eos_id)
             if reason is not None:
                 self.scheduler.retire(slot, reason)
 
+    def _prefill_chunks(self) -> None:
+        """Advance ONE prefilling slot by exactly one [1, C] chunk — the
+        chunked-prefill half of the engine step (ISSUE 11). The chunk size
+        IS the per-step prefill budget: each engine step spends at most C
+        prompt tokens on prefill no matter how many long prompts are in
+        flight (round-robin across prefilling slots keeps them all moving),
+        and _decode_once still runs for every fully-prefilled slot in the
+        same engine step — so no decode step is ever skipped for a prefill
+        and the decode streams' inter-token latency is bounded by decode +
+        ONE chunk, not by a whole-prompt forward. The final chunk emits the
+        request's first sampled token (one host fetch per REQUEST, there)."""
+        prefilling = [
+            (slot, act) for slot, act in self.scheduler.active_slots()
+            if act.prefilling
+        ]
+        if not prefilling:
+            return
+        # round-robin: resume after the last slot serviced so co-resident
+        # long prompts share the per-step budget fairly (deterministic —
+        # and result-irrelevant: per-slot math never crosses slots)
+        prefilling.sort(
+            key=lambda sa: (sa[0] <= self._chunk_rr_slot, sa[0])
+        )
+        for slot, act in prefilling[:1]:
+            self._chunk_rr_slot = slot
+            h = act.handle
+            c = self.prefill_chunk
+            start = act.prefill_pos
+            piece = act.prompt[start : start + c]
+            toks = np.zeros((1, c), np.int32)
+            toks[0, : len(piece)] = piece
+            lengths = np.array([len(act.prompt)], np.int32)
+            starts = np.array([start], np.int32)
+            seeds, temps, top_ks = self._sampling_row(h)
+            rows = self.cache.slot_row(slot)
+            # span-ok: ring-buffer write only, constant name, int attrs — the
+            # chunk loop is hot-path like the decode loop (lint-pinned)
+            with trace.activate(h.trace_ctx):
+                with trace.span(
+                    "serving.prefill_chunk", request_id=h.request_id,
+                    start=start,
+                ):
+                    # ONE dispatch per chunk: forward + commit fused, pages
+                    # donated through (see model.prefill_chunk docstring)
+                    self.k_pages, self.v_pages, tok = self._prefill_chunk(
+                        self.params, self.k_pages, self.v_pages, toks,
+                        starts, lengths, rows, seeds, temps, top_ks,
+                    )
+            act.prefill_pos = min(start + c, len(act.prompt))
+            self.prefill_chunks_committed += 1
+            SERVING_EVENTS.incr("serving_prefill_chunks")
+            if not act.prefilling:
+                # sync-ok: one host fetch per REQUEST (not per chunk, not per
+                # step) — the FINAL chunk's sampled first token, which the
+                # autoregressive loop needs on host; intermediate chunks
+                # never fetch (their `tok` stays device-resident and unused)
+                act.append(int(tok[0]))
+                self._observe_ttft(h, h.trace_ctx)
+                SERVING_EVENTS.incr("serving_prefills")
+                reason = act.finished(self.cfg.eos_id)
+                if reason is not None:
+                    self.scheduler.retire(slot, reason)
+
     def _decode_once(self) -> None:
-        """One continuous-batching decode step: every active slot advances by
-        one token inside the single fixed-shape executable."""
-        active = self.scheduler.active_slots()
+        """One continuous-batching decode step: every active, fully-prefilled
+        slot advances by one token inside the single fixed-shape executable
+        (slots mid-chunked-prefill sit this one out as inactive lanes — their
+        KV is still being committed)."""
+        active = [
+            (slot, act) for slot, act in self.scheduler.active_slots()
+            if not act.prefilling
+        ]
         if not active:
             return
         if _faults.get().active:
@@ -298,17 +461,29 @@ class ServingSession:
         tokens = np.zeros(s, np.int32)
         positions = np.zeros(s, np.int32)
         act_mask = np.zeros(s, bool)
+        seeds = np.zeros(s, np.uint32)
+        steps = np.zeros(s, np.int32)
+        temps = np.zeros(s, np.float32)
+        top_ks = np.zeros(s, np.int32)
         for slot, act in active:
             tokens[slot] = act.last_token
             positions[slot] = act.next_pos
             act_mask[slot] = True
+            # sampling identity rides as DATA: the token this step emits for
+            # the slot is draw `generated` of request `seed` — exactly what a
+            # crash replay re-draws (bitwise), and still one decode signature
+            seeds[slot] = act.handle.seed
+            steps[slot] = act.generated
+            temps[slot] = act.handle.temperature
+            top_ks[slot] = act.handle.top_k
         bt = self.cache.block_table()
         # zero-recompile assertion data: the decode signature must be the
         # same every step no matter the request mix (fixed [max_slots] shape)
         self.recompiles.record(
             stats.batch_signature(
                 {"tokens": tokens, "positions": positions, "active": act_mask,
-                 "block_table": bt}
+                 "block_table": bt, "seeds": seeds, "steps": steps,
+                 "temps": temps, "top_ks": top_ks}
             )
         )
         # span-ok: ring-buffer write only, constant name, int attr — no file
@@ -317,7 +492,7 @@ class ServingSession:
         with trace.span("serving.decode_step", active=len(active)):
             self.k_pages, self.v_pages, next_tok = self._decode(
                 self.params, self.k_pages, self.v_pages,
-                tokens, positions, act_mask, bt,
+                tokens, positions, act_mask, bt, seeds, steps, temps, top_ks,
             )
             # sync-ok: the ONE sanctioned fetch in the serving hot loop — the
             # sampled token ids, which the autoregressive loop needs on host to
@@ -335,8 +510,10 @@ class ServingSession:
 
     def step(self, now: Optional[float] = None) -> bool:
         """One engine iteration: reap expired/cancelled requests, then
-        retire/admit at the boundary, then one decode step. Returns True
-        when any work was done."""
+        retire/admit at the boundary, then one prefill chunk per prefilling
+        slot, then one decode step — chunked prefill and decode INTERLEAVE
+        inside every engine step rather than alternate across them. Returns
+        True when any work was done."""
         if now is None:
             # clock-ok: the ONE sanctioned wall-clock read per engine step —
             # deadline expiry, cancellation reaping and admission stamps all
@@ -346,6 +523,7 @@ class ServingSession:
         self._last_progress = now  # supervisor stall-watchdog heartbeat
         self.scheduler.reap(now)
         self._admit(now)
+        self._prefill_chunks()
         before = self.decode_steps
         self._decode_once()
         return self.decode_steps != before or bool(self.scheduler.active_slots())
@@ -579,6 +757,10 @@ class ServingSession:
             "engine_restarts": self.engine_restarts,
             "estimated_queue_wait_s": round(sch.estimate_wait_s(), 4),
             "prefill_buckets": list(self.buckets),
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunks_committed": self.prefill_chunks_committed,
+            "default_temperature": self.default_temperature,
+            "default_top_k": self.default_top_k,
         }
 
 
@@ -595,7 +777,9 @@ def make_demo_session(
 
     buckets = session_kw.pop("prefill_buckets", (16, 32, 64))
     max_new = session_kw.pop("max_new_limit", 64)
-    max_len = max(buckets) + max_new
+    # chunked prefill serves prompts beyond the largest bucket, so callers
+    # exercising it can ask for more position room than the bucket default
+    max_len = session_kw.pop("max_len", None) or max(buckets) + max_new
     model = ServableLM(LMConfig(
         vocab=vocab, n_layers=n_layers, d_model=d_model, n_heads=n_heads,
         max_len=max_len,

@@ -36,10 +36,37 @@ def make_prompts(
     return out
 
 
+def make_mixed_prompts(
+    n: int,
+    short_lengths: Sequence[int],
+    long_len: int,
+    long_every: int,
+    vocab: int,
+    bos_id: int,
+    seed: int = 0,
+    burst: int = 3,
+) -> List[List[int]]:
+    """The chunked-prefill workload (ISSUE 11): a steady short-prompt stream
+    with a BURST of `burst` long prompts joining every `long_every` requests
+    mid-stream. Bursts are the adversarial arrival pattern for whole-prompt
+    prefill: every long prompt admitted at one step boundary runs its full
+    forward serially inside that single engine step, so the running streams'
+    inter-token gap is burst_size × prefill — exactly the stall chunked
+    prefill bounds to one chunk per step."""
+    base = make_prompts(n, lengths=short_lengths, vocab=vocab, bos_id=bos_id,
+                        seed=seed)
+    rs = np.random.RandomState(seed + 1)
+    for i in range(long_every // 2, n, long_every):
+        for j in range(i, min(i + burst, n)):
+            body = rs.randint(3, vocab, size=long_len - 1)
+            base[j] = [bos_id] + [int(t) for t in body]
+    return base
+
+
 def run_closed_loop(
     session,
     prompts: List[List[int]],
-    max_new_tokens: int,
+    max_new_tokens,  # int, or a per-prompt list (staggers retirements)
     concurrency: int,
     tenant: str = "default",
     deadline_s: Optional[float] = None,
@@ -47,17 +74,26 @@ def run_closed_loop(
 ) -> Dict:
     """Drive `session` single-threaded: keep up to `concurrency` requests in
     flight, stepping the engine until all prompts complete. Returns
-    tokens/sec plus p50/p99/p999 request latency and (when deadlines are
-    armed) the deadline-miss and shed columns — present either way, so
+    tokens/sec plus p50/p99/p999 request latency, the INTER-TOKEN latency
+    percentiles (gap between consecutive tokens of one stream, observed at
+    engine-step boundaries — the number a whole-prompt prefill stall shows
+    up in and chunked prefill must keep flat, ISSUE 11), and (when deadlines
+    are armed) the deadline-miss and shed columns — present either way, so
     bench rounds stay comparable. Throughput and the percentiles count only
     requests that COMPLETED: a deadline-cancelled request's partial tokens
     and truncated latency would otherwise flatter the overloaded run
     (higher tok/s, lower p99) exactly when it is failing."""
     from paddle_tpu.serving.quota import QuotaExceeded
 
+    budgets = (
+        list(max_new_tokens) if isinstance(max_new_tokens, (list, tuple))
+        else [max_new_tokens] * len(prompts)
+    )
     pending = list(enumerate(prompts))
     in_flight = {}  # request_id -> (index, handle)
     latencies_ms: List[float] = []
+    itl_ms: List[float] = []  # inter-token gaps across ALL streams
+    token_seen = {}  # request_id -> (token_count, t_last_token)
     tokens_out = 0
     shed = 0
     deadline_missed = 0
@@ -69,17 +105,31 @@ def run_closed_loop(
             idx, prompt = pending.pop(0)
             try:
                 h = session.submit(
-                    prompt, max_new_tokens, tenant=tenant,
+                    prompt, budgets[idx], tenant=tenant,
                     deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
                 )
             except QuotaExceeded:
                 shed += 1
                 continue
             in_flight[h.request_id] = (idx, h)
+            token_seen[h.request_id] = (0, None)
         session.step()
+        now = time.monotonic()
+        # inter-token latency: a stream's gap between consecutive tokens,
+        # measured from this driver's step boundary (first token = TTFT,
+        # excluded — ITL isolates the steady-stream stall a co-scheduled
+        # prefill causes)
+        for rid, (_, h) in in_flight.items():
+            n_prev, t_prev = token_seen[rid]
+            n_now = len(h.tokens)
+            if n_now > n_prev:
+                if t_prev is not None:
+                    itl_ms.append((now - t_prev) * 1e3 / (n_now - n_prev))
+                token_seen[rid] = (n_now, now)
         done = [rid for rid, (_, h) in in_flight.items() if h.done]
         for rid in done:
             idx, h = in_flight.pop(rid)
+            token_seen.pop(rid, None)
             if h.status == h.DONE:
                 results[idx] = h.tokens
                 tokens_out += len(h.tokens)
@@ -89,6 +139,7 @@ def run_closed_loop(
     dt = time.monotonic() - t0
 
     lat = np.asarray(latencies_ms) if latencies_ms else np.asarray([0.0])
+    itl = np.asarray(itl_ms) if itl_ms else np.asarray([0.0])
     accepted = len(latencies_ms) + deadline_missed
     return {
         "concurrency": concurrency,
@@ -99,6 +150,8 @@ def run_closed_loop(
         "p50_latency_ms": round(float(np.percentile(lat, 50)), 2),
         "p99_latency_ms": round(float(np.percentile(lat, 99)), 2),
         "p999_latency_ms": round(float(np.percentile(lat, 99.9)), 2),
+        "p50_inter_token_ms": round(float(np.percentile(itl, 50)), 3),
+        "p99_inter_token_ms": round(float(np.percentile(itl, 99)), 3),
         "shed": shed,
         "deadline_misses": deadline_missed,
         "deadline_miss_ratio": round(deadline_missed / accepted, 4)

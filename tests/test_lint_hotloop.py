@@ -53,10 +53,15 @@ SERVING_SYNC_CALL = re.compile(
 )
 
 # (file, class, hot methods, pattern, max sync-ok tags)
+#
+# ISSUE 11 extended the serving hot surface: _prefill_chunks runs once per
+# engine step while a long prompt commits (its ONE sanctioned fetch is the
+# final chunk's sampled first token — per REQUEST, not per chunk), so it
+# obeys the same np.asarray/float( ban as the decode loop.
 HOT_LOOPS = [
     (TRAINER_PY, "SGDTrainer", ("train", "_train_one_pass"), SYNC_CALL, 4),
-    (SERVING_PY, "ServingSession", ("_decode_once", "step"),
-     SERVING_SYNC_CALL, 1),
+    (SERVING_PY, "ServingSession", ("_decode_once", "step", "_prefill_chunks"),
+     SERVING_SYNC_CALL, 2),
 ]
 
 # a tag on the offending line or in the contiguous comment block above it
@@ -80,7 +85,8 @@ SPAN_TAG = "span-ok"
 # (file, class, hot methods, max span-ok tags)
 SPAN_HOT_LOOPS = [
     (TRAINER_PY, "SGDTrainer", ("train", "_train_one_pass"), 2),
-    (SERVING_PY, "ServingSession", ("_decode_once", "step"), 1),
+    (SERVING_PY, "ServingSession", ("_decode_once", "step", "_prefill_chunks"),
+     2),
 ]
 HOT_IO_CALL = re.compile(r"(?<![\w.])open\(|\.write\(|json\.dump")
 SPAN_FMT = re.compile(
@@ -236,8 +242,8 @@ CLOCK_TAG = "clock-ok"
 # (file, class, methods on the request path, max clock-ok tags)
 CLOCK_HOT_LOOPS = [
     (SERVING_PY, "ServingSession",
-     ("step", "_admit", "_decode_once", "_engine_loop", "_supervise",
-      "_recover"), 4),
+     ("step", "_admit", "_prefill_chunks", "_observe_ttft", "_decode_once",
+      "_engine_loop", "_supervise", "_recover"), 4),
     (SCHEDULER_PY, "Scheduler",
      ("reap", "pop_admissions", "requeue_active", "retire"), 3),
     (SCHEDULER_PY, "ActiveSeq", ("append", "finished"), 1),

@@ -61,11 +61,7 @@ def greedy_reference(model, params, prompt, max_new):
 
     toks, out = list(prompt), []
     for _ in range(max_new):
-        logits = model.forward_logits(
-            params,
-            jnp.asarray([toks], jnp.int32),
-            jnp.asarray([len(toks)], jnp.int32),
-        )
+        logits = model.forward_logits(params, jnp.asarray([toks], jnp.int32))
         nxt = int(jnp.argmax(logits[0, len(toks) - 1]))
         out.append(nxt)
         toks.append(nxt)
