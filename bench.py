@@ -437,6 +437,80 @@ def run_serving(cpu_fallback: bool) -> dict:
     }
 
 
+def run_serving_tp() -> dict:
+    """Tensor-parallel serving leg (ISSUE 12): the SAME demo-LM geometry
+    served single-chip and at TP=N (N = 4 when the host exposes >= 4
+    devices), with per-chip param/KV-pool bytes read from sharding
+    metadata. The cross-round headline is `serving_tp4_pool_bytes_per_chip`
+    — the number that must keep dropping as the pool shards wider. Tokens
+    must be identical across the legs (TP is result-invisible); on CPU the
+    collectives are emulated, so tokens/sec here is a smoke number, tagged
+    with the platform like every entry.
+
+    Runs LAST and detaches the persistent compile cache first: this leg
+    EXECUTES multi-device programs, and running a cache-DESERIALIZED
+    multi-device program segfaults on this jax build (the PR-5/PR-8
+    gotcha); detaching is sticky, which is why this leg is last."""
+    import jax
+
+    from paddle_tpu.core.init_ctx import detach_compilation_cache
+    from paddle_tpu.serving.session import make_demo_session
+    from paddle_tpu.serving.workload import make_prompts, run_closed_loop
+
+    n_dev = len(jax.devices())
+    tp = 4
+    if n_dev < 4:
+        # never measure a DIFFERENT tp under the tp4-named headline: the
+        # cross-round series would silently change scale with the host's
+        # device count — raise instead (caller records serving_tp_error and
+        # appends no misleading metric entry)
+        raise RuntimeError(
+            f"serving TP leg needs >= 4 devices for the tp4 headline; host "
+            f"exposes {n_dev}"
+        )
+    detach_compilation_cache("bench TP serving leg executes multi-device programs")
+    requests = int(os.environ.get("BENCH_SERVE_TP_REQUESTS", "16"))
+    max_new = int(os.environ.get("BENCH_SERVE_MAX_NEW", "16"))
+    prompts = make_prompts(
+        requests, lengths=(5, 11, 16, 23, 32), vocab=256, bos_id=1, seed=0
+    )
+    warm = make_prompts(2, lengths=(16, 32), vocab=256, bos_id=1, seed=7)
+
+    def leg(tp_n):
+        session = make_demo_session(
+            vocab=256, n_layers=2, d_model=64, n_heads=4, seed=0,
+            max_slots=16, page_size=16, prefill_buckets=(16, 32),
+            max_new_limit=max_new, tp=tp_n,
+        )
+        run_closed_loop(session, warm, max_new, concurrency=2)
+        res = run_closed_loop(session, prompts, max_new, concurrency=16)
+        return res, session.stats()
+
+    base_res, base_st = leg(0)
+    tp_res, tp_st = leg(tp)
+    return {
+        "metric": "serving_tp4_pool_bytes_per_chip",
+        "value": tp_st["pool_bytes_per_chip"],
+        "unit": "bytes",
+        "platform": jax.devices()[0].platform,
+        "tp": tp,
+        "pool_bytes_per_chip_single": base_st["pool_bytes_per_chip"],
+        "pool_bytes_ratio": round(
+            base_st["pool_bytes_per_chip"]
+            / max(tp_st["pool_bytes_per_chip"], 1), 2
+        ),
+        "param_bytes_per_chip": tp_st["param_bytes_per_chip"],
+        "param_bytes_per_chip_single": base_st["param_bytes_per_chip"],
+        "tokens_per_sec": tp_res["tokens_per_sec"],
+        "tokens_per_sec_single": base_res["tokens_per_sec"],
+        "p99_inter_token_ms": tp_res["p99_inter_token_ms"],
+        "tp_tokens_identical": bool(
+            tp_res["results"] == base_res["results"]
+        ),
+        "decode_shape_signatures": tp_st["decode_shape_signatures"],
+    }
+
+
 def run_bench(cpu_fallback: bool) -> dict:
     import jax
 
@@ -656,6 +730,13 @@ def run_bench(cpu_fallback: bool) -> dict:
     except Exception as exc:  # noqa: BLE001 — serving must not kill the headline
         sys.stderr.write(f"[bench] serving leg failed: {exc!r}\n")
         out["serving_error"] = repr(exc)[-400:]
+    # LAST on purpose: this leg detaches the persistent compile cache (it
+    # executes multi-device programs — see run_serving_tp docstring)
+    try:
+        out["metrics"].append(run_serving_tp())
+    except Exception as exc:  # noqa: BLE001 — TP leg must not kill the headline
+        sys.stderr.write(f"[bench] serving TP leg failed: {exc!r}\n")
+        out["serving_tp_error"] = repr(exc)[-400:]
     if cpu_fallback:
         out["error"] = (
             "tpu backend unavailable after probe retries; numbers are from the "

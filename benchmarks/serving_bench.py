@@ -23,9 +23,18 @@ Acceptance gates (printed in the JSON line):
     prefill <= 0.5x the whole-prompt-prefill baseline at 16 streams when
     long prompts join mid-stream, with identical tokens across the legs
 
+The --tp leg (ISSUE 12) serves identical geometry at TP=1/2/4, one child
+process per size with that many FORCED host devices (the shard_update_bench
+pattern): tokens must be identical at every TP, per-chip KV-pool bytes
+exactly TP× down and param bytes ~TP× down (both from sharding metadata),
+zero decode recompiles. Each entry carries its own "platform" tag — CPU
+emulates the collectives, so the TP tokens/sec column is a smoke number
+there.
+
 Usage:
   JAX_PLATFORMS=cpu python benchmarks/serving_bench.py
       [--streams 1,4,16,64] [--requests N] [--max_new N]
+      [--tp 1,2,4] [--skip_tp]
       [--vocab V --n_layers L --d_model D --n_heads H]
 
 Output: one JSON line {"metric": "serving_bench", ...} with a per-stream-
@@ -37,6 +46,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -223,6 +233,139 @@ def run_mixed_length(args):
     return out
 
 
+def run_tp_child(args):
+    """One tensor-parallel leg in THIS process (forced host device count is
+    already set by the parent re-exec): identical geometry at every TP, so
+    the tokens/sec + p99 ITL deltas isolate the collectives, and the
+    per-chip param/pool bytes come from sharding metadata."""
+    import jax
+
+    from paddle_tpu.serving.session import make_demo_session
+    from paddle_tpu.serving.workload import make_prompts, run_closed_loop
+
+    tp = args._child_tp
+    session = make_demo_session(
+        vocab=args.vocab, n_layers=args.n_layers, d_model=args.d_model,
+        n_heads=args.tp_n_heads, seed=0,
+        max_slots=args.max_slots, page_size=args.page_size,
+        prefill_buckets=(16, 32), max_new_limit=args.max_new,
+        tp=(tp if tp > 1 else 0),
+    )
+    prompts = make_prompts(
+        args.requests, lengths=(5, 11, 16, 23, 32), vocab=args.vocab,
+        bos_id=1, seed=0,
+    )
+    warm = make_prompts(
+        len(session.buckets), lengths=session.buckets, vocab=args.vocab,
+        bos_id=1, seed=7,
+    )
+    run_closed_loop(session, warm, args.max_new, concurrency=len(warm))
+    sigs0 = session.decode_shape_signatures()
+    session.scheduler.reset_load_estimate()
+    res = run_closed_loop(session, prompts, args.max_new, concurrency=16)
+    tokens = res.pop("results")
+    st = session.stats()
+    res.update({
+        "tp": tp,
+        "platform": jax.devices()[0].platform,
+        "devices": jax.device_count(),
+        "decode_recompiles_after_warmup":
+            session.decode_shape_signatures() - sigs0,
+        "param_bytes_per_chip": st["param_bytes_per_chip"],
+        "pool_bytes_per_chip": st["pool_bytes_per_chip"],
+        "results": tokens,
+    })
+    print("TP_BENCH_JSON " + json.dumps(res))
+
+
+def run_tp(args):
+    """The --tp leg (ISSUE 12): TP=1/2/4 over identical geometry, each in a
+    child process with the XLA host device count FORCED to the TP size (the
+    shard_update_bench pattern — the device count is fixed at backend
+    init). The persistent compile cache is dropped from the children:
+    executing a cache-DESERIALIZED multi-device program segfaults on this
+    jax build (see tests/test_precision.py). Gates: tokens identical at
+    every TP (tensor parallelism is result-invisible), zero decode
+    recompiles, and per-chip pool bytes exactly TP× down."""
+    legs = []
+    for n in [int(x) for x in args.tp.split(",") if x.strip()]:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PADDLE_TPU_COMPILE_CACHE", None)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "").replace(
+                "--xla_force_host_platform_device_count=8", ""
+            )
+            + f" --xla_force_host_platform_device_count={max(n, 1)}"
+        ).strip()
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            f"--_child_tp={n}", f"--requests={args.requests}",
+            f"--max_new={args.max_new}", f"--max_slots={args.max_slots}",
+            f"--page_size={args.page_size}", f"--vocab={args.vocab}",
+            f"--n_layers={args.n_layers}", f"--d_model={args.d_model}",
+            f"--tp_n_heads={args.tp_n_heads}",
+        ]
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=1200, env=env,
+            )
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            # a wedged/unspawnable child is an ERROR LEG, not a bench abort:
+            # the streams grid + mixed-length results already computed must
+            # still reach the JSON line
+            legs.append({"tp": n, "error": repr(exc)[-500:]})
+            continue
+        line = next(
+            (l for l in out.stdout.splitlines()
+             if l.startswith("TP_BENCH_JSON ")), None,
+        )
+        if line is None:
+            legs.append({"tp": n, "error": (out.stderr or out.stdout)[-500:]})
+        else:
+            legs.append(json.loads(line[len("TP_BENCH_JSON "):]))
+    ok_legs = [l for l in legs if "error" not in l]
+    token_sets = {l["tp"]: l.pop("results") for l in ok_legs}
+    base = next((l for l in ok_legs if l["tp"] <= 1), None)
+    identical = (
+        len(token_sets) == len(legs) and len(set(
+            json.dumps(t) for t in token_sets.values()
+        )) == 1
+    )
+    gates = {
+        "tp_tokens_identical": bool(identical),
+        "tp_zero_decode_recompiles": bool(ok_legs) and all(
+            l["decode_recompiles_after_warmup"] == 0 for l in ok_legs
+        ),
+    }
+    for leg in ok_legs:
+        if base is None or leg["tp"] <= 1:
+            continue
+        n = leg["tp"]
+        gates[f"tp{n}_pool_bytes_ratio"] = round(
+            base["pool_bytes_per_chip"] / max(leg["pool_bytes_per_chip"], 1), 2
+        )
+        gates[f"tp{n}_pool_bytes_exact"] = bool(
+            leg["pool_bytes_per_chip"] * n == base["pool_bytes_per_chip"]
+        )
+        gates[f"tp{n}_param_bytes_ratio"] = round(
+            base["param_bytes_per_chip"] / max(leg["param_bytes_per_chip"], 1),
+            2,
+        )
+        gates[f"tp{n}_param_bytes_reduced_enough"] = bool(
+            base["param_bytes_per_chip"]
+            >= 0.6 * n * leg["param_bytes_per_chip"]
+        )
+        print(
+            f"[serving_bench] tp={n}: {leg['tokens_per_sec']} tok/s "
+            f"p99_itl={leg['p99_inter_token_ms']}ms "
+            f"pool_bytes/chip={leg['pool_bytes_per_chip']} "
+            f"(ratio {gates[f'tp{n}_pool_bytes_ratio']}x) "
+            f"identical={identical}",
+            file=sys.stderr,
+        )
+    return {"legs": legs, "gates": gates}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--streams", default="1,4,16,64")
@@ -249,11 +392,26 @@ def main():
     ap.add_argument("--mixed_n_heads", type=int, default=4)
     ap.add_argument("--skip_mixed", action="store_true",
                     help="skip the mixed-length chunked-prefill leg")
+    ap.add_argument("--tp", default="1,2,4",
+                    help="tensor-parallel leg (ISSUE 12): comma list of TP "
+                         "sizes, each run in a child with that many forced "
+                         "host devices over identical geometry; empty "
+                         "string skips the leg")
+    ap.add_argument("--tp_n_heads", type=int, default=4,
+                    help="head count for the --tp leg (must divide by every "
+                         "TP size; the main grid keeps --n_heads)")
+    ap.add_argument("--skip_tp", action="store_true",
+                    help="skip the tensor-parallel leg")
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--n_layers", type=int, default=2)
     ap.add_argument("--d_model", type=int, default=64)
     ap.add_argument("--n_heads", type=int, default=2)
+    ap.add_argument("--_child_tp", type=int, default=0, help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args._child_tp:
+        run_tp_child(args)
+        return
 
     from paddle_tpu.serving.model import LMConfig
     from paddle_tpu.serving.workload import make_prompts
@@ -293,6 +451,7 @@ def main():
     consistent = all(t == token_sets[min(token_sets)] for t in token_sets.values())
     speedup_16 = by_n.get(16, {}).get("speedup_vs_sequential", 0.0)
     mixed = None if args.skip_mixed else run_mixed_length(args)
+    tp = None if (args.skip_tp or not args.tp.strip()) else run_tp(args)
     gates = {
         "speedup_16_vs_sequential": speedup_16,
         "speedup_16_ge_3x": bool(speedup_16 >= 3.0),
@@ -311,6 +470,13 @@ def main():
         ok = (ok and mixed["chunked_itl_le_half"]
               and mixed["chunked_result_transparent"]
               and mixed["zero_decode_recompiles"])
+    if tp is not None:
+        gates.update(tp["gates"])
+        ok = (ok and tp["gates"]["tp_tokens_identical"]
+              and tp["gates"]["tp_zero_decode_recompiles"]
+              and all(v for k, v in tp["gates"].items()
+                      if k.endswith(("_pool_bytes_exact",
+                                     "_param_bytes_reduced_enough"))))
     print(json.dumps({
         "metric": "serving_bench",
         "value": speedup_16,
@@ -319,6 +485,7 @@ def main():
         "gates": gates,
         "results": results,
         "mixed_length": mixed,
+        "tensor_parallel": tp,
     }))
 
 

@@ -854,6 +854,13 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--top_k", type=int, default=0,
                    help="default top-k truncation for requests that do not "
                         "set one (0 = off)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel size (0/1 = single chip): shard "
+                        "params and the KV page pool over the mesh 'model' "
+                        "axis via the named sharding rules "
+                        "(parallel/rules.py); needs n_heads and vocab "
+                        "divisible by N, and N devices visible; tokens are "
+                        "identical to single-chip serving")
     p.add_argument("--max_new_limit", type=int, default=64)
     p.add_argument("--max_queue", type=int, default=256)
     p.add_argument("--tenant_tokens", type=float, default=0.0,
@@ -959,13 +966,18 @@ def cmd_serve(args: argparse.Namespace) -> int:
         if args.load:
             from paddle_tpu.serving.model import ServableLM
 
-            model, params = ServableLM.load(args.load)
+            mesh = None
+            if args.tp and args.tp > 1:
+                from paddle_tpu.parallel.rules import make_tp_mesh
+
+                mesh = make_tp_mesh(args.tp)
+            model, params = ServableLM.load(args.load, mesh=mesh)
             session = ServingSession(model, params, **session_kw)
         else:
             session = make_demo_session(
                 vocab=args.vocab, n_layers=args.n_layers,
                 d_model=args.d_model, n_heads=args.n_heads, seed=args.seed,
-                max_len=args.max_len or None,
+                max_len=args.max_len or None, tp=args.tp,
                 **session_kw,
             )
 

@@ -124,7 +124,15 @@ class ParamAttr:
     # initial_std when set
     initial_min: Optional[float] = None
     initial_max: Optional[float] = None
-    # Logical sharding axes for pjit (None → replicated), e.g. ("model", None).
+    # NAMED logical sharding axes resolved through the parallel rules table
+    # (parallel/rules.py DEFAULT_RULES), e.g. ("embed", "mlp") — declare the
+    # axis MEANING once here; which mesh axis (if any) it shards over is the
+    # deployment's rules-table decision (ISSUE 12).
+    logical_axes: Optional[Tuple[Optional[str], ...]] = None
+    # DEPRECATED: raw mesh-axis tuples, e.g. ("model", None). Kept as a shim —
+    # mesh-axis names are implicitly logical names that resolve to themselves
+    # through the rules table — so old call sites translate into the table
+    # rather than bypassing it. New code should use logical_axes.
     sharding: Optional[Tuple[Optional[str], ...]] = None
 
 

@@ -1,4 +1,9 @@
 from paddle_tpu.parallel.mesh import make_mesh, resize_mesh  # noqa: F401
+from paddle_tpu.parallel.rules import (  # noqa: F401
+    DEFAULT_RULES,
+    ShardingRules,
+    make_tp_mesh,
+)
 from paddle_tpu.parallel.data_parallel import DataParallel  # noqa: F401
 from paddle_tpu.parallel import distributed as distributed  # noqa: F401
 from paddle_tpu.parallel.sequence_parallel import (  # noqa: F401

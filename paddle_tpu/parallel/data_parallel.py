@@ -29,17 +29,23 @@ log = logging.getLogger("paddle_tpu.parallel")
 
 class DataParallel:
     """Plugs into SGDTrainer(parallel=...). `batch_axis` shards batches;
-    param shardings come from ParamAttr.sharding tuples."""
+    param shardings come from ParamAttr.logical_axes resolved through the
+    rules table (parallel/rules.py), with legacy ParamAttr.sharding
+    mesh-axis tuples translated through the same table as a shim."""
 
     def __init__(
         self,
         mesh: Mesh,
         batch_axis: str = "data",
         param_attrs: Optional[Dict[str, ParamAttr]] = None,
+        rules=None,
     ):
+        from paddle_tpu.parallel.rules import ShardingRules
+
         self.mesh = mesh
         self.batch_axis = batch_axis
         self.param_attrs = param_attrs or {}
+        self.rules = rules if rules is not None else ShardingRules()
         self._replicated = NamedSharding(mesh, P())
         self._batch_sharding = NamedSharding(mesh, P(batch_axis))
         # K-stacked ([K, B, ...]) placement: scan axis unsharded, batch axis
@@ -49,12 +55,21 @@ class DataParallel:
 
     # -- sharding rules ------------------------------------------------------
     def param_sharding(self, name: str, ndim: int) -> NamedSharding:
+        """Resolve one parameter's placement through the rules table:
+        `logical_axes` wins, the deprecated mesh-axis `sharding` tuple rides
+        the table's identity shim. Rank-mismatched specs (more axes than the
+        array has dims) raise naming the param — they used to be silently
+        truncated, which sharded the WRONG dims of any param whose spec
+        outlived a shape change."""
         attr = self.param_attrs.get(name)
-        if attr is not None and attr.sharding is not None:
-            spec = list(attr.sharding)[:ndim]
-            spec += [None] * (ndim - len(spec))
-            return NamedSharding(self.mesh, P(*spec))
-        return self._replicated
+        if attr is None:
+            return self._replicated
+        axes = attr.logical_axes
+        if axes is None:
+            axes = attr.sharding
+        if axes is None:
+            return self._replicated
+        return self.rules.sharding_for(self.mesh, axes, ndim=ndim, param=name)
 
     @property
     def data_axis_size(self) -> int:

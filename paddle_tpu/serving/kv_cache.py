@@ -44,6 +44,7 @@ class PagedKVCache:
         page_size: int,
         max_slots: int,
         max_pages_per_seq: int,
+        pool_sharding=None,
     ):
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is the dump page)")
@@ -53,6 +54,12 @@ class PagedKVCache:
         self.page_size = page_size
         self.max_slots = max_slots
         self.max_pages_per_seq = max_pages_per_seq
+        # TP placement (ISSUE 12): a NamedSharding splitting the kv_dim's
+        # kv_heads over the mesh 'model' axis — per-chip pool bytes drop
+        # ~TPx. Stored here so every make_pools call (init AND the crash-
+        # recovery re-init) lands the pools on the same layout. None =
+        # single-chip default placement.
+        self.pool_sharding = pool_sharding
         # pop() hands out ascending ids; page 0 is never allocatable
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
@@ -62,12 +69,20 @@ class PagedKVCache:
 
     # -- device pool --------------------------------------------------------
     def make_pools(self, dtype=None):
-        """Fresh zeroed (k_pages, v_pages) device arrays."""
+        """Fresh zeroed (k_pages, v_pages) device arrays, placed on
+        `pool_sharding` when the cache is tensor-parallel."""
+        import jax
         import jax.numpy as jnp
 
         shape = (self.n_layers, self.num_pages, self.page_size, self.kv_dim)
         dtype = dtype or jnp.float32
-        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+        if self.pool_sharding is None:
+            return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, dtype),
+            out_shardings=self.pool_sharding,
+        )
+        return zeros(), zeros()
 
     # -- accounting ---------------------------------------------------------
     def pages_needed(self, total_len: int) -> int:

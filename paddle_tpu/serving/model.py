@@ -41,6 +41,22 @@ slot dimension; no cross-slot reduction), which is what makes continuous
 batching *bitwise* transparent: a request's tokens are identical whether it
 ran alone or joined a full batch mid-stream (tests/test_serving.py).
 
+Tensor parallelism (ISSUE 12) rides the named sharding-rules mesh
+(parallel/rules.py): every parameter declares LOGICAL axes once
+(`param_logical_axes`), the rules table maps them to the mesh `model` axis
+(heads/kv_heads/mlp/vocab split, embed replicated), and the per-layer
+resharding points carry `with_sharding_constraint`s so XLA's partitioner
+emits exactly one all-reduce per row-parallel projection (wo, w2) and one
+logits all-gather at the unembed output — sampling then runs on REPLICATED
+logits, so the greedy branch stays collective-free and tokens are identical
+to the single-chip oracle. The paged KV pool shards its kv_heads dim over
+the same axis (per-chip pool bytes drop ~TPx), block tables stay replicated
+host state, and `_paged_attention` runs per-shard over the LOCAL head slice
+under shard_map — the Pallas kernel and the jnp gather oracle take the same
+specs, so the CPU tests exercise the TP code structure bit-for-bit.
+With no mesh (or model axis 1) every path is bitwise the PR-11 single-chip
+program — TP support costs the one-chip deployment nothing.
+
 All methods are pure functions of (params, inputs) — the serving session owns
 jit + donation. The model is deliberately small-config-friendly (the repo's
 CPU oracle discipline) but structurally a real transformer LM: pre-RMSNorm,
@@ -49,15 +65,21 @@ multi-head causal attention, GELU MLP, learned positions, tied nothing."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+import functools
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 Array = jax.Array
 
 NEG_INF = -1e9
+
+# the paged KV pools' logical axes [n_layers, num_pages, page_size, kv_dim]:
+# only the flattened (kv_heads * head_dim) dim shards, over the model axis
+POOL_LOGICAL_AXES = (None, None, None, "kv_heads")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,9 +103,107 @@ def _rms(x: Array, scale: Array) -> Array:
 
 
 class ServableLM:
-    def __init__(self, cfg: LMConfig):
+    def __init__(self, cfg: LMConfig, mesh=None, rules=None):
+        from paddle_tpu.parallel.rules import ShardingRules
+
         self.cfg = cfg
         self.scale = 1.0 / float(np.sqrt(cfg.head_dim))
+        self.rules = rules if rules is not None else ShardingRules()
+        self._axes_cache: Optional[Dict[str, Tuple[Optional[str], ...]]] = None
+        # a mesh whose model axis is 1 (or absent) is the single-chip path:
+        # drop it so every program stays bitwise the unsharded PR-11 one
+        tp = int(dict(mesh.shape).get("model", 1)) if mesh is not None else 1
+        self.mesh = mesh if tp > 1 else None
+        if self.mesh is not None:
+            for what, n in (("n_heads", cfg.n_heads), ("vocab", cfg.vocab)):
+                if n % tp:
+                    raise ValueError(
+                        f"tensor parallelism over {tp} chips needs "
+                        f"{what} % {tp} == 0 (got {what}={n}): heads and "
+                        "vocab split over the mesh 'model' axis"
+                    )
+
+    @property
+    def tp_size(self) -> int:
+        return int(dict(self.mesh.shape)["model"]) if self.mesh is not None else 1
+
+    # -- named sharding (ISSUE 12) ------------------------------------------
+    def param_logical_axes(self) -> Dict[str, Tuple[Optional[str], ...]]:
+        """Every parameter's LOGICAL axes — declared once, resolved through
+        the rules table (parallel/rules.py DEFAULT_RULES). Megatron-style TP:
+        qkv/w1 column-parallel (heads/mlp), wo/w2 row-parallel, embed rows +
+        unembed columns over vocab; norms/biases/positions replicated.
+        Built once and cached: shard_params resolves every parameter
+        through here (O(P) placements, not O(P^2) dict rebuilds)."""
+        if self._axes_cache is not None:
+            return self._axes_cache
+        axes: Dict[str, Tuple[Optional[str], ...]] = {
+            "embed": ("vocab", "embed"),
+            "pos": ("length", "embed"),
+            "lnf": ("embed",),
+            "unembed": ("embed", "vocab"),
+        }
+        for i in range(self.cfg.n_layers):
+            axes.update({
+                f"l{i}.wq": ("embed", "heads"),
+                f"l{i}.wk": ("embed", "kv_heads"),
+                f"l{i}.wv": ("embed", "kv_heads"),
+                f"l{i}.wo": ("heads", "embed"),
+                f"l{i}.w1": ("embed", "mlp"),
+                f"l{i}.w2": ("mlp", "embed"),
+                f"l{i}.b1": ("mlp",),
+                f"l{i}.b2": ("embed",),
+                f"l{i}.ln1": ("embed",),
+                f"l{i}.ln2": ("embed",),
+            })
+        self._axes_cache = axes
+        return axes
+
+    def param_sharding(self, name: str, ndim: int):
+        """One param's NamedSharding through the rules table, or None when
+        there is no TP mesh (single-chip: the session device_puts plainly).
+        A param MISSING from param_logical_axes raises: silently replicating
+        it would quietly erode the per-chip memory win the table exists to
+        deliver — same contract as the rules table's unknown-name error."""
+        if self.mesh is None:
+            return None
+        axes = self.param_logical_axes().get(name)
+        if axes is None:
+            raise KeyError(
+                f"param {name!r} has no entry in param_logical_axes — every "
+                "tensor must declare its logical axes (use ('embed',)-style "
+                "replicated entries explicitly, never by omission)"
+            )
+        return self.rules.sharding_for(self.mesh, axes, ndim=ndim, param=name)
+
+    def shard_params(self, params: Dict[str, Array]) -> Dict[str, Array]:
+        """Place params on the TP mesh per the rules (identity on 1 chip)."""
+        if self.mesh is None:
+            return jax.device_put(params)
+        return {
+            k: jax.device_put(v, self.param_sharding(k, jnp.ndim(v)))
+            for k, v in params.items()
+        }
+
+    def pool_sharding(self):
+        """The paged KV pools' placement: kv_heads (inside the flattened KD
+        dim) over the model axis — per-chip pool bytes drop ~TPx. None on a
+        single chip."""
+        if self.mesh is None:
+            return None
+        return self.rules.sharding_for(
+            self.mesh, POOL_LOGICAL_AXES, param="k_pages"
+        )
+
+    def _constrain(self, x: Array, *logical: Optional[str]) -> Array:
+        """`with_sharding_constraint` through the rules table — the explicit
+        resharding points that pin where the partitioner places collectives.
+        Identity without a TP mesh, so single-chip programs are untouched."""
+        if self.mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, self.rules.sharding_for(self.mesh, logical, ndim=jnp.ndim(x))
+        )
 
     # -- params -------------------------------------------------------------
     def init_params(self, rng: Array) -> Dict[str, Array]:
@@ -124,7 +244,12 @@ class ServableLM:
                  **{k: np.asarray(v) for k, v in params.items()})
 
     @classmethod
-    def load(cls, path: str) -> Tuple["ServableLM", Dict[str, Array]]:
+    def load(
+        cls, path: str, mesh=None, rules=None
+    ) -> Tuple["ServableLM", Dict[str, Array]]:
+        """Checkpoints are CANONICAL full arrays (save() materializes every
+        shard), so the same .npz loads onto any layout: single chip, TP=2,
+        TP=4 — the cross-layout contract tests/test_tp_serving.py pins."""
         with np.load(path) as z:
             cfg = LMConfig(
                 vocab=int(z["__vocab__"]), n_layers=int(z["__n_layers__"]),
@@ -135,7 +260,7 @@ class ServableLM:
             params = {
                 k: jnp.asarray(z[k]) for k in z.files if not k.startswith("__")
             }
-        return cls(cfg), params
+        return cls(cfg, mesh=mesh, rules=rules), params
 
     # -- on-device sampling -------------------------------------------------
     def _sample(
@@ -181,10 +306,14 @@ class ServableLM:
     # -- shared block body --------------------------------------------------
     def _mlp(self, params, i: int, x: Array) -> Array:
         h = _rms(x, params[f"l{i}.ln2"])
-        return x + (
+        out = x + (
             jax.nn.gelu(h @ params[f"l{i}.w1"] + params[f"l{i}.b1"])
             @ params[f"l{i}.w2"] + params[f"l{i}.b2"]
         )
+        # TP resharding point: w2 is row-parallel (contraction dim sharded
+        # over 'model'), so the partitioner all-reduces the partial sums
+        # HERE — one collective per layer's MLP, activations replicated out
+        return self._constrain(out)
 
     # -- full-context forward (prefill + the sequential reference path) -----
     def _context_forward(self, params, tokens: Array) -> Tuple[Array, Array, Array]:
@@ -196,7 +325,7 @@ class ServableLM:
         cfg = self.cfg
         b, t = tokens.shape
         h_, hd = cfg.n_heads, cfg.head_dim
-        x = params["embed"][tokens] + params["pos"][:t][None]
+        x = self._constrain(params["embed"][tokens] + params["pos"][:t][None])
         causal = jnp.tril(jnp.ones((t, t), bool))
         kcs, vcs = [], []
         for i in range(cfg.n_layers):
@@ -212,10 +341,16 @@ class ServableLM:
             s = jnp.where(causal[None, None], s, NEG_INF)
             w = jax.nn.softmax(s.astype(jnp.float32), -1).astype(x.dtype)
             ctx = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, t, -1)
-            x = x + ctx @ params[f"l{i}.wo"]
+            # TP resharding point: wo is row-parallel — all-reduce here
+            x = self._constrain(x + ctx @ params[f"l{i}.wo"])
             x = self._mlp(params, i, x)
-        logits = _rms(x, params["lnf"]) @ params["unembed"]
-        return logits, jnp.stack(kcs), jnp.stack(vcs)
+        # the unembed is column-parallel (vocab sharded): constraining the
+        # logits REPLICATED places one all-gather here, so sampling below is
+        # collective-free and bitwise the single-chip math
+        logits = self._constrain(_rms(x, params["lnf"]) @ params["unembed"])
+        kc = self._constrain(jnp.stack(kcs), None, None, None, "kv_heads")
+        vc = self._constrain(jnp.stack(vcs), None, None, None, "kv_heads")
+        return logits, kc, vc
 
     def forward_logits(self, params, tokens: Array) -> Array:
         """Causal forward over padded [B, T] prompts -> logits [B, T, V].
@@ -282,9 +417,10 @@ class ServableLM:
         pos = starts[:, None] + jnp.arange(c)[None, :]          # [1, C]
         # padded tail may run past max_len; clamp the INDEX only (those
         # positions are causally invisible to every valid one)
-        x = params["embed"][tokens] + params["pos"][
-            jnp.minimum(pos, cfg.max_len - 1)
-        ]
+        x = self._constrain(
+            params["embed"][tokens]
+            + params["pos"][jnp.minimum(pos, cfg.max_len - 1)]
+        )
         t_ctx = block_rows.shape[1] * ps
         ctx_idx = jnp.arange(t_ctx)
         # committed-context mask: this chunk sees pages strictly before it
@@ -312,9 +448,11 @@ class ServableLM:
                 jnp.einsum("bhqk,bkhd->bqhd", w[..., :t_ctx], v_past)
                 + jnp.einsum("bhqk,bkhd->bqhd", w[..., t_ctx:], v_self)
             ).reshape(b, c, -1)
-            x = x + ctx @ params[f"l{i}.wo"]
+            # TP resharding point: row-parallel wo all-reduces here
+            x = self._constrain(x + ctx @ params[f"l{i}.wo"])
             x = self._mlp(params, i, x)
-        logits = _rms(x, params["lnf"]) @ params["unembed"]
+        # replicated logits: the one all-gather, sampling collective-free
+        logits = self._constrain(_rms(x, params["lnf"]) @ params["unembed"])
         # last valid position falls in this chunk only on the final chunk;
         # clamp keeps the index in range for the earlier ones (tok unused)
         last_in_chunk = jnp.clip(lengths - 1 - starts, 0, c - 1)
@@ -355,21 +493,28 @@ class ServableLM:
         offs = (pos % ps).reshape(-1)
         kf = kc.reshape(l, b * t, kd)
         vf = vc.reshape(l, b * t, kd)
+        # pool placement pinned at every producing seam: the scatter keeps
+        # the kv_heads dim sharded (indices touch page/offset dims only), so
+        # donated pools round-trip their TP layout with no resharding
         return (
-            k_pages.at[:, page, offs].set(kf),
-            v_pages.at[:, page, offs].set(vf),
+            self._constrain(k_pages.at[:, page, offs].set(kf), *POOL_LOGICAL_AXES),
+            self._constrain(v_pages.at[:, page, offs].set(vf), *POOL_LOGICAL_AXES),
         )
 
     # -- the ONE decode executable ------------------------------------------
-    def _paged_attention(
+    def _paged_attention_local(
         self,
-        q: Array,            # [S, KD] — this layer's queries
-        k_pages_i: Array,    # [NP, PS, KD] — this layer's page pools
+        q: Array,            # [S, KD_local] — this shard's head slice
+        k_pages_i: Array,    # [NP, PS, KD_local]
         v_pages_i: Array,
         block_table: Array,  # [S, P]
         positions: Array,    # [S]
+        n_heads: int,
     ) -> Array:
-        """Ragged paged attention for one layer's decode step: [S, KD] ctx.
+        """Ragged paged attention over `n_heads` heads (the FULL head count
+        on one chip; the LOCAL slice per shard under TP — heads are
+        batched-independent, so the per-shard math is bitwise the
+        single-chip math for those heads).
 
         Two numerically-equivalent paths behind one seam: the Pallas kernel
         (ops/pallas/paged_attention.py — block table drives the page gathers
@@ -380,7 +525,7 @@ class ServableLM:
         from paddle_tpu.ops import pallas as _pallas
 
         s = q.shape[0]
-        h_, hd = self.cfg.n_heads, self.cfg.head_dim
+        h_, hd = n_heads, self.cfg.head_dim
         if _pallas.enabled():
             from paddle_tpu.ops.pallas.paged_attention import (
                 paged_attention_decode,
@@ -401,6 +546,49 @@ class ServableLM:
         sc = jnp.where(att_mask[:, None, :], sc, NEG_INF)
         w = jax.nn.softmax(sc.astype(jnp.float32), -1).astype(q.dtype)
         return jnp.einsum("sht,sthd->shd", w, v_seq).reshape(s, -1)
+
+    def _paged_attention(
+        self,
+        q: Array,            # [S, KD] — this layer's queries
+        k_pages_i: Array,    # [NP, PS, KD] — this layer's page pools
+        v_pages_i: Array,
+        block_table: Array,  # [S, P]
+        positions: Array,    # [S]
+    ) -> Array:
+        """The TP dispatch seam over `_paged_attention_local`.
+
+        Single chip: the local body at the full head count (unchanged PR-11
+        program). Under TP: shard_map over the mesh 'model' axis — each
+        shard runs the SAME body (Pallas kernel on TPU, jnp gather oracle on
+        CPU, identical in_specs) on its resident kv_heads slice of the page
+        pool, with the block table and positions replicated; attention never
+        crosses heads, so the seam adds ZERO collectives and the kernel's
+        scalar-prefetch block-table operand (its grid geometry) is the same
+        per shard as on one chip — just fewer heads per page fetch."""
+        if self.mesh is None:
+            return self._paged_attention_local(
+                q, k_pages_i, v_pages_i, block_table, positions,
+                n_heads=self.cfg.n_heads,
+            )
+        from paddle_tpu.parallel.shard_map_compat import shard_map
+
+        local = functools.partial(
+            self._paged_attention_local,
+            n_heads=self.cfg.n_heads // self.tp_size,
+        )
+        return shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(
+                P(None, "model"),        # q: head slice
+                P(None, None, "model"),  # k_pages[i]: kv_heads slice
+                P(None, None, "model"),  # v_pages[i]
+                P(None, None),           # block table: replicated host state
+                P(None),                 # positions: replicated
+            ),
+            out_specs=P(None, "model"),
+            check_vma=False,
+        )(q, k_pages_i, v_pages_i, block_table, positions)
 
     def decode_step(
         self,
@@ -428,7 +616,11 @@ class ServableLM:
         the rest of the batch."""
         cfg = self.cfg
         ps = k_pages.shape[2]
-        x = params["embed"][tokens] + params["pos"][positions]
+        # the embed table is row-sharded over vocab: the token gather's
+        # cross-shard combine happens here, activations replicated after
+        x = self._constrain(
+            params["embed"][tokens] + params["pos"][positions]
+        )
         cur_page = jnp.take_along_axis(
             block_table, (positions // ps)[:, None], axis=1
         )[:, 0]
@@ -444,8 +636,16 @@ class ServableLM:
             ctx = self._paged_attention(
                 q, k_pages[i], v_pages[i], block_table, positions
             )
-            x = x + ctx @ params[f"l{i}.wo"]
+            # TP resharding point: row-parallel wo all-reduces here
+            x = self._constrain(x + ctx @ params[f"l{i}.wo"])
             x = self._mlp(params, i, x)
-        logits = _rms(x, params["lnf"]) @ params["unembed"]
+        # replicated logits (the one all-gather): sampling below then runs
+        # entirely locally — no collective in the greedy branch, tokens
+        # bitwise the single-chip oracle's
+        logits = self._constrain(_rms(x, params["lnf"]) @ params["unembed"])
         next_tok = self._sample(logits, seeds, steps, temps, top_ks)
-        return k_pages, v_pages, next_tok
+        return (
+            self._constrain(k_pages, *POOL_LOGICAL_AXES),
+            self._constrain(v_pages, *POOL_LOGICAL_AXES),
+            next_tok,
+        )

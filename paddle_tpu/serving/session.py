@@ -113,7 +113,10 @@ class ServingSession:
 
         self.model = model
         self.cfg = model.cfg
-        self.params = jax.device_put(params)
+        # TP (ISSUE 12): params resolve through the model's logical-axes
+        # table + sharding rules — heads/mlp/vocab split over the mesh
+        # 'model' axis, per-chip param bytes ~1/TP. Identity on one chip.
+        self.params = model.shard_params(params)
         self.buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
         self.max_new_limit = int(max_new_limit)
         max_ctx = self.buckets[-1] + self.max_new_limit
@@ -143,6 +146,9 @@ class ServingSession:
             page_size=page_size,
             max_slots=max_slots,
             max_pages_per_seq=pages_per_seq,
+            # kv_heads over the mesh 'model' axis under TP (~1/TP pool bytes
+            # per chip); the cache re-applies it on crash-recovery re-init
+            pool_sharding=model.pool_sharding(),
         )
         self.scheduler = Scheduler(
             self.cache, max_queue=max_queue, quotas=quotas,
@@ -362,6 +368,9 @@ class ServingSession:
                         self.params, toks, lengths, seeds, temps, top_ks
                     )
                     rows = self.cache.slot_row(slot)
+                    # tp-ok: per-ADMISSION placement of one request's commit
+                    # operands (never per decode step); the block table the
+                    # decode loop uses rides the jit dispatch untouched
                     self.k_pages, self.v_pages = self._commit(
                         self.k_pages, self.v_pages, kc, vc,
                         jnp.asarray(lengths), jnp.asarray(rows),
@@ -741,6 +750,13 @@ class ServingSession:
         sch = self.scheduler
         return {
             "decode_steps": self.decode_steps,
+            # TP accounting from SHARDING METADATA, not trust: what one chip
+            # actually holds (replicated leaves count fully, sharded 1/N)
+            "tp": self.model.tp_size,
+            "param_bytes_per_chip": stats.per_chip_tree_bytes(self.params),
+            "pool_bytes_per_chip": stats.per_chip_tree_bytes(
+                [self.k_pages, self.v_pages]
+            ),
             "tokens_generated": self.tokens_generated,
             "decode_shape_signatures": self.decode_shape_signatures(),
             "queue_depth": sch.queue_depth(),
@@ -770,9 +786,15 @@ def make_demo_session(
     d_model: int = 32,
     n_heads: int = 2,
     seed: int = 0,
+    tp: int = 0,
     **session_kw,
 ) -> ServingSession:
-    """A small seeded model + session (CLI --demo, benches, tests)."""
+    """A small seeded model + session (CLI --demo, benches, tests).
+
+    tp > 1 builds the 2-D ("data"=1, "model"=tp) rules mesh and serves
+    tensor-parallel over tp chips: params and the KV page pool shard over
+    the model axis, tokens stay identical to tp=0/1 (the single-chip
+    oracle) — pinned in tests/test_tp_serving.py."""
     import jax
 
     buckets = session_kw.pop("prefill_buckets", (16, 32, 64))
@@ -780,10 +802,15 @@ def make_demo_session(
     # chunked prefill serves prompts beyond the largest bucket, so callers
     # exercising it can ask for more position room than the bucket default
     max_len = session_kw.pop("max_len", None) or max(buckets) + max_new
+    mesh = None
+    if tp and int(tp) > 1:
+        from paddle_tpu.parallel.rules import make_tp_mesh
+
+        mesh = make_tp_mesh(int(tp))
     model = ServableLM(LMConfig(
         vocab=vocab, n_layers=n_layers, d_model=d_model, n_heads=n_heads,
         max_len=max_len,
-    ))
+    ), mesh=mesh)
     params = model.init_params(jax.random.PRNGKey(seed))
     return ServingSession(
         model, params, prefill_buckets=buckets, max_new_limit=max_new,

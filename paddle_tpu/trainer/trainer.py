@@ -1130,7 +1130,8 @@ class SGDTrainer:
         old = self.parallel
         new_mesh = resize_mesh(old.mesh, old.batch_axis, world, devices)
         new_parallel = DataParallel(
-            new_mesh, batch_axis=old.batch_axis, param_attrs=old.param_attrs
+            new_mesh, batch_axis=old.batch_axis, param_attrs=old.param_attrs,
+            rules=old.rules,
         )
         # A resized process must never again execute a persistent-cache-
         # DESERIALIZED multi-device program: the re-shard's eager programs

@@ -108,3 +108,120 @@ def test_sharded_gathers_stay_bounded():
     got = _counts(_compiled_step_hlo(True))
     n_params = 6
     assert 0 < got["all-gather"] <= n_params, got
+
+
+# -- tensor-parallel serving decode (ISSUE 12) --------------------------------
+#
+# The TP decode step's collective budget is FIXED by construction: one
+# all-reduce for the vocab-sharded embed gather, one all-reduce per
+# row-parallel projection (wo and w2 — two per layer), and one all-gather
+# replicating the logits at the unembed output so sampling (greedy argmax
+# AND the gumbel branch) runs with ZERO collectives. A stray resharding
+# boundary — an activation left sharded, a constraint dropped, a sampling
+# op crossing the vocab shards — changes these counts and fails loudly.
+# Compile-only (.lower().compile(), never executed), so the persistent-cache
+# multi-device execution gotcha does not apply.
+
+N_LAYERS_TP = 2
+
+
+def _compiled_tp_decode_hlo(tp: int, max_slots: int = 4,
+                            n_layers: int = N_LAYERS_TP) -> str:
+    import jax.numpy as jnp
+    import numpy as np_
+
+    from paddle_tpu.parallel.rules import make_tp_mesh
+    from paddle_tpu.serving.model import LMConfig, ServableLM
+
+    mesh = make_tp_mesh(tp) if tp > 1 else None
+    model = ServableLM(
+        LMConfig(vocab=64, n_layers=n_layers, d_model=32, n_heads=4,
+                 max_len=64),
+        mesh=mesh,
+    )
+    params = model.shard_params(model.init_params(jax.random.PRNGKey(0)))
+    shape = (n_layers, 9, 8, 32)
+    if mesh is not None:
+        k_pages = jax.jit(
+            lambda: jnp.zeros(shape), out_shardings=model.pool_sharding()
+        )()
+    else:
+        k_pages = jnp.zeros(shape)
+    s = max_slots
+    args = (
+        params, k_pages, k_pages,
+        np_.zeros(s, np_.int32), np_.zeros(s, np_.int32), np_.ones(s, bool),
+        np_.zeros((s, 8), np_.int32), np_.zeros(s, np_.uint32),
+        np_.zeros(s, np_.int32), np_.zeros(s, np_.float32),
+        np_.zeros(s, np_.int32),
+    )
+    return jax.jit(model.decode_step).lower(*args).compile().as_text()
+
+
+# 1 embed all-reduce + 2 row-parallel all-reduces per layer; 1 logits
+# all-gather. Measured on the container's jax 0.4.37 CPU partitioner.
+TP_DECODE_PINNED = {
+    "all-reduce": 1 + 2 * N_LAYERS_TP,
+    "reduce-scatter": 0,
+    "all-gather": 1,
+    "collective-permute": 0,
+    "all-to-all": 0,
+}
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_decode_collective_counts_pinned(tp):
+    got = _counts(_compiled_tp_decode_hlo(tp))
+    assert got == TP_DECODE_PINNED, (
+        f"TP={tp} decode step now emits {got} (pinned {TP_DECODE_PINNED}) — "
+        "a resharding boundary moved. Expected: one embed all-reduce, one "
+        "all-reduce per row-parallel projection (wo, w2), one logits "
+        "all-gather, nothing in sampling; see serving/model.py _constrain "
+        "sites before re-pinning"
+    )
+
+
+def test_tp_decode_collectives_do_not_scale_with_slots():
+    """Slots are data, not shape — and not collectives either: doubling
+    max_slots must not add a single collective op."""
+    assert (_counts(_compiled_tp_decode_hlo(2, max_slots=8))
+            == _counts(_compiled_tp_decode_hlo(2, max_slots=4)))
+
+
+def test_tp_decode_collectives_scale_only_with_layers():
+    """+1 layer = +2 all-reduces (its wo and w2), nothing else — the
+    per-layer budget the ISSUE names, directly."""
+    base = _counts(_compiled_tp_decode_hlo(2))
+    plus = _counts(_compiled_tp_decode_hlo(2, n_layers=N_LAYERS_TP + 1))
+    assert plus["all-reduce"] == base["all-reduce"] + 2
+    assert plus["all-gather"] == base["all-gather"]
+
+
+def test_tp_single_chip_decode_has_no_collectives():
+    """tp=1 must compile the PR-11 single-chip program: zero collectives,
+    zero partitioning artifacts — TP support is free when unused."""
+    got = _counts(_compiled_tp_decode_hlo(1))
+    assert all(v == 0 for v in got.values()), got
+
+
+def test_tp_sampling_branch_is_collective_free():
+    """The sampling math ALONE (greedy argmax + the gumbel/top-k branch) on
+    replicated logits under the TP mesh: zero collectives — the all-gather
+    pinned above belongs to the unembed output, not to sampling."""
+    import numpy as np_
+
+    from paddle_tpu.parallel.rules import make_tp_mesh
+    from paddle_tpu.serving.model import LMConfig, ServableLM
+
+    model = ServableLM(
+        LMConfig(vocab=64, n_layers=1, d_model=32, n_heads=4, max_len=64),
+        mesh=make_tp_mesh(2),
+    )
+    s = 4
+    txt = jax.jit(model._sample).lower(
+        np_.zeros((s, 64), np_.float32), np_.zeros(s, np_.uint32),
+        np_.zeros(s, np_.int32), np_.ones(s, np_.float32),
+        np_.full(s, 8, np_.int32),
+    ).compile().as_text()
+    got = _counts(txt)
+    assert all(v == 0 for v in got.values()), got

@@ -281,6 +281,62 @@ def test_sanctioned_clock_sites_stay_rare():
         )
 
 
+# -- TP dispatch seam (ISSUE 12 tensor-parallel serving) ----------------------
+#
+# Under TP the decode step's inputs split two ways: params + KV pool live
+# SHARDED on the mesh (placed once at session init / crash re-init), block
+# tables + per-slot lanes stay REPLICATED host state that the jit dispatch
+# transfers as step data. A host-side jax.device_put / jnp.asarray of the
+# block table inside the engine loop would re-place (and under TP, reshard)
+# it EVERY step — exactly the per-step transfer discipline the sync-ok lint
+# exists for, now applied to placements. The sanctioned sites (per-ADMISSION
+# placement of one request's commit operands, never per-step) carry `tp-ok`
+# tags with the count pinned below.
+
+PUT_CALL = re.compile(
+    r"(?<![\w.])jax\.device_put\(|(?<![\w.])device_put\(|"
+    r"(?<![\w.])jnp\.asarray\(|(?<![\w.])jnp\.array\(|"
+    r"make_array_from_process_local_data\("
+)
+PUT_TAG = "tp-ok"
+# (file, class, engine-loop methods, max tp-ok tags)
+PUT_HOT_LOOPS = [
+    (SERVING_PY, "ServingSession",
+     ("step", "_admit", "_prefill_chunks", "_decode_once"), 1),
+]
+
+
+def test_no_untagged_host_placement_in_serving_loops():
+    """Host→device placements in the serving engine loop must be tagged:
+    the block table and per-slot lanes ride the jit dispatch as replicated
+    step data (one transfer, no explicit put), so an untagged device_put /
+    jnp.asarray here is a per-step placement — under TP, a per-step
+    RESHARD of host state."""
+    violations = []
+    for path, cls, methods, _budget in PUT_HOT_LOOPS:
+        v, _ = _scan(path, cls, methods, PUT_CALL, tag=PUT_TAG)
+        violations += v
+    assert not violations, (
+        "host->device placement in a serving engine-loop body without a "
+        "`tp-ok` tag — pass host arrays straight to the jitted call (the "
+        "dispatch owns the one transfer) or tag a genuinely per-admission "
+        "site with `# tp-ok: <why>`:\n  " + "\n  ".join(violations)
+    )
+
+
+def test_sanctioned_placement_sites_stay_rare():
+    """tp-ok is a justification, not a loophole: the count is pinned so a
+    new placement site in the engine loop forces a review here."""
+    for path, cls, methods, budget in PUT_HOT_LOOPS:
+        _, tagged = _scan(path, cls, methods, PUT_CALL, tag=PUT_TAG)
+        assert len(tagged) <= budget, (
+            f"{len(tagged)} tp-ok tags in the {cls} engine loop (expected "
+            f"<= {budget}): a new sanctioned placement site was added — "
+            "confirm it is per-admission (not per-step) and bump this "
+            "bound deliberately"
+        )
+
+
 def test_no_file_io_in_hot_loops():
     """No open()/.write()/json.dump in any hot-loop body, tagged or not —
     span export and metric scraping happen OUTSIDE the loops (export_chrome,
