@@ -10,7 +10,7 @@ Usage:
         [--num_passes=N] [--save_dir=DIR] [--trainer_count=N] [--use_tpu=1]
         [--init_model_path=DIR] [--start_pass=N] [--log_period=N] [--job=train|test|time]
         [--auto_resume=1] [--divergence_policy=skip_batch|rollback|raise]
-        [--shard_update=1] [--grad_compression=none|bf16|int8]
+        [--shard_update=zero1|zero2|zero3] [--grad_compression=none|bf16|int8]
         [--precision=f32|bf16] [--remat=none|dots|conv_only|full]
         [--guard_check_every=N] [--steps_per_dispatch=K] [--async_checkpoint=0|1]
         [--keep_last_n=N] [--faults=SPEC]
@@ -41,6 +41,22 @@ from paddle_tpu import proto
 
 def _str2bool(v: str) -> bool:
     return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def _shard_update_mode(v: str):
+    """--shard_update value: bools stay the zero1 alias (back-compat),
+    zero1/zero2/zero3 name the ZeRO mode explicitly."""
+    s = str(v).strip().lower()
+    if s in ("zero1", "zero2", "zero3"):
+        return s
+    if s in ("1", "true", "yes", "on"):
+        return "zero1"
+    if s in ("0", "false", "no", "off", "none", ""):
+        return False
+    raise argparse.ArgumentTypeError(
+        f"--shard_update must be a boolean or one of zero1/zero2/zero3, "
+        f"got {v!r}"
+    )
 
 
 def _train_args(p: argparse.ArgumentParser) -> None:
@@ -98,11 +114,17 @@ def _train_args(p: argparse.ArgumentParser) -> None:
              "batch. 1 = one dispatch per batch",
     )
     p.add_argument(
-        "--shard_update", type=_str2bool, default=False,
-        help="ZeRO-1-style sharded weight update over the mesh data axis: "
-             "reduce-scatter grads, shard-local optimizer step on 1/N of "
-             "the optimizer state (resident sharded — ~N x less opt-state "
-             "HBM per chip), all-gather updated params. Needs "
+        "--shard_update", type=_shard_update_mode, default=False,
+        help="ZeRO-sharded weight update over the mesh data axis. "
+             "zero1 (or 1/true, the back-compat alias): reduce-scatter "
+             "grads, shard-local optimizer step on 1/N of the optimizer "
+             "state (resident sharded — ~N x less opt-state HBM per chip), "
+             "all-gather updated params. zero2: zero1 fused across the "
+             "--steps_per_dispatch window — one scatter/gather per dispatch "
+             "(~K x fewer grad-leg bytes; gradient-accumulation semantics). "
+             "zero3: params themselves live data-axis-sharded (~N x less "
+             "param HBM per chip), gathered layer-by-layer on demand inside "
+             "the step and re-gathered in the backward. Needs "
              "--trainer_count > 1 to matter",
     )
     p.add_argument(
@@ -111,8 +133,10 @@ def _train_args(p: argparse.ArgumentParser) -> None:
         help="quantize the sharded update's collective payloads: bf16 "
              "halves both legs (~2x fewer collective bytes/step); int8 "
              "block-scales the gradient leg with an error-feedback "
-             "residual in the train state (~2.7x total). Requires "
-             "--shard_update=1",
+             "residual in the train state (~2.7x total); under "
+             "--shard_update=zero3 int8 instead quantizes INSIDE the "
+             "on-demand param all-gather (the hot leg there, ~3.75x) with "
+             "a master-tracking EF residual. Requires --shard_update",
     )
     p.add_argument(
         "--guard_check_every", type=int, default=16,

@@ -3,8 +3,9 @@ pserver path it exercises, SURVEY §2.5 sparse/EP row).
 
 Wide part: multi-hot sparse feature vector through a linear projection (the
 reference's sparse_binary_vector → fc). Deep part: per-slot categorical ids
-through embeddings (the row-sharded pserver tables; shard over the mesh
-'expert' axis via ParamAttr(sharding=...) for the EP-parity path) → MLP.
+through embeddings (the row-sharded pserver tables; declare the "expert"
+LOGICAL axis via ParamAttr(logical_axes=...) and the rules table decides
+which mesh axis — if any — it shards over, the EP-parity path) → MLP.
 Output: sigmoid CTR estimate, soft binary cross-entropy loss."""
 
 from __future__ import annotations
@@ -24,8 +25,11 @@ def ctr_wide_deep(
     embedding_sharding: Optional[Tuple] = None,
 ):
     """Returns (inputs, label, prediction, cost). inputs = [wide_input,
-    slot0_ids, slot1_ids, ...]. embedding_sharding e.g. ("expert", None)
-    shards every deep table row-wise over the mesh."""
+    slot0_ids, slot1_ids, ...]. embedding_sharding is a LOGICAL-axes tuple,
+    e.g. ("expert", None): every deep table's rows declare the "expert"
+    logical axis, and the deployment's rules table (parallel/rules.py)
+    decides whether that shards them (an "expert"-axis mesh) or replicates
+    (the data-only CPU mesh) — no mesh-axis names in model code."""
     wide_in = L.Data("wide_features", shape=(wide_dim,))
     slot_ids = [
         L.Data(f"slot{i}_id", shape=()) for i in range(len(slot_vocab_sizes))
@@ -39,7 +43,7 @@ def ctr_wide_deep(
     embeds = []
     for i, (ids, vocab) in enumerate(zip(slot_ids, slot_vocab_sizes)):
         attr = (
-            ParamAttr(sharding=embedding_sharding)
+            ParamAttr(logical_axes=tuple(embedding_sharding))
             if embedding_sharding is not None
             else None
         )

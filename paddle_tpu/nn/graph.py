@@ -158,6 +158,7 @@ class Context:
         rng: Optional[Array],
         train: bool,
         policy: Optional[dtypes.Policy] = None,
+        param_resolver: Optional[Callable[[str, Array], Array]] = None,
     ):
         assert mode in ("init", "apply")
         self.mode = mode
@@ -166,6 +167,14 @@ class Context:
         self.rng = rng
         self.train = train
         self.policy = policy or dtypes.current()
+        # ZeRO-3 on-demand gather seam (ISSUE 14): in apply mode, a resolver
+        # rebuilds a stored parameter's full view AT ITS POINT OF USE — the
+        # Zero3Updater passes the all-gather of its flat data-axis-sharded
+        # leaf, so each layer's gather is emitted next to its consumer in
+        # the trace (layer-by-layer, not hoisted as one bulk gather) and the
+        # backward's remat re-gathers per use. Memoized per trace below so a
+        # SHARED parameter gathers once. None = params are stored full.
+        self.param_resolver = param_resolver
         self.state_updates: Dict[str, Array] = {}
         self.param_attrs: Dict[str, ParamAttr] = {}
         self._rng_count = 0
@@ -235,6 +244,11 @@ class Context:
                         f"shared parameter {full!r} shape mismatch: {got} vs {tuple(shape)}"
                     )
         value = self.params[full]
+        if self.mode == "apply" and self.param_resolver is not None:
+            key = ("__param_resolved__", full)
+            if key not in self.cache:
+                self.cache[key] = self.param_resolver(full, value)
+            value = self.cache[key]
         return value
 
     # -- state (non-trainable, updated functionally) ------------------------
@@ -412,14 +426,23 @@ class Network:
         train: bool = False,
         rng: Optional[Array] = None,
         policy: Optional[dtypes.Policy] = None,
+        param_resolver: Optional[Callable[[str, Array], Array]] = None,
     ) -> Tuple[Dict[str, Argument], Dict[str, Array]]:
         """Pure forward. Returns ({output_layer_name: Argument}, new_states).
 
         Like init(), the trace is wrapped in a policy_scope so every nested
-        dtypes.current() fallback resolves to this trace's policy."""
+        dtypes.current() fallback resolves to this trace's policy.
+
+        `param_resolver(name, stored_value)` rebuilds a parameter's full
+        view at its point of use (Context.param) — the ZeRO-3 on-demand
+        gather seam; None (default) means `params` already hold full
+        values."""
         policy = policy or dtypes.current()
         with dtypes.policy_scope(policy):
-            ctx = Context("apply", params, states, rng, train, policy=policy)
+            ctx = Context(
+                "apply", params, states, rng, train, policy=policy,
+                param_resolver=param_resolver,
+            )
             values = self._run(ctx, batch)
         new_states = dict(states)
         new_states.update(ctx.state_updates)

@@ -20,5 +20,7 @@ from paddle_tpu.parallel.updaters import (  # noqa: F401
     ParameterUpdater,
     SgdLocalUpdater,
     ShardedUpdater,
+    Zero2Updater,
+    Zero3Updater,
 )
 from paddle_tpu.parallel import compression as compression  # noqa: F401

@@ -1,9 +1,10 @@
-"""Compressed gradient collectives for the sharded (ZeRO-1) update.
+"""Compressed gradient collectives for the sharded (ZeRO-1/2/3) update.
 
 "EQuARX: Efficient Quantized AllReduce in XLA" (PAPERS.md) shows the
 gradient all-reduce can run quantized at near-zero quality cost. Here the
-all-reduce is already decomposed by the ShardedUpdater into its two phases —
-reduce-scatter of gradients, all-gather of updated parameters — and each
+all-reduce is already decomposed by the ShardedUpdater into its phases —
+reduce-scatter of gradients, all-gather of updated parameters (ZeRO-1/2) or
+on-demand all-gather of resident-sharded parameters (ZeRO-3) — and each
 phase's payload is quantized just before it crosses the collective boundary
 (the `with_sharding_constraint` resharding point) and dequantized just after:
 
@@ -20,6 +21,20 @@ The gather leg of a compressed mode transports the parameter DELTA
 the dequantized increment, so master weights never round-trip through the
 narrow dtype. The `none` mode gathers the updated parameter itself, which is
 what keeps that path bitwise-identical to the replicated updater.
+
+ZeRO-3 (the Zero3Updater) swaps the legs' roles: parameters live sharded and
+the hot leg is the on-demand PARAM all-gather inside the forward (plus its
+remat re-gather in the backward). That leg quantizes symmetrically INSIDE
+the collective (the EQuARX all-gather case): each shard encodes its OWN rows
+before the gather and every chip decodes the identical payload after, so the
+decode is deterministic and SPMD-consistent — under int8 with a per-master
+error-feedback residual (`encode_param_gather`), carried in opt_state["ef"]
+just like the scatter EF, so the forward's quantized view chases the exact
+f32 master instead of drifting. The ZeRO-3 grad leg needs no explicit
+encode: the gather's autodiff transpose delivers cotangents already in the
+flat [n, chunk] layout and the updater crosses them via
+encode_z3_scatter/decode (bf16 for the compressed modes — grad EF is a
+ZeRO-1/2 feature; under ZeRO-3 the residual budget belongs to the params).
 
 Realization note (honest accounting): the quantize runs inside the jit
 global-view program, so what XLA materializes on the wire depends on its
@@ -53,6 +68,15 @@ class GradCompression:
     chunk_align = 1
     scatter_itemsize = 4.0  # effective bytes/element at the scatter boundary
     gather_itemsize = 4.0
+    # ZeRO-3 legs: the on-demand param all-gather (forward + remat re-gather)
+    # and the cotangent crossing at the updater's scatter constraint
+    param_gather_itemsize = 4.0
+    z3_scatter_itemsize = 4.0
+    # dtype labels for the per-leg collective-bytes detail (observability)
+    scatter_dtype = "f32"
+    gather_dtype = "f32"
+    param_gather_dtype = "f32"
+    z3_scatter_dtype = "f32"
 
     # -- scatter leg (gradients) ----------------------------------------
     # encode_scatter returns (payload, new_ef) where payload is a TUPLE of
@@ -77,11 +101,43 @@ class GradCompression:
         """Gathered payload (+ full pre-update flat param) → new flat param."""
         return payload
 
+    # -- ZeRO-3 param-gather leg (quantize-inside-all-gather) ------------
+    # Each shard encodes its OWN [n, chunk] rows (only the owned row is
+    # real data under the P(data) layout); the payload tuple crosses the
+    # all-gather (the wsc-to-replicated point in Zero3Updater.materialize)
+    # and every chip decodes identically — symmetric quantization inside
+    # the collective, exact in the sense that all chips compute the same
+    # dequantized view. `ef` is the per-master error-feedback residual
+    # (int8 only): encode returns (payload, new_ef); the updater persists
+    # new_ef in opt_state["ef"] at apply time by recomputing this encode
+    # on the pre-update params (deterministic, collective-free).
+    def encode_param_gather(self, p2, ef) -> Tuple[Tuple[Any, ...], Any]:
+        return (p2,), None
+
+    def decode_param_gather(self, payload: Tuple[Any, ...]):
+        return payload[0]
+
+    # -- ZeRO-3 grad leg -------------------------------------------------
+    # The gather transpose hands the updater cotangents already in the
+    # flat [n, chunk] layout; they cross the scatter constraint encoded
+    # here (no error feedback — see module docstring).
+    def encode_z3_scatter(self, g2):
+        return g2
+
+    def decode_z3_scatter(self, payload):
+        return payload
+
 
 class Bf16Compression(GradCompression):
     name = "bf16"
     scatter_itemsize = 2.0
     gather_itemsize = 2.0
+    param_gather_itemsize = 2.0
+    z3_scatter_itemsize = 2.0
+    scatter_dtype = "bf16"
+    gather_dtype = "bf16"
+    param_gather_dtype = "bf16"
+    z3_scatter_dtype = "bf16"
 
     def encode_scatter(self, g2, ef):
         return (g2.astype(jnp.bfloat16),), None
@@ -94,6 +150,20 @@ class Bf16Compression(GradCompression):
 
     def decode_gather(self, payload, p_full2):
         return p_full2 + payload.astype(jnp.float32)
+
+    def encode_param_gather(self, p2, ef):
+        # params cross the on-demand gather in bf16: the forward computes on
+        # the rounded view, the f32 master stays exact on the owning shard
+        return (p2.astype(jnp.bfloat16),), None
+
+    def decode_param_gather(self, payload):
+        return payload[0].astype(jnp.float32)
+
+    def encode_z3_scatter(self, g2):
+        return g2.astype(jnp.bfloat16)
+
+    def decode_z3_scatter(self, payload):
+        return payload.astype(jnp.float32)
 
 
 def _block_quantize(x2):
@@ -114,13 +184,21 @@ def _block_dequantize(q, scale):
 
 
 class Int8Compression(GradCompression):
-    """Block-scaled int8 gradients with error feedback; bf16 delta gather."""
+    """Block-scaled int8 gradients with error feedback; bf16 delta gather.
+    Under ZeRO-3 the int8 + EF budget moves to the param-gather leg (the hot
+    one there) and the cotangent crossing runs bf16."""
 
     name = "int8"
     uses_error_feedback = True
     chunk_align = BLOCK
     scatter_itemsize = 1.0 + 4.0 / BLOCK  # int8 payload + f32 scale per block
     gather_itemsize = 2.0
+    param_gather_itemsize = 1.0 + 4.0 / BLOCK
+    z3_scatter_itemsize = 2.0
+    scatter_dtype = "int8+f32scale"
+    gather_dtype = "bf16"
+    param_gather_dtype = "int8+f32scale"
+    z3_scatter_dtype = "bf16"
 
     def encode_scatter(self, g2, ef):
         corrected = g2 if ef is None else g2 + ef
@@ -139,6 +217,26 @@ class Int8Compression(GradCompression):
 
     def decode_gather(self, payload, p_full2):
         return p_full2 + payload.astype(jnp.float32)
+
+    def encode_param_gather(self, p2, ef):
+        # EQuARX-style quantize-inside-all-gather with error feedback on the
+        # MASTER: the forward sees dequant(quant(p + ef)); the residual of
+        # that quantization is re-injected next step, so the quantized view
+        # tracks the exact f32 master instead of accumulating drift
+        corrected = p2 if ef is None else p2 + ef
+        q, scale = _block_quantize(corrected)
+        new_ef = corrected - _block_dequantize(q, scale)
+        return (q, scale), new_ef
+
+    def decode_param_gather(self, payload):
+        q, scale = payload
+        return _block_dequantize(q, scale)
+
+    def encode_z3_scatter(self, g2):
+        return g2.astype(jnp.bfloat16)
+
+    def decode_z3_scatter(self, payload):
+        return payload.astype(jnp.float32)
 
 
 def make(mode: Optional[str]) -> GradCompression:

@@ -67,6 +67,10 @@ class DataParallel:
         axes = attr.logical_axes
         if axes is None:
             axes = attr.sharding
+            if axes is not None:
+                from paddle_tpu.parallel.rules import warn_legacy_sharding
+
+                warn_legacy_sharding(name)  # once per process
         if axes is None:
             return self._replicated
         return self.rules.sharding_for(self.mesh, axes, ndim=ndim, param=name)
@@ -201,7 +205,7 @@ class DataParallel:
         )
 
     def shard_state(
-        self, state: Dict[str, Any], opt_sharding=None
+        self, state: Dict[str, Any], opt_sharding=None, param_sharding=None
     ) -> Dict[str, Any]:
         """Place a train state on the mesh. `opt_sharding(param_name, leaf)`
         (from ParameterUpdater.opt_leaf_sharding) overrides the placement of
@@ -209,9 +213,16 @@ class DataParallel:
         data-axis sharding for flat leaves so they go STRAIGHT to their 1/n
         resident placement (a replicated intermediate would momentarily cost
         the full optimizer state per chip at init/resume, exactly the peak
-        shard_update exists to avoid)."""
+        shard_update exists to avoid). `param_sharding(param_name, leaf)`
+        (from ParameterUpdater.param_leaf_sharding) does the same for
+        PARAMETER and model-average leaves — the ZeRO-3 updater's flat
+        params land 1/n-resident directly too."""
         params = {
-            k: jax.device_put(v, self.param_sharding(k, v.ndim))
+            k: jax.device_put(
+                v,
+                (param_sharding and param_sharding(k, v))
+                or self.param_sharding(k, v.ndim),
+            )
             for k, v in state["params"].items()
         }
         # optimizer slots follow their parameter's sharding unless the
@@ -242,11 +253,26 @@ class DataParallel:
                 )
                 for k, e in opt["ef"].items()
             }
-        rest = {
-            k: jax.tree.map(lambda v: jax.device_put(v, self._replicated), state[k])
-            for k in state
-            if k not in ("params", "opt")
-        }
+        rest = {}
+        for k in state:
+            if k in ("params", "opt"):
+                continue
+            if k == "avg" and state[k] and param_sharding is not None:
+                # model-average leaves mirror the param layout: under ZeRO-3
+                # the flat averages go straight to their sharded residency
+                avg = dict(state[k])
+                avg["avg"] = {
+                    name: jax.device_put(
+                        v, param_sharding(name, v) or self._replicated
+                    )
+                    for name, v in avg["avg"].items()
+                }
+                avg["n"] = jax.device_put(avg["n"], self._replicated)
+                rest[k] = avg
+                continue
+            rest[k] = jax.tree.map(
+                lambda v: jax.device_put(v, self._replicated), state[k]
+            )
         return {"params": params, "opt": opt, **rest}
 
     # -- hooks used inside the traced step ----------------------------------

@@ -38,12 +38,35 @@ day the mesh grows a "pipe" axis the mapping is one line here."""
 
 from __future__ import annotations
 
+import warnings
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.parallel.mesh import AXES, make_mesh
+
+# the legacy ParamAttr(sharding=...) shim warns EXACTLY once per process —
+# per-call warnings would spam every step trace of a legacy model, and
+# python's default "once" filter dedups per call SITE, not per process
+_legacy_sharding_warned = False
+
+
+def warn_legacy_sharding(param: str) -> None:
+    """One DeprecationWarning per process for raw mesh-axis ParamAttr.sharding
+    tuples (they still resolve through the rules table's identity shim)."""
+    global _legacy_sharding_warned
+    if _legacy_sharding_warned:
+        return
+    _legacy_sharding_warned = True
+    warnings.warn(
+        f"ParamAttr(sharding=...) mesh-axis tuples are deprecated (first "
+        f"seen on {param!r}): declare ParamAttr(logical_axes=...) and let "
+        f"the rules table (parallel/rules.py DEFAULT_RULES) map logical "
+        f"axes to mesh axes",
+        DeprecationWarning,
+        stacklevel=3,
+    )
 
 # the one serving+training sharding vocabulary (SNIPPETS.md DEFAULT_RULES
 # pattern). Values are mesh axis names or None (replicated).

@@ -13,6 +13,7 @@ point for custom update policies."""
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -54,6 +55,11 @@ class ParameterUpdater:
     # trainer goes through this seam for init, checkpoint save/load and mesh
     # placement so both layouts round-trip through the same checkpoints.
 
+    # ZeRO mode tag: None for the replicated updaters, "zero1"/"zero2"/
+    # "zero3" on the ShardedUpdater family — the trainer dispatches its
+    # multi-step fusion (zero2) and state layout (zero3) on this
+    mode: Optional[str] = None
+
     def init_opt_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
         return self.optimizer.init_state(params)
 
@@ -65,6 +71,28 @@ class ParameterUpdater:
     def from_canonical(self, opt_canonical: Dict[str, Any]) -> Dict[str, Any]:
         return opt_canonical
 
+    # -- parameter-layout seam (ZeRO-3) --------------------------------------
+    # Mirrors the opt-state seam above: the Zero3Updater stores PARAMETERS in
+    # the flat data-axis-sharded layout too, so checkpoints/resizes cross
+    # through the canonical per-param layout exactly like optimizer slots.
+    # Identity for every other updater.
+
+    def params_to_canonical(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        return params
+
+    def params_from_canonical(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        return params
+
+    def param_resolver(self, opt_state=None) -> Optional[Callable]:
+        """Optional `(name, stored_leaf) -> full_view` resolver threaded
+        through Network.apply (Context.param), built INSIDE the compiled
+        step. None by default (params are stored full); the Zero3Updater
+        returns the on-demand all-gather of its resident-sharded flat
+        leaves, so each parameter is gathered layer-by-layer AT ITS POINT
+        OF USE — and the gather's autodiff transpose delivers
+        already-scattered gradients to apply."""
+        return None
+
     def opt_leaf_sharding(self, name: str, leaf) -> Optional[Any]:
         """Placement override for one optimizer slot/EF leaf of param `name`,
         consulted by DataParallel.shard_state. None = default rule (follow
@@ -73,12 +101,28 @@ class ParameterUpdater:
         DIRECTLY — never through a full-size replicated intermediate."""
         return None
 
-    def collective_bytes_per_step(self) -> int:
+    def param_leaf_sharding(self, name: str, leaf) -> Optional[Any]:
+        """Same override for PARAMETER (and model-average) leaves — non-None
+        only on the Zero3Updater, whose params live flat-sharded."""
+        return None
+
+    def collective_bytes_per_step(self, steps_per_dispatch: int = 1) -> int:
         """Modeled bytes/chip crossing collectives per train step for the
         parameter update + gradient reduction (ring convention: an all-reduce
         of M bytes moves 2*M*(n-1)/n per chip; each decomposed phase moves
-        M*(n-1)/n). 0 for single-replica updaters."""
+        M*(n-1)/n). `steps_per_dispatch` amortizes per-dispatch collectives
+        (the zero2 fused update) back to per-step units. 0 for
+        single-replica updaters."""
         return 0
+
+    def collective_bytes_detail(
+        self, steps_per_dispatch: int = 1
+    ) -> Dict[str, Any]:
+        """Per-leg breakdown of collective_bytes_per_step: {"mode": ...,
+        "per_leg": {leg: {"dtype": ..., "bytes_per_step": ...}}} — the
+        scatter/gather × zero-mode × dtype accounting surfaced in EndPass
+        metrics and shard_update_bench. {} for single-replica updaters."""
+        return {}
 
     def rebind(self, parallel, params: Dict[str, Any]) -> "ParameterUpdater":
         """Elastic-resize seam: a NEW updater of this kind bound to a
@@ -138,12 +182,27 @@ class IciAllReduceUpdater(SgdLocalUpdater):
             if not (self.optimizer.param_attrs.get(k) or ParamAttr()).is_static
         )
 
-    def collective_bytes_per_step(self) -> int:
+    def collective_bytes_per_step(self, steps_per_dispatch: int = 1) -> int:
         n = self.parallel.mesh.shape[self.parallel.batch_axis]
         if n <= 1:
             return 0
-        # full-precision grad all-reduce: 2*M*(n-1)/n bytes per chip
+        # full-precision grad all-reduce: 2*M*(n-1)/n bytes per chip; one
+        # per STEP regardless of dispatch fusion (the scan body reduces
+        # every iteration)
         return int(2 * getattr(self, "_grad_bytes", 0) * (n - 1) / n)
+
+    def collective_bytes_detail(
+        self, steps_per_dispatch: int = 1
+    ) -> Dict[str, Any]:
+        total = self.collective_bytes_per_step(steps_per_dispatch)
+        if not total:
+            return {}
+        return {
+            "mode": "replicated",
+            "per_leg": {
+                "all_reduce": {"dtype": "grad", "bytes_per_step": total},
+            },
+        }
 
     def rebind(self, parallel, params: Dict[str, Any]) -> "IciAllReduceUpdater":
         new = type(self)(self.optimizer, parallel)
@@ -210,6 +269,8 @@ class ShardedUpdater(IciAllReduceUpdater):
     XLA freely FMA-contracts the scale multiplies, so arbitrary lr agrees
     to 1-2 ULP) and matches Adam to tight tolerance."""
 
+    mode = "zero1"
+
     def __init__(self, optimizer: Optimizer, parallel, compression: str = "none"):
         super().__init__(optimizer, parallel)
         self.compression = compression_mod.make(compression)
@@ -223,11 +284,25 @@ class ShardedUpdater(IciAllReduceUpdater):
     def _param_geom(self, k: str, p) -> _FlatGeom:
         attr = self.optimizer.param_attrs.get(k) or ParamAttr()
         size = int(np.prod(p.shape)) if p.shape else 1
-        flat = not attr.is_static and attr.sharding is None
+        flat = not attr.is_static and self._resolves_replicated(k, attr, p)
         align = self.compression.chunk_align
         chunk = -(-size // self.n)
         chunk = -(-chunk // align) * align
         return _FlatGeom(tuple(p.shape), size, chunk, flat)
+
+    def _resolves_replicated(self, k: str, attr: ParamAttr, p) -> bool:
+        """Whether this param's declared axes resolve to REPLICATED on this
+        mesh — resolved through the rules table, not by tuple presence, so a
+        model declaring TP logical axes ("heads": "model") still gets the
+        flat ZeRO treatment on a data-only mesh (where those axes do not
+        bite) and keeps its canonical TP layout on a dp x tp mesh."""
+        axes = attr.logical_axes if attr.logical_axes is not None else attr.sharding
+        if axes is None:
+            return True
+        spec = self.parallel.rules.spec_for(
+            axes, self.parallel.mesh, ndim=len(p.shape), param=k
+        )
+        return all(a is None for a in spec)
 
     def bind_geometry(self, params: Dict[str, Any]) -> None:
         """Derive the flat-shard geometry for `params` without allocating any
@@ -339,6 +414,7 @@ class ShardedUpdater(IciAllReduceUpdater):
                 if nef is not None:
                     new_ef[k] = nef
             widths = [[arr.shape[1] for arr in p] for p in payloads]
+            # reshard-ok: THE grad reduce-scatter boundary (one per step)
             cat = tuple(
                 wsc(jnp.concatenate(arrs, axis=1), self._shard)
                 for arrs in zip(*payloads)
@@ -355,12 +431,14 @@ class ShardedUpdater(IciAllReduceUpdater):
                 for j in range(len(cat)):
                     offs[j] += widths[i][j]
                 g2 = comp.decode_scatter(payload)
+                # reshard-ok: placement pin of the local shard view
                 p2 = wsc(_to_flat(params[k], self.n, geom.chunk), self._shard)
                 np2, new_slots[k] = opt.update_one(
                     k, g2, opt_state["slots"][k], p2, lr
                 )
                 gathers.append(comp.encode_gather(np2, p2))
             # 3) one all-gather of the concatenated updated shards
+            # reshard-ok: THE updated-param all-gather (one per step)
             gat = wsc(jnp.concatenate(gathers, axis=1), self._rep)
             off = 0
             for i, k in enumerate(flat_keys):
@@ -377,18 +455,280 @@ class ShardedUpdater(IciAllReduceUpdater):
             new_opt["ef"] = new_ef
         return new_params, new_opt
 
-    def collective_bytes_per_step(self) -> int:
+    # -- collective-bytes model (ring convention, per-leg) --------------------
+    def _flat_payload_elems(self) -> int:
+        return sum(self.n * g.chunk for g in self._geom.values() if g.flat)
+
+    def _leg_bytes(self, itemsize: float, per_dispatch_of: int = 1) -> int:
+        """One decomposed phase: payload * (n-1)/n bytes/chip, amortized to
+        per-step units when the leg runs once per `per_dispatch_of` steps."""
         if self.n <= 1:
             return 0
         ring = (self.n - 1) / self.n
-        total = 0.0
-        for k, g in self._geom.items():
-            if not g.flat:
-                continue
-            padded = self.n * g.chunk
-            total += padded * self.compression.scatter_itemsize * ring
-            total += padded * self.compression.gather_itemsize * ring
-        return int(total)
+        return int(
+            self._flat_payload_elems() * itemsize * ring
+            / max(per_dispatch_of, 1)
+        )
+
+    def collective_bytes_detail(
+        self, steps_per_dispatch: int = 1
+    ) -> Dict[str, Any]:
+        """zero1: one grad reduce-scatter + one updated-param all-gather per
+        STEP, regardless of dispatch fusion (the scan body repeats both)."""
+        comp = self.compression
+        return {
+            "mode": self.mode,
+            "per_leg": {
+                "scatter": {
+                    "dtype": comp.scatter_dtype,
+                    "bytes_per_step": self._leg_bytes(comp.scatter_itemsize),
+                },
+                "gather": {
+                    "dtype": comp.gather_dtype,
+                    "bytes_per_step": self._leg_bytes(comp.gather_itemsize),
+                },
+            },
+        }
+
+    def collective_bytes_per_step(self, steps_per_dispatch: int = 1) -> int:
+        detail = self.collective_bytes_detail(steps_per_dispatch)
+        if not detail:
+            return 0
+        return int(
+            sum(l["bytes_per_step"] for l in detail["per_leg"].values())
+        )
+
+
+class Zero2Updater(ShardedUpdater):
+    """ZeRO-2: gradients stay reduce-scattered across the K-step fused
+    dispatch. The update math is zero1's (the class inherits `apply`
+    unchanged); what changes is WHERE it runs — the trainer's multi-step
+    program (SGDTrainer.make_multi_step) merges the K stacked batches into
+    one shard-local [K*B] batch and applies ONE fused update per dispatch,
+    so the gradient reduce-scatter and the param all-gather each cross the
+    wire once per dispatch instead of once per step (~K x fewer collective
+    bytes on the grad leg at --steps_per_dispatch K).
+
+    Semantics: classic gradient accumulation — the dispatch's single update
+    consumes the mean gradient over the window's K*B rows (sample masks
+    included, so padded trailing rows still drop out exactly), parameters
+    hold still within the window, and the optimizer steps once per dispatch.
+    At K=1 (and for the trailing remainder batches the loop runs as
+    singles) zero2 applies exactly zero1's per-batch updates."""
+
+    mode = "zero2"
+
+    def collective_bytes_detail(
+        self, steps_per_dispatch: int = 1
+    ) -> Dict[str, Any]:
+        comp = self.compression
+        k = max(int(steps_per_dispatch), 1)
+        return {
+            "mode": self.mode,
+            "per_leg": {
+                "scatter": {
+                    "dtype": comp.scatter_dtype,
+                    "bytes_per_step": self._leg_bytes(
+                        comp.scatter_itemsize, per_dispatch_of=k
+                    ),
+                },
+                "gather": {
+                    "dtype": comp.gather_dtype,
+                    "bytes_per_step": self._leg_bytes(
+                        comp.gather_itemsize, per_dispatch_of=k
+                    ),
+                },
+            },
+        }
+
+
+class Zero3Updater(ShardedUpdater):
+    """ZeRO-3: parameters THEMSELVES live in the flat [n, chunk]
+    data-axis-sharded layout in the train state (~n x less param HBM per
+    chip, same as the optimizer slots), and the compiled step gathers each
+    one on demand:
+
+      * `network_params` (called inside the step's loss function) rebuilds
+        every flat param's full view through a custom_vjp gather: the
+        payload crosses the all-gather boundary encoded by the compression
+        mode (f32 / bf16 / block-scaled int8 with a master-tracking
+        error-feedback residual in opt_state["ef"] — quantization INSIDE
+        the collective, EQuARX-style), and the trainer remats the gathered
+        views (checkpoint_name "zero3_gathered") so the backward re-gathers
+        instead of holding every full parameter across the forward.
+      * The gather's transpose delivers gradients ALREADY in the flat
+        sharded layout — `apply` concatenates them across one scatter
+        constraint (the grad reduce-scatter), steps the optimizer
+        shard-locally, and leaves the updated params sharded. There is no
+        trailing param all-gather: the next step's forward re-gathers.
+
+    Tensor-parallel / static params keep their canonical layout and
+    placement (geometry resolves through the rules table), so zero3
+    composes with TP logical axes the same way zero1 does. Checkpoints
+    store the canonical layout via params_to/from_canonical — resumes
+    cross zero modes and world sizes bitwise (SGD) exactly like the
+    opt-state seam."""
+
+    mode = "zero3"
+
+    # -- parameter layout seams ----------------------------------------------
+    def params_to_canonical(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            k: p
+            if not self._geom[k].flat
+            else _from_flat(p, self._geom[k].shape, self._geom[k].size)
+            for k, p in params.items()
+        }
+
+    def params_from_canonical(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            k: p
+            if not self._geom[k].flat
+            else _to_flat(p, self.n, self._geom[k].chunk)
+            for k, p in params.items()
+        }
+
+    def param_leaf_sharding(self, name: str, leaf):
+        geom = self._geom.get(name)
+        if geom is not None and geom.flat:
+            return self._shard
+        return None
+
+    # -- the on-demand gather (runs inside the compiled step) -----------------
+    def param_resolver(self, opt_state=None) -> Optional[Callable]:
+        """The Context.param seam: each flat leaf's full view is rebuilt at
+        the consuming layer's trace position (memoized per trace by the
+        Context so shared params gather once). Canonical (TP/static) leaves
+        pass through."""
+        from jax.ad_checkpoint import checkpoint_name
+
+        ef = (opt_state or {}).get("ef")
+
+        def resolve(name: str, leaf):
+            geom = self._geom.get(name)
+            if geom is None or not geom.flat:
+                return leaf
+            e = None if ef is None else ef[name]
+            full2 = _z3_gather(self, leaf, e)
+            # named so the trainer's default zero3 remat policy
+            # (save_anything_except_these_names) recomputes exactly these:
+            # the gathered view is dropped after its layer consumes it and
+            # re-gathered in the backward
+            return checkpoint_name(
+                _from_flat(full2, geom.shape, geom.size), "zero3_gathered"
+            )
+
+        return resolve
+
+    # -- the sharded update (no trailing gather) ------------------------------
+    def apply(self, grads, opt_state, params, lr):
+        wsc = jax.lax.with_sharding_constraint
+        opt = self.optimizer
+        comp = self.compression
+        t = opt_state["t"] + 1
+        opt._t = t
+        ef = opt_state.get("ef")
+        new_params: Dict[str, Any] = {}
+        new_slots: Dict[str, Tuple] = {}
+        new_ef: Dict[str, Any] = {}
+
+        flat_keys = [k for k in params if self._geom[k].flat]
+        for k in params:
+            if not self._geom[k].flat:
+                new_params[k], new_slots[k] = opt.update_one(
+                    k, grads[k], opt_state["slots"][k], params[k], lr
+                )
+
+        if flat_keys:
+            # cotangents of the gather arrive already [n, chunk]-shaped;
+            # concat → ONE resharding boundary = the grad reduce-scatter
+            # (encode narrows the crossing for the compressed modes)
+            # reshard-ok: THE zero3 grad reduce-scatter boundary
+            cat = wsc(
+                jnp.concatenate(
+                    [comp.encode_z3_scatter(grads[k]) for k in flat_keys],
+                    axis=1,
+                ),
+                self._shard,
+            )
+            off = 0
+            for k in flat_keys:
+                geom = self._geom[k]
+                g2 = comp.decode_z3_scatter(cat[:, off:off + geom.chunk])
+                off += geom.chunk
+                # reshard-ok: placement pin of the resident shard
+                p2 = wsc(params[k], self._shard)
+                np2, new_slots[k] = opt.update_one(
+                    k, g2, opt_state["slots"][k], p2, lr
+                )
+                # params STAY sharded — the next forward re-gathers
+                # reshard-ok: placement pin, no collective
+                new_params[k] = wsc(np2, self._shard)
+                if ef is not None:
+                    # persist the param-gather error feedback: re-run the
+                    # forward's deterministic encode on the PRE-update
+                    # master (local math, no second collective)
+                    _, new_ef[k] = comp.encode_param_gather(p2, ef[k])
+
+        new_opt = {"slots": new_slots, "t": t}
+        if ef is not None:
+            new_opt["ef"] = new_ef
+        return new_params, new_opt
+
+    def collective_bytes_detail(
+        self, steps_per_dispatch: int = 1
+    ) -> Dict[str, Any]:
+        """zero3 legs: the on-demand param all-gather runs TWICE per step
+        (forward + the remat'd backward re-gather) and the grad scatter
+        once; both repeat every step of a fused dispatch."""
+        comp = self.compression
+        return {
+            "mode": self.mode,
+            "per_leg": {
+                "scatter": {
+                    "dtype": comp.z3_scatter_dtype,
+                    "bytes_per_step": self._leg_bytes(comp.z3_scatter_itemsize),
+                },
+                "gather": {
+                    "dtype": comp.param_gather_dtype,
+                    "bytes_per_step": self._leg_bytes(
+                        2 * comp.param_gather_itemsize
+                    ),
+                },
+            },
+        }
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _z3_gather(updater, p2, ef):
+    """One flat param's on-demand all-gather: encode the owned rows, cross
+    the replication constraint (the all-gather), decode identically on every
+    chip. custom_vjp so (a) the quantized view's gradient flows straight
+    through to the master (STE) and (b) autodiff never tries to transpose
+    the non-differentiable quantize."""
+    wsc = jax.lax.with_sharding_constraint
+    comp = updater.compression
+    # reshard-ok: placement pin of the owned rows before encoding
+    payload, _ = comp.encode_param_gather(wsc(p2, updater._shard), ef)
+    # reshard-ok: THE on-demand param all-gather (per flat param, fwd +
+    # remat'd bwd re-gather)
+    crossed = tuple(wsc(x, updater._rep) for x in payload)
+    return comp.decode_param_gather(crossed)
+
+
+def _z3_gather_fwd(updater, p2, ef):
+    return _z3_gather(updater, p2, ef), ef
+
+
+def _z3_gather_bwd(updater, ef_res, d_full2):
+    # straight-through estimator for the quantized modes: the cotangent of
+    # the gathered (possibly quantized) view passes to the master unchanged;
+    # its narrow wire crossing happens at apply's scatter constraint. The
+    # EF residual is state, not a differentiated input — zero cotangent.
+    return d_full2, None if ef_res is None else jnp.zeros_like(ef_res)
+
+
+_z3_gather.defvjp(_z3_gather_fwd, _z3_gather_bwd)
 
 
 # SparseRemoteParameterUpdater (RemoteParameterUpdater.h:265) has no updater

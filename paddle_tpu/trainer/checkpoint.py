@@ -25,7 +25,7 @@ import shutil
 import tempfile
 import threading
 import zlib
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import numpy as np
@@ -439,9 +439,11 @@ def is_v1_model_dir(dirname: str) -> bool:
 def load_pass(
     save_dir: str,
     pass_id: Optional[int] = None,
-    params_template: Optional[Dict[str, Any]] = None,
+    params_template: Union[None, Dict[str, Any], Callable[[], Dict[str, Any]]] = None,
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray], Dict[str, np.ndarray], Dict]:
     """Load (params, states, opt_flat, manifest). pass_id=None → latest.
+    `params_template` may be a zero-arg callable, resolved only if the v1
+    branch needs it.
 
     Accepts three on-disk layouts, sniffed in order:
     - save_dir/pass-%05d/ with manifest.json (this repo's native format);
@@ -472,6 +474,11 @@ def load_pass(
     if not os.path.exists(os.path.join(pdir, "manifest.json")) and (
         v1_sniffed or is_v1_model_dir(pdir)
     ):
+        if callable(params_template):
+            # lazy template: only the v1 branch needs the shapes, and
+            # building them may be non-trivial (a zero3 trainer gathers its
+            # flat-sharded params to canonical) — resolve it only here
+            params_template = params_template()
         if params_template is None:
             raise ValueError(
                 f"{pdir!r} is a reference-format (v1 binary) model dir; loading "

@@ -50,6 +50,24 @@ DIVERGENCE_POLICIES = ("skip_batch", "rollback", "raise")
 REMAT_POLICIES = (None, "none", "dots", "conv_only", "full")
 
 
+SHARD_UPDATE_MODES = ("zero1", "zero2", "zero3")
+
+
+def _resolve_shard_mode(shard_update) -> Optional[str]:
+    """Normalize SGDTrainer(shard_update=...): bools stay the zero1 alias,
+    strings name the ZeRO mode, anything else fails loudly."""
+    if shard_update in (False, None, "none", "0"):
+        return None
+    if shard_update in (True, "true", "1"):
+        return "zero1"
+    if shard_update in SHARD_UPDATE_MODES:
+        return shard_update
+    raise ValueError(
+        f"shard_update must be a bool or one of {SHARD_UPDATE_MODES}, got "
+        f"{shard_update!r}"
+    )
+
+
 class DivergenceError(RuntimeError):
     """Raised by divergence_policy="raise" when a step cost goes NaN/Inf."""
 
@@ -99,7 +117,9 @@ class SGDTrainer:
         precision: Optional[str] = None,  # None (ambient) | "f32" | "bf16"
         divergence_policy: Optional[str] = None,  # skip_batch|rollback|raise
         guard_check_every: int = 16,  # steps between divergence-guard polls
-        shard_update: bool = False,  # ZeRO-1 sharded update over the data axis
+        # ZeRO-sharded update over the mesh data axis: False/None = off,
+        # True = "zero1" (back-compat alias), or "zero1"|"zero2"|"zero3"
+        shard_update: Union[bool, str, None] = False,
         grad_compression: Optional[str] = None,  # None/none | bf16 | int8
     ):
         costs = [cost] if isinstance(cost, Layer) else list(cost)
@@ -134,26 +154,38 @@ class SGDTrainer:
         # inside the compiled step goes through updater.apply, and host-side
         # pass boundaries go through start_pass/finish_pass (barriers on
         # multi-host). Default: local updater, or the ICI all-reduce updater
-        # when a DataParallel mesh is configured; shard_update=True swaps in
-        # the ZeRO-1 ShardedUpdater (reduce-scatter grads over the mesh data
-        # axis → shard-local optimizer step on 1/N of the optimizer state →
-        # all-gather of updated params), optionally with a compressed
-        # collective payload (--grad_compression; parallel/compression.py).
-        if (shard_update or grad_compression not in (None, "none")) and (
-            parallel is None and updater is None
-        ):
+        # when a DataParallel mesh is configured; shard_update selects a ZeRO
+        # mode (parallel/updaters.py):
+        #   "zero1" (True): reduce-scatter grads over the mesh data axis →
+        #       shard-local optimizer step on 1/N of the optimizer state →
+        #       all-gather updated params, every step;
+        #   "zero2": zero1's update fused across the K-step dispatch — the
+        #       multi-step program merges the window into one shard-local
+        #       K*B batch, so grads cross the wire ONCE per dispatch
+        #       (gradient-accumulation semantics: one update per window);
+        #   "zero3": parameters live flat data-axis-sharded in the train
+        #       state (~N x less param HBM per chip) and are gathered
+        #       layer-by-layer on demand inside the step, re-gathered (not
+        #       stored) in the backward via remat;
+        # optionally with a compressed collective payload
+        # (--grad_compression; parallel/compression.py — under zero3 the
+        # int8 budget moves to the on-demand param gather).
+        self.shard_update = _resolve_shard_mode(shard_update)
+        if (
+            self.shard_update or grad_compression not in (None, "none")
+        ) and (parallel is None and updater is None):
             raise ValueError(
                 "shard_update/grad_compression need a DataParallel mesh "
                 "(SGDTrainer(parallel=...)): there is no data axis to shard "
                 "the update over"
             )
-        if grad_compression not in (None, "none") and not shard_update:
+        if grad_compression not in (None, "none") and not self.shard_update:
             raise ValueError(
                 "grad_compression wraps the sharded update's reduce-scatter "
                 "— pass shard_update=True with it"
             )
         if updater is not None and (
-            shard_update or grad_compression not in (None, "none")
+            self.shard_update or grad_compression not in (None, "none")
         ):
             raise ValueError(
                 "shard_update/grad_compression select the built-in "
@@ -164,10 +196,16 @@ class SGDTrainer:
         if updater is None:
             from paddle_tpu.parallel import (
                 IciAllReduceUpdater, SgdLocalUpdater, ShardedUpdater,
+                Zero2Updater, Zero3Updater,
             )
 
-            if parallel is not None and shard_update:
-                updater = ShardedUpdater(
+            if parallel is not None and self.shard_update:
+                cls = {
+                    "zero1": ShardedUpdater,
+                    "zero2": Zero2Updater,
+                    "zero3": Zero3Updater,
+                }[self.shard_update]
+                updater = cls(
                     optimizer, parallel, compression=grad_compression or "none"
                 )
             elif parallel is not None:
@@ -258,14 +296,19 @@ class SGDTrainer:
             rng, sample_batch, train=True, policy=self.policy()
         )
         self.optimizer.param_attrs = self.network.param_attrs
+        # the updater owns the opt-state LAYOUT: canonical per-param slots by
+        # default, flat [n, chunk] data-axis-sharded slots (+ error-feedback
+        # residuals) under shard_update. init_opt_state also binds the flat
+        # geometry, which params_from_canonical below needs: under zero3 the
+        # PARAMETERS adopt the same flat sharded layout (identity otherwise),
+        # and the model-average state mirrors whatever layout params use.
+        opt_state = self.updater.init_opt_state(params)
+        params_store = self.updater.params_from_canonical(params)
         state: TrainState = {
-            "params": params,
-            # the updater owns the opt-state LAYOUT: canonical per-param
-            # slots by default, flat [n, chunk] data-axis-sharded slots
-            # (+ error-feedback residuals) under shard_update
-            "opt": self.updater.init_opt_state(params),
+            "params": params_store,
+            "opt": opt_state,
             "states": states,
-            "avg": self.model_average.init_state(params),
+            "avg": self.model_average.init_state(params_store),
             # int32 (not float32): float32 absorbs small increments past 2^24
             # samples, which would freeze LR schedules and the per-step rng
             "samples": jnp.zeros((), jnp.int32),
@@ -290,8 +333,11 @@ class SGDTrainer:
                 self.parallel.param_attrs = self.network.param_attrs
             # ZeRO-sharded slot/EF leaves land DIRECTLY on their 1/n-per-chip
             # resident placement via the updater's opt_leaf_sharding rule
+            # (zero3 params/averages via param_leaf_sharding likewise)
             state = self.parallel.shard_state(
-                state, opt_sharding=self.updater.opt_leaf_sharding
+                state,
+                opt_sharding=self.updater.opt_leaf_sharding,
+                param_sharding=self.updater.param_leaf_sharding,
             )
         self.state = state
         return state
@@ -328,10 +374,17 @@ class SGDTrainer:
             lr = schedule(state["samples"].astype(jnp.float32)) * state["lr_scale"]
             step_rng = jax.random.fold_in(state["rng"], state["samples"])
 
+            # ZeRO-3 gather seam: a non-None resolver makes Context.param
+            # rebuild each flat sharded leaf's full view AT ITS POINT OF
+            # USE inside Network.apply (layer-by-layer on demand; the
+            # all-gather's transpose delivers already-scattered gradients
+            # to updater.apply). None for every other updater.
+            resolver = updater.param_resolver(state["opt"])
+
             def loss_fn(params):
                 outs, new_states = net.apply(
-                    params, state["states"], batch, train=True, rng=step_rng,
-                    policy=policy,
+                    params, state["states"], batch, train=True,
+                    rng=step_rng, policy=policy, param_resolver=resolver,
                 )
                 total = sum(outs[c].value for c in cost_names)
                 # the pass-cost average and the divergence guard's isfinite
@@ -362,6 +415,19 @@ class SGDTrainer:
                 )
             elif self.remat == "full":
                 loss_fn = jax.checkpoint(loss_fn)
+            elif updater.mode == "zero3":
+                # zero3 default (no explicit remat policy): save every
+                # residual EXCEPT the gathered param views, so the backward
+                # RE-GATHERS each full parameter instead of holding all of
+                # them across the forward — the comms-for-memory trade that
+                # makes the sharded residency real at peak, not just at
+                # rest. The explicit policies above already recompute the
+                # gathers (none of them saves the named views).
+                loss_fn = jax.checkpoint(
+                    loss_fn,
+                    policy=jax.checkpoint_policies
+                    .save_anything_except_these_names("zero3_gathered"),
+                )
 
             (cost, (outs, new_states)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
@@ -424,8 +490,59 @@ class SGDTrainer:
         compute/comm overlap in ConcurrentRemoteParameterUpdater
         (RemoteParameterUpdater.h:180). `train(steps_per_dispatch=K)` drives
         this program over K-batch groups from the reader (stacked by a
-        DevicePrefetcher(stack_k=K) or host-side by the trainer)."""
+        DevicePrefetcher(stack_k=K) or host-side by the trainer).
+
+        ZeRO-2 (shard_update="zero2") replaces the scan with the FUSED
+        update: the K stacked batches merge into one shard-local [K*B] batch
+        (each device's rows stay local — no batch reshuffle collective) and
+        ONE forward/backward/update runs for the whole window, so the grad
+        reduce-scatter and the param all-gather cross the wire once per
+        DISPATCH instead of once per step (~K x fewer collective bytes on
+        the grad leg). Semantics are classic gradient accumulation: the
+        single update consumes the mean gradient over the window's real
+        rows (sample masks compose exactly), parameters hold still within
+        the window. Dispatch-level bookkeeping (cost accumulator, diverged
+        counter) is scaled back to per-batch units inside the same program
+        so pass averages and divergence accounting stay comparable to
+        zero1; a poisoned window reverts and counts as K diverged steps."""
         step = self._build_step()
+
+        if self.updater.mode == "zero2":
+            guard_on = self.divergence_policy is not None
+            n_data = self.parallel.data_axis_size
+            batch_sharding = self.parallel._batch_sharding
+
+            def multi(state: TrainState, batches: Dict[str, Any]):
+                k = next(iter(batches.values())).shape[0]
+                merged = {}
+                for key, v in batches.items():
+                    b = v.shape[1]
+                    rest = tuple(v.shape[2:])
+                    # shard-local merge [K, B] -> [K*B]: route the reshape
+                    # through the data-axis split so each device's rows stay
+                    # on-device (a naive k-major reshape would interleave
+                    # shards and buy an all-to-all). Row order within the
+                    # window changes, which a mean over the window cannot see.
+                    vm = v.reshape((k, n_data, b // n_data) + rest)
+                    vm = vm.transpose(
+                        (1, 0, 2) + tuple(range(3, 3 + len(rest)))
+                    )
+                    merged[key] = jax.lax.with_sharding_constraint(
+                        vm.reshape((k * b,) + rest), batch_sharding
+                    )
+                d0, a0 = state["diverged"], state["cost_acc"]
+                new_state, cost, _ = step(state, merged)
+                # one fused update stands for k batches: scale the dispatch-
+                # level bookkeeping back to per-batch units (samples already
+                # advanced by the window's real row count via the mask sum)
+                new_state["diverged"] = d0 + (new_state["diverged"] - d0) * k
+                if guard_on:
+                    new_state["cost_acc"] = (
+                        a0 + (new_state["cost_acc"] - a0) * k
+                    )
+                return new_state, jnp.broadcast_to(cost, (k,))
+
+            return jax.jit(multi, donate_argnums=0)
 
         def multi(state: TrainState, batches: Dict[str, Any]):
             def body(s, b):
@@ -444,10 +561,15 @@ class SGDTrainer:
         avg = self.model_average
         policy = self.policy()
 
+        updater = self.updater
+
         def evaluate(state: TrainState, batch: Dict[str, Any]):
+            # zero3: averages share the flat layout, so averaging then
+            # gathering equals gathering then averaging (it is linear)
             params = avg.averaged_params(state["avg"], state["params"])
             outs, _ = net.apply(
-                params, state["states"], batch, train=False, policy=policy
+                params, state["states"], batch, train=False, policy=policy,
+                param_resolver=updater.param_resolver(state["opt"]),
             )
             total = sum(outs[c].value for c in cost_names).astype(jnp.float32)
             extras = {n: outs[n].value for n in extra_names}
@@ -990,8 +1112,13 @@ class SGDTrainer:
                 self.state["opt"]
             )
             metrics["collective_bytes_per_step"] = (
-                self.updater.collective_bytes_per_step()
+                self.updater.collective_bytes_per_step(steps_per_dispatch)
             )
+            detail = self.updater.collective_bytes_detail(steps_per_dispatch)
+            if detail:
+                # per-leg (scatter/gather) x mode (zero1/2/3) x dtype
+                # breakdown of the modeled collective traffic
+                metrics["collective_bytes_detail"] = detail
             hbm = stats.device_memory_stats()
             if hbm.get("peak_bytes_in_use"):
                 metrics["peak_hbm_bytes"] = hbm["peak_bytes_in_use"]
@@ -1142,8 +1269,19 @@ class SGDTrainer:
         # re-shard alone proved insufficient.
         detach_compilation_cache("elastic resize")
         # canonical layout is the portable waypoint: gather ZeRO-flat
-        # slots back to parameter shapes on the OLD updater...
+        # slots — and zero3's flat params — back to parameter shapes on
+        # the OLD updater...
         canonical = self.updater.to_canonical(self.state["opt"])
+        params_canonical = self.updater.params_to_canonical(
+            self.state["params"]
+        )
+        # model averages mirror the param layout (flat under zero3), so
+        # they cross the resize through the same seam (identity otherwise)
+        avg_canonical = (
+            self.updater.params_to_canonical(self.state["avg"]["avg"])
+            if self.state.get("avg")
+            else None
+        )
         if faults.get().fire("reshard_kill"):
             # chaos hook: the process dies mid-re-shard — after the
             # drain checkpoint, before the new mesh runs; auto_resume
@@ -1152,14 +1290,22 @@ class SGDTrainer:
             raise faults.InjectedKill("injected reshard_kill (chaos)")
         # ...then re-flatten for the NEW shard count and place every
         # leaf on its new-mesh sharding (ZeRO leaves land directly
-        # 1/n-resident)
-        new_updater = self.updater.rebind(new_parallel, self.state["params"])
+        # 1/n-resident). rebind derives geometry from CANONICAL shapes.
+        new_updater = self.updater.rebind(new_parallel, params_canonical)
         state = dict(self.state)
         state["opt"] = new_updater.from_canonical(canonical)
+        state["params"] = new_updater.params_from_canonical(params_canonical)
+        if avg_canonical is not None:
+            state["avg"] = {
+                **state["avg"],
+                "avg": new_updater.params_from_canonical(avg_canonical),
+            }
         self.parallel = new_parallel
         self.updater = new_updater
         self.state = new_parallel.shard_state(
-            state, opt_sharding=new_updater.opt_leaf_sharding
+            state,
+            opt_sharding=new_updater.opt_leaf_sharding,
+            param_sharding=new_updater.param_leaf_sharding,
         )
         self._step_fn = None
         self._multi_fn = None
@@ -1414,14 +1560,22 @@ class SGDTrainer:
         # the checkpoint span covers what the TRAINING THREAD pays: the full
         # write when synchronous, only the D2H fetch + enqueue when async
         with trace.span("train.checkpoint", pass_id=pass_id, is_async=async_):
-            # checkpoints always store the optimizer's CANONICAL per-param
-            # layout: a ShardedUpdater gathers its flat [n, chunk] slot/EF
-            # shards back to parameter shapes here, so the same pass dir
-            # resumes under shard_update on or off (and across device
-            # counts) bitwise
+            # checkpoints always store the CANONICAL per-param layout: a
+            # ShardedUpdater gathers its flat [n, chunk] slot/EF shards back
+            # to parameter shapes here — and the Zero3Updater its flat
+            # PARAMS too — so the same pass dir resumes under any
+            # shard_update mode (and across device counts) bitwise
+            params_store = self.updater.params_to_canonical(
+                self.state["params"]
+            )
             opt_tree = {"opt": self.updater.to_canonical(self.state["opt"])}
             if self.state["avg"]:
-                opt_tree["avg"] = self.state["avg"]
+                opt_tree["avg"] = {
+                    **self.state["avg"],
+                    "avg": self.updater.params_to_canonical(
+                        self.state["avg"]["avg"]
+                    ),
+                }
             extra_meta = {
                 "samples": int(self.state["samples"]),
                 "lr_scale": float(self.state["lr_scale"]),
@@ -1441,7 +1595,7 @@ class SGDTrainer:
                 return ckpt_mod.save_pass(
                     save_dir,
                     pass_id,
-                    self.state["params"],
+                    params_store,
                     self.state["states"],
                     opt_tree,
                     extra_meta=extra_meta,
@@ -1450,7 +1604,7 @@ class SGDTrainer:
             if self._ckpt_writer is None:
                 self._ckpt_writer = ckpt_mod.AsyncCheckpointer()
             with stats.timer("ckptFetch"):
-                params_np = _fetch_host_tree(self.state["params"])
+                params_np = _fetch_host_tree(params_store)
                 states_np = _fetch_host_tree(self.state["states"])
                 opt_np = _fetch_host_tree(opt_tree)
             return ckpt_mod.save_pass_async(
@@ -1479,9 +1633,18 @@ class SGDTrainer:
         assert self.state is not None, "init_state() with a sample batch first"
         self.checkpoint_wait()  # never read a checkpoint that is mid-write
         params, states, opt_flat, manifest = ckpt_mod.load_pass(
-            save_dir, pass_id, params_template=self.state["params"]
+            save_dir, pass_id,
+            # lazy canonical template: only the legacy v1-binary branch needs
+            # shapes, and building them under zero3 would eagerly gather the
+            # flat-sharded params (a transient full-model footprint on the
+            # COMMON native-format resume path otherwise)
+            params_template=lambda: self.updater.params_to_canonical(
+                self.state["params"]
+            ),
         )
-        self.state["params"] = {k: jnp.asarray(v) for k, v in params.items()}
+        self.state["params"] = self.updater.params_from_canonical(
+            {k: jnp.asarray(v) for k, v in params.items()}
+        )
         if states:
             self.state["states"] = {k: jnp.asarray(v) for k, v in states.items()}
         if opt_flat:
@@ -1489,7 +1652,12 @@ class SGDTrainer:
             # re-flatten for a ShardedUpdater — identity for the others
             template = {"opt": self.updater.to_canonical(self.state["opt"])}
             if self.state["avg"]:
-                template["avg"] = self.state["avg"]
+                template["avg"] = {
+                    **self.state["avg"],
+                    "avg": self.updater.params_to_canonical(
+                        self.state["avg"]["avg"]
+                    ),
+                }
             # pin the cross-world-size contract: canonical checkpoints load
             # on ANY world size, so a shape mismatch here means the opt tree
             # was written as raw per-shard state (pre-canonical or foreign)
@@ -1557,7 +1725,12 @@ class SGDTrainer:
             restored = ckpt_mod.restore_tree(template, opt_flat)
             self.state["opt"] = self.updater.from_canonical(restored["opt"])
             if "avg" in restored:
-                self.state["avg"] = restored["avg"]
+                self.state["avg"] = {
+                    **restored["avg"],
+                    "avg": self.updater.params_from_canonical(
+                        restored["avg"]["avg"]
+                    ),
+                }
         samples = manifest.get("extra", {}).get("samples")
         if samples is not None:
             self.state["samples"] = jnp.asarray(int(samples), jnp.int32)
@@ -1566,10 +1739,12 @@ class SGDTrainer:
             self.state["lr_scale"] = jnp.asarray(float(lr_scale), jnp.float32)
         if self.parallel is not None:
             # re-establish mesh placement (sharded head weights, replicated
-            # or ZeRO-flat slots) — plain asarray loads land unsharded
-            # otherwise
+            # or ZeRO-flat slots/params) — plain asarray loads land
+            # unsharded otherwise
             self.state = self.parallel.shard_state(
-                self.state, opt_sharding=self.updater.opt_leaf_sharding
+                self.state,
+                opt_sharding=self.updater.opt_leaf_sharding,
+                param_sharding=self.updater.param_leaf_sharding,
             )
 
 

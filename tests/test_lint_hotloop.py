@@ -337,6 +337,83 @@ def test_sanctioned_placement_sites_stay_rare():
         )
 
 
+# -- ZeRO resharding boundaries (ISSUE 14 sharded update) ---------------------
+#
+# Every with_sharding_constraint inside the updaters' compiled-step bodies is
+# a potential COLLECTIVE (the scatter/gather boundaries the HLO pins in
+# test_hlo_collectives.py count) or a placement pin. Each site carries a
+# `reshard-ok` tag naming which it is, and the counts are pinned per body so
+# a new resharding boundary — a second scatter, a stray gather-back under
+# zero3, a per-parameter constraint replacing the concat — forces a review
+# here before it silently multiplies wire traffic.
+
+UPDATERS_PY = os.path.join(_REPO, "paddle_tpu", "parallel", "updaters.py")
+WSC_CALL = re.compile(r"(?<![\w.])wsc\(|with_sharding_constraint\(")
+WSC_TAG = "reshard-ok"
+# (class or None for module functions, bodies, exact reshard-ok site count)
+WSC_STEP_BODIES = [
+    ("ShardedUpdater", ("apply",), 3),   # scatter, local-view pin, gather
+    ("Zero3Updater", ("apply",), 3),     # scatter, resident pin, stay-pin
+    (None, ("_z3_gather",), 2),          # owned-rows pin, THE param gather
+]
+
+
+def _updater_spans(tree: ast.Module, class_name, methods):
+    if class_name is None:
+        for node in tree.body:
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in methods
+            ):
+                yield node.name, node.lineno, node.end_lineno
+        return
+    yield from _hot_spans(tree, class_name, methods)
+
+
+def _scan_updaters(class_name, methods, pattern, tag):
+    with open(UPDATERS_PY) as f:
+        source = f.read()
+    lines = source.splitlines()
+    spans = list(_updater_spans(ast.parse(source), class_name, methods))
+    assert {name for name, _, _ in spans} == set(methods), (
+        f"updater step bodies {methods} moved/renamed — update {__file__}"
+    )
+    violations, tagged = [], 0
+    for name, lo, hi in spans:
+        for ln in range(lo, hi + 1):
+            code = lines[ln - 1].split("#", 1)[0]
+            if not pattern.search(code):
+                continue
+            window = lines[max(0, ln - TAG_LOOKBACK):ln]
+            if tag in lines[ln - 1] or any(tag in w for w in window):
+                tagged += 1
+                continue
+            violations.append(
+                f"updaters.py:{name}:{ln}: {lines[ln - 1].strip()}"
+            )
+    return violations, tagged
+
+
+def test_updater_reshard_sites_tagged_and_pinned():
+    """Sanctioned gather/scatter sites in the sharded-update step bodies:
+    every wsc() is tagged `reshard-ok` and the per-body counts are exact —
+    the alias `wsc = jax.lax.with_sharding_constraint` line itself does not
+    count (no call parens)."""
+    for cls, methods, count in WSC_STEP_BODIES:
+        violations, tagged = _scan_updaters(cls, methods, WSC_CALL, WSC_TAG)
+        where = cls or "module"
+        assert not violations, (
+            f"untagged resharding constraint in {where} step body — a new "
+            "collective boundary needs a `# reshard-ok: <why>` tag and a "
+            "deliberate count bump here:\n  " + "\n  ".join(violations)
+        )
+        assert tagged == count, (
+            f"{tagged} reshard-ok sites in {where}.{methods} (pinned "
+            f"{count}): the sharded update's resharding structure changed — "
+            "re-check the HLO collective pins and re-pin both"
+        )
+
+
 def test_no_file_io_in_hot_loops():
     """No open()/.write()/json.dump in any hot-loop body, tagged or not —
     span export and metric scraping happen OUTSIDE the loops (export_chrome,

@@ -123,3 +123,42 @@ def test_sharded_param_layout():
     sh = tr.state["params"]["h.w"].sharding
     spec = sh.spec
     assert tuple(spec) == (None, "model"), spec
+
+
+def test_legacy_sharding_shim_warns_exactly_once_per_process():
+    """ISSUE 14 satellite: the deprecated ParamAttr(sharding=...) mesh-axis
+    shim emits ONE DeprecationWarning per process — not one per parameter,
+    not one per step trace, and not zero."""
+    import warnings
+
+    from paddle_tpu.parallel import rules as rules_mod
+
+    was = rules_mod._legacy_sharding_warned
+    try:
+        rules_mod._legacy_sharding_warned = False
+        mesh = make_mesh({"data": 2, "model": 2})
+        dp = DataParallel(mesh, param_attrs={
+            "a.w": ParamAttr(sharding=("model", None)),
+            "b.w": ParamAttr(sharding=(None, "model")),
+        })
+        with warnings.catch_warnings(record=True) as got:
+            warnings.simplefilter("always")
+            dp.param_sharding("a.w", 2)
+            dp.param_sharding("b.w", 2)  # second legacy param: no new warning
+        dep = [w for w in got if issubclass(w.category, DeprecationWarning)]
+        assert len(dep) == 1, [str(w.message) for w in dep]
+        assert "a.w" in str(dep[0].message)
+        assert "logical_axes" in str(dep[0].message)
+        # logical_axes declarations never trip the shim
+        dp2 = DataParallel(mesh, param_attrs={
+            "c.w": ParamAttr(logical_axes=("embed", "mlp")),
+        })
+        rules_mod._legacy_sharding_warned = False
+        with warnings.catch_warnings(record=True) as got2:
+            warnings.simplefilter("always")
+            dp2.param_sharding("c.w", 2)
+        assert not [
+            w for w in got2 if issubclass(w.category, DeprecationWarning)
+        ]
+    finally:
+        rules_mod._legacy_sharding_warned = was
