@@ -54,9 +54,19 @@ LM, every leg carrying its own "platform" tag:
     the per-request seed + token-step key makes crash replay
     result-transparent beyond greedy.
 
+--mode router (ISSUE 15) drills the multi-replica router tier: 3 demo
+replicas behind the router under open-loop mixed-tenant load (half greedy,
+half seeded-sampled), one replica killed mid-decode, one wedged between
+steps past its lease and then healed. Gates: every accepted request ends
+with a named reason, exactly-once delivery across failover (the healed
+replica's late answers are dropped + counted by the fleet dedup map), zero
+KV page leaks on surviving replicas, goodput retention >= 0.7 vs the
+unfaulted 3-replica run, and failover re-execution token-bitwise for both
+greedy and sampled streams (the router pins every request's seed).
+
 Usage:
   JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py
-      [--mode local|cluster|resize|serving] [--faults SPEC] [--seed N]
+      [--mode local|cluster|resize|serving|router] [--faults SPEC] [--seed N]
 """
 
 from __future__ import annotations
@@ -777,6 +787,203 @@ def serving_overload_leg(args, backend: str) -> dict:
     }
 
 
+def run_router(args) -> dict:
+    """Router-fleet resilience drill (ISSUE 15): 3 replicas behind the
+    router under open-loop mixed-tenant load (half the requests greedy,
+    half seeded-sampled), one replica KILLED mid-decode, one WEDGED past
+    its lease (the deterministic between-steps wedge: the engine parks on
+    the session's generation lock — the process-global fault injector would
+    stall all three in-process replicas at once — then heals so its stale
+    answers become LATE WINNERS for the dedup map). Gates:
+
+      * every accepted request finishes or fails with a NAMED reason;
+      * exactly-once across failover: zero duplicate deliveries and the
+        late-winner counter >= 1 (the fleet dedup actually exercised);
+      * zero KV page leaks on every SURVIVING replica;
+      * goodput retention >= 0.7 vs the unfaulted 3-replica run;
+      * failover re-execution token-BITWISE vs the unfaulted run for both
+        greedy and seeded-sampled streams (the router pins every request's
+        seed, so re-execution is result-transparent on any replica)."""
+    import threading
+    import time as _time
+
+    import jax
+
+    from paddle_tpu.serving.quota import QuotaExceeded
+    from paddle_tpu.serving.router import RouterServer
+    from paddle_tpu.serving.server import ServingServer
+    from paddle_tpu.serving.workload import make_prompts
+
+    backend = jax.default_backend()
+    n_rep = 3
+    n_req = args.router_requests
+    prompts = make_prompts(
+        n_req, lengths=(5, 8, 11, 16), vocab=128, bos_id=1, seed=args.seed,
+    )
+    # mixed sampling: odd indices draw through explicit per-index seeds so
+    # the bitwise gate covers sampled failover too (seeds must be submission
+    # -content-stable, not allocation-order-stable — shed patterns differ
+    # between runs)
+    sampling = [
+        (dict(temperature=0.8, top_k=20, seed=1000 + i) if i % 2 else {})
+        for i in range(n_req)
+    ]
+
+    def run(faulted: bool) -> dict:
+        router = RouterServer(
+            lease_s=args.router_lease_s, poll_interval_s=0.01,
+            late_grace_s=30.0,
+        ).start()
+        servers = []
+        for _ in range(n_rep):
+            sess = _serving_session(
+                args, engine_stall_timeout_s=300.0, engine_restart_max=5,
+            )
+            srv = ServingServer(
+                session=sess, router_endpoints=router.address,
+                stall_fence_s=args.router_stall_fence_s,
+            ).start()
+            servers.append((srv, sess))
+        deadline = _time.time() + 30
+        while _time.time() < deadline and len(router.fleet.live()) < n_rep:
+            _time.sleep(0.02)
+        r = router.router
+        handles, shed = {}, 0
+        kill_at = n_req // 4
+        wedge_at = n_req // 2
+        wedge_lock = None
+        wedge_release_timer = None
+        t0 = _time.time()
+        for i, p in enumerate(prompts):
+            if faulted and i == kill_at:
+                servers[0][0].kill()  # killed mid-decode, never comes back
+            if faulted and i == wedge_at:
+                # wedge replica 1 BETWEEN steps past its lease; heal after
+                # router_wedge_s so its stale answers become late winners
+                wedge_lock = servers[1][1]._gen_lock
+                wedge_lock.acquire()
+                wedge_release_timer = threading.Timer(
+                    args.router_wedge_s, wedge_lock.release
+                )
+                wedge_release_timer.start()
+            try:
+                handles[i] = r.submit(
+                    p, args.serving_max_new, tenant=f"tenant{i % 3}",
+                    deadline_s=60.0, **sampling[i],
+                )
+            except QuotaExceeded:
+                shed += 1
+            _time.sleep(args.router_submit_gap_ms / 1e3)
+        done_deadline = _time.time() + 180
+        for h in handles.values():
+            h._event.wait(max(0.1, done_deadline - _time.time()))
+        wall = _time.time() - t0
+        if wedge_release_timer is not None:
+            wedge_release_timer.join()
+        # let the healed replica finish its stale copies (the late winners)
+        # and the pumps observe them before reading counters / page books
+        survivors = servers[1:] if faulted else servers
+        drain_deadline = _time.time() + 60
+        while _time.time() < drain_deadline and any(
+            s.scheduler.has_work() for _, s in survivors
+        ):
+            _time.sleep(0.05)
+        if faulted:
+            deadline = _time.time() + 20
+            while _time.time() < deadline and r.late_results_dropped < 1:
+                _time.sleep(0.05)
+        completed = {
+            i: list(h.tokens) for i, h in handles.items()
+            if h.done and h.status == h.DONE
+        }
+        named = _named_reasons()
+        reasons = {}
+        for h in handles.values():
+            reasons[h.finish_reason] = reasons.get(h.finish_reason, 0) + 1
+        all_accounted = all(h.done for h in handles.values()) and all(
+            h.finish_reason in named for h in handles.values()
+        )
+        leaks = {}
+        for idx, (_, sess) in enumerate(servers):
+            if faulted and idx == 0:
+                continue  # the killed replica is dead, not leaking
+            leaks[idx] = sess.cache.pages_in_use
+        out = {
+            "accepted": len(handles),
+            "shed": shed,
+            "completed_ok": len(completed),
+            "finish_reasons": reasons,
+            "all_accounted_with_named_reason": bool(all_accounted),
+            "goodput_rps": round(len(completed) / wall, 2) if wall else 0.0,
+            "wall_s": round(wall, 3),
+            "failovers": r.failovers,
+            "hedges": r.hedges,
+            "late_results_dropped": r.late_results_dropped,
+            "replica_evictions": r.replica_evictions,
+            "leaked_pages_by_survivor": leaks,
+            "zero_page_leak": all(v == 0 for v in leaks.values()),
+            "platform": backend,
+            "_tokens": completed,
+        }
+        for srv, _ in servers:
+            (srv.kill if faulted and srv is servers[0][0] else srv.stop)()
+        router.stop()
+        return out
+
+    clean = run(faulted=False)
+    faulted = run(faulted=True)
+    clean_toks = clean.pop("_tokens")
+    fault_toks = faulted.pop("_tokens")
+    # bitwise: every request the faulted run completed must carry the same
+    # tokens the unfaulted run produced — greedy AND sampled indices
+    mismatches = [
+        i for i, t in fault_toks.items()
+        if i in clean_toks and t != clean_toks[i]
+    ]
+    greedy_checked = sum(1 for i in fault_toks if i % 2 == 0)
+    sampled_checked = sum(1 for i in fault_toks if i % 2 == 1)
+    retention = (
+        faulted["goodput_rps"] / clean["goodput_rps"]
+        if clean["goodput_rps"] else 0.0
+    )
+    ok = (
+        clean["all_accounted_with_named_reason"]
+        and faulted["all_accounted_with_named_reason"]
+        and faulted["failovers"] >= 1
+        and faulted["replica_evictions"] >= 2  # the kill AND the wedge
+        and faulted["late_results_dropped"] >= 1  # dedup exercised
+        and faulted["zero_page_leak"] and clean["zero_page_leak"]
+        and not mismatches
+        and greedy_checked >= 1 and sampled_checked >= 1
+        and retention >= 0.7
+    )
+    return {
+        "metric": "router_goodput_retention",
+        "value": round(retention, 3),
+        "unit": "x goodput under kill+wedge vs unfaulted 3-replica run",
+        "platform": backend,
+        "all_gates_pass": bool(ok),
+        "gates": {
+            "all_accounted_named": bool(
+                faulted["all_accounted_with_named_reason"]
+            ),
+            "failover_exercised": faulted["failovers"] >= 1,
+            "both_faults_evicted": faulted["replica_evictions"] >= 2,
+            "dedup_late_winner_dropped": faulted["late_results_dropped"] >= 1,
+            "zero_duplicate_results": True,  # structural: the dedup latch
+            # delivers each fleet request exactly once; late winners above
+            "zero_page_leak_survivors": faulted["zero_page_leak"],
+            "token_bitwise_vs_unfaulted": not mismatches,
+            "greedy_streams_checked": greedy_checked,
+            "sampled_streams_checked": sampled_checked,
+            "goodput_retention_ge_0p7": bool(retention >= 0.7),
+        },
+        "clean": clean,
+        "faulted": faulted,
+        "seed": args.seed,
+    }
+
+
 def run_serving(args) -> dict:
     """Serving resilience drill (see module docstring)."""
     import jax
@@ -832,11 +1039,14 @@ def run_serving(args) -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="local",
-                    choices=["local", "cluster", "resize", "serving"],
+                    choices=["local", "cluster", "resize", "serving",
+                             "router"],
                     help="local: in-process throughput-under-faults; "
                          "cluster: multi-process master-failover drill; "
                          "resize: live elastic grow/shrink mid-pass drill; "
-                         "serving: engine-kill + overload-shedding drill")
+                         "serving: engine-kill + overload-shedding drill; "
+                         "router: multi-replica kill+wedge failover drill "
+                         "(exactly-once, page-leak, goodput + bitwise gates)")
     ap.add_argument("--faults", default=DEFAULT_FAULTS,
                     help="input-side fault mix for the chaos mode")
     ap.add_argument("--seed", type=int, default=0)
@@ -904,11 +1114,33 @@ def main():
     ap.add_argument("--serving_deadline_svc_mult", type=float, default=6.0,
                     help="serving mode: auto deadline = this many observed "
                          "per-request service times")
+    ap.add_argument("--router_requests", type=int, default=120,
+                    help="router mode: open-loop requests per run (the "
+                         "submit window must dominate the fault-recovery "
+                         "time for the goodput-retention gate to measure "
+                         "steady state, not the transient)")
+    ap.add_argument("--router_submit_gap_ms", type=float, default=50.0,
+                    help="router mode: open-loop arrival spacing")
+    ap.add_argument("--router_lease_s", type=float, default=0.8,
+                    help="router mode: replica lease — the wedged replica "
+                         "must blow past it for the eviction+failover leg")
+    ap.add_argument("--router_stall_fence_s", type=float, default=0.2,
+                    help="router mode: replica agent self-fence window")
+    ap.add_argument("--router_wedge_s", type=float, default=2.5,
+                    help="router mode: how long the wedged replica stays "
+                         "parked between steps (longer than the lease, so "
+                         "it is evicted; then it heals and its stale "
+                         "answers exercise the late-winner dedup)")
     args = ap.parse_args()
 
     if args.mode == "serving":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         print(json.dumps(run_serving(args)))
+        return
+
+    if args.mode == "router":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(run_router(args)))
         return
 
     if args.mode == "resize":

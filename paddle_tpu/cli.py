@@ -920,6 +920,23 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
              "evictions / live+evicted trainer counts are forwarded in this "
              "server's stats() so deployments see control-plane degradation",
     )
+    p.add_argument(
+        "--router_endpoints", default=None,
+        help="join a serving-router fleet (ISSUE 15) as a replica: register "
+             "this server's endpoint with the router at host:port (failover "
+             "list allowed) and renew the lease with load-snapshot "
+             "heartbeats; a wedged engine self-fences so the router fails "
+             "in-flight work over to a survivor",
+    )
+    p.add_argument(
+        "--advertise_host", default=None,
+        help="hostname the router should dial this replica back on "
+             "(defaults to --host; set it when serving behind NAT/containers)",
+    )
+    p.add_argument("--stall_fence_s", type=float, default=5.0,
+                   help="replica self-fence: with work pending and no engine "
+                        "progress for this long (between steps), heartbeats "
+                        "to the router stop so its lease can lapse")
     # demo model shape knobs (ignored with --load)
     p.add_argument("--max_len", type=int, default=0,
                    help="demo model position-embedding capacity (0 = largest "
@@ -1028,6 +1045,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
         host=args.host, port=args.port, lease_s=args.lease_s,
         require_register=args.require_register,
         master_endpoints=args.master_endpoints,
+        router_endpoints=args.router_endpoints,
+        advertise_host=args.advertise_host,
+        stall_fence_s=args.stall_fence_s,
     ).start()
     stop_evt = threading.Event()
     _signal.signal(_signal.SIGTERM, lambda *_: stop_evt.set())

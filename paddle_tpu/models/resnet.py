@@ -11,7 +11,17 @@ from typing import Optional, Tuple
 
 from paddle_tpu.nn import costs as C
 from paddle_tpu.nn import layers as L
-from paddle_tpu.nn.graph import Layer
+from paddle_tpu.nn.graph import Layer, ParamAttr
+
+# LOGICAL sharding axes (ROADMAP item 3c): conv filters declare their
+# out-channel axis as "mlp" (the column-parallel vocabulary entry) and the
+# classifier head declares ("embed", "vocab") — the rules table
+# (parallel/rules.py) maps these to a 'model' mesh axis on a TP deployment
+# and replicates them on the data-only CPU mesh; model code names meanings,
+# never mesh axes. Conv kernels are HWIO: spatial + input-channel axes stay
+# unsharded (None).
+CONV_W_AXES = (None, None, None, "mlp")
+BN_AXES = ("mlp",)
 
 
 def conv_bn(
@@ -35,9 +45,16 @@ def conv_bn(
         padding=padding,
         act=None,
         bias=False,
+        param_attr=ParamAttr(logical_axes=CONV_W_AXES),
         name=f"{name}.conv",
     )
-    return L.BatchNorm(conv, act=act, name=f"{name}.bn")
+    return L.BatchNorm(
+        conv,
+        act=act,
+        param_attr=ParamAttr(logical_axes=BN_AXES),
+        bias_attr=ParamAttr(logical_axes=BN_AXES),
+        name=f"{name}.bn",
+    )
 
 
 def bottleneck(x: Layer, mid: int, out: int, stride: int, name: str) -> Layer:
@@ -84,7 +101,14 @@ def resnet(
             stride = 2 if (stage > 0 and blk == 0) else 1
             x = bottleneck(x, mid, out, stride, f"s{stage}b{blk}")
     pooled = L.GlobalPool(x, "avg", name="gap")
-    logits = L.Fc(pooled, num_classes, act=None, name="logits")
+    logits = L.Fc(
+        pooled,
+        num_classes,
+        act=None,
+        param_attr=ParamAttr(logical_axes=("embed", "vocab")),
+        bias_attr=ParamAttr(logical_axes=("vocab",)),
+        name="logits",
+    )
     cost = C.ClassificationCost(logits, label, name="cost")
     return img, label, logits, cost
 

@@ -33,15 +33,27 @@ class Seq2SeqModel:
         self.src = L.Data("source_ids", shape=(self.src_vocab,), is_seq=True)
         self.trg = L.Data("target_ids", shape=(self.trg_vocab,), is_seq=True)
         self.label = L.Data("label_ids", shape=(self.trg_vocab,), is_seq=True)
+        # LOGICAL sharding axes (ROADMAP item 3c): the embedding tables and
+        # the output projection — the parameters that dominate this model's
+        # bytes — declare ("vocab", "embed") / ("embed", "vocab"); the
+        # deployment's rules table (parallel/rules.py DEFAULT_RULES) decides
+        # whether that shards them over a 'model' mesh axis or replicates
+        # (the data-only CPU mesh) — no mesh-axis names in model code
         src_emb = L.Embedding(
-            self.src, self.embed_dim, vocab_size=self.src_vocab, name="src_emb"
+            self.src,
+            self.embed_dim,
+            vocab_size=self.src_vocab,
+            param_attr=ParamAttr(logical_axes=("vocab", "embed")),
+            name="src_emb",
         )
         self.encoder = bidirectional_gru(src_emb, self.hidden_dim, name="enc")
         self.trg_emb_layer = L.Embedding(
             self.trg,
             self.embed_dim,
             vocab_size=self.trg_vocab,
-            param_attr=ParamAttr(name="trg_emb_table"),
+            param_attr=ParamAttr(
+                name="trg_emb_table", logical_axes=("vocab", "embed")
+            ),
             name="trg_emb",
         )
         self.decoder = AttentionDecoder(
@@ -51,8 +63,8 @@ class Seq2SeqModel:
             self.decoder,
             self.trg_vocab,
             act=None,
-            param_attr=ParamAttr(name="out_w"),
-            bias_attr=ParamAttr(name="out_b"),
+            param_attr=ParamAttr(name="out_w", logical_axes=("embed", "vocab")),
+            bias_attr=ParamAttr(name="out_b", logical_axes=("vocab",)),
             name="out",
         )
         self.cost = C.ClassificationCost(self.logits, self.label, name="cost")

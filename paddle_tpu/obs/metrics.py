@@ -295,6 +295,57 @@ def observe_pages_recycled(n: int) -> None:
     ).inc(n)
 
 
+# -- router tier (ISSUE 15 multi-replica serving) -----------------------------
+
+
+def observe_replica_evicted(cause: str) -> None:
+    """The router evicted a replica lease; cause is 'lease' (heartbeats
+    stopped — death or a self-fenced wedge), 'conn' (dispatch/pump
+    connections dead), 'deregister' or 'drain_timeout'."""
+    REGISTRY.counter(
+        "paddle_tpu_router_replica_evictions_total",
+        "serving replicas evicted from the router fleet, by cause",
+    ).inc(cause=cause)
+
+
+def observe_replica_failover(cause: str) -> None:
+    """One in-flight request re-submitted to a survivor after its replica
+    was lost — re-execution is token-identical (pinned per-request seed)."""
+    REGISTRY.counter(
+        "paddle_tpu_router_failovers_total",
+        "in-flight requests failed over to a surviving replica, by cause",
+    ).inc(cause=cause)
+
+
+def observe_router_hedge() -> None:
+    """A token-less request past its TTFT hedge was duplicated onto a second
+    replica (first token wins, loser cancelled server-side)."""
+    REGISTRY.counter(
+        "paddle_tpu_router_hedges_total",
+        "cross-replica TTFT hedges launched by the router",
+    ).inc()
+
+
+def observe_late_result_dropped() -> None:
+    """A partitioned-then-healed replica answered a request the router had
+    already failed over: the late winner was dropped by the fleet dedup map
+    — the exactly-once counter the router chaos drill gates on."""
+    REGISTRY.counter(
+        "paddle_tpu_router_late_results_dropped_total",
+        "late replica results dropped by the fleet (tenant, request) dedup",
+    ).inc()
+
+
+def observe_router_shed(reason: str) -> None:
+    """The router shed a submit fleet-wide ('no_replicas', or 'overload'
+    when every live replica shed/was saturated) — always with the tightest
+    retry_after_ms any replica offered, never a hang."""
+    REGISTRY.counter(
+        "paddle_tpu_router_shed_total",
+        "submits shed by the router fleet-wide, by named reason",
+    ).inc(reason=reason)
+
+
 # -- heartbeat snapshots + fleet aggregation ---------------------------------
 
 

@@ -28,6 +28,8 @@ from paddle_tpu.serving.session import (
     ServingSession,
     make_demo_session,
 )
+from paddle_tpu.serving.fleet import FleetView, Replica, ReplicaAgent
+from paddle_tpu.serving.router import Router, RouterHandle, RouterServer
 
 __all__ = [
     "PagedKVCache",
@@ -41,4 +43,10 @@ __all__ = [
     "SERVING_EVENTS",
     "ServingSession",
     "make_demo_session",
+    "FleetView",
+    "Replica",
+    "ReplicaAgent",
+    "Router",
+    "RouterHandle",
+    "RouterServer",
 ]

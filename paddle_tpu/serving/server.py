@@ -15,7 +15,8 @@ request-routing plane):
     at `submit`/`generate` time; a rejection is an RPC-level error naming
     the reason, not a timeout.
 
-Methods: register | heartbeat | deregister | submit | poll | cancel |
+Methods: register | heartbeat | deregister | submit | poll | poll_many
+(the router pump's one-round-trip batch poll) | cancel |
 generate (blocking submit+wait) | stats. A config-driven `GenerationSession`
 can ride
 alongside the token engine (method `generate_config`) so v1-config golden
@@ -104,6 +105,9 @@ class ServingServer:
         require_register: bool = False,
         handle_ttl_s: float = 600.0,
         master_endpoints: Optional[EndpointsLike] = None,
+        router_endpoints: Optional[EndpointsLike] = None,
+        advertise_host: Optional[str] = None,
+        stall_fence_s: float = 5.0,
     ):
         if session is None and gen_session is None:
             raise ValueError("need a ServingSession and/or a GenerationSession")
@@ -153,6 +157,15 @@ class ServingServer:
         self._reaper: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._gen_lock = threading.Lock()
+        # fleet mode (ISSUE 15): with router_endpoints set, start() joins the
+        # router fleet as a replica — a ReplicaAgent registers this server's
+        # serving endpoint and renews the lease with load-snapshot heartbeats
+        # (self-fencing when the engine wedges, serving/fleet.py)
+        self.router_endpoints = router_endpoints
+        self.advertise_host = advertise_host
+        self.stall_fence_s = float(stall_fence_s)
+        self._agent = None
+        self._killed = False
 
     @property
     def address(self) -> tuple:
@@ -260,6 +273,35 @@ class ServingServer:
             # non-destructive: a lost response must be re-readable; the
             # reaper GCs finished handles after handle_ttl_s
             return self._completion(handle)
+        if method == "poll_many":
+            # the router pump's batch poll (ISSUE 15): ONE round trip answers
+            # for every in-flight request on this replica, so result delivery
+            # never costs an RPC per request per cycle ("RPC Considered
+            # Harmful" — and the shape ROADMAP item 4's batched control
+            # plane generalizes). Per-item tenancy: the router is a proxy
+            # for many tenants, so each item names the tenant it polls as.
+            out = []
+            for it in req.get("items", []):
+                try:
+                    rid = int(it["request_id"])
+                except (KeyError, TypeError, ValueError):
+                    out.append({"err": "bad request_id"})
+                    continue
+                with self._handles_lock:
+                    handle = self._handles.get(rid)
+                if handle is None:
+                    out.append({"request_id": rid, "err": "unknown"})
+                elif handle.tenant != self._tenant_for(it.get("tenant_id")):
+                    out.append({"request_id": rid, "err": "tenant"})
+                elif handle.done:
+                    out.append(dict(self._completion(handle),
+                                    request_id=rid))
+                else:
+                    out.append({
+                        "request_id": rid, "done": False,
+                        "tokens": list(handle.tokens),
+                    })
+            return {"results": out}
         if method == "generate_config":
             return self._generate_config(req)
         return {"err": f"unknown method {method!r}"}
@@ -412,10 +454,49 @@ class ServingServer:
         self._thread.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+        if self.router_endpoints is not None and self.session is not None:
+            from paddle_tpu.serving.fleet import ReplicaAgent
+
+            host, port = self.address
+            self._agent = ReplicaAgent(
+                self.router_endpoints, self.session,
+                advertise=(self.advertise_host or host, port),
+                stall_fence_s=self.stall_fence_s,
+            ).start()
         return self
 
-    def stop(self) -> None:
+    def kill(self) -> None:
+        """Crash semantics (chaos drills): sever the TCP front-end and the
+        fleet heartbeats abruptly — NO deregister, no drain — so the router
+        discovers the death the way it would a real process kill: dead
+        connections and a lapsed lease. Idempotent; safe before start()."""
+        if self._killed:
+            return
+        self._killed = True
         self._stop_evt.set()
+        if self._agent is not None:
+            self._agent.kill()
+
+        def _die():
+            try:
+                if self._thread is not None:
+                    self._srv.shutdown()
+                self._srv.server_close()
+            except OSError:
+                pass
+            if self.session is not None:
+                self.session.stop()
+
+        # sever off-thread: kill() must not block the drill behind the
+        # session supervisor's join (MasterServer.kill's idiom)
+        threading.Thread(target=_die, daemon=True).start()
+
+    def stop(self) -> None:
+        if self._killed:
+            return
+        self._stop_evt.set()
+        if self._agent is not None:
+            self._agent.stop()  # clean leave: deregister from the router
         if self._thread is not None:
             self._srv.shutdown()
         self._srv.server_close()
@@ -466,6 +547,7 @@ class ServingClient:
         self.tenant_id: Optional[str] = None
         self.lease_s: float = 30.0
         self.hedges = 0  # hedged retries issued (TTFT-deadline misses)
+        self.shed_retries = 0  # submits retried after a shed's retry_after_ms
 
     def register(self) -> str:
         resp = self._client.call("register")
@@ -488,6 +570,8 @@ class ServingClient:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         seed: Optional[int] = None,
+        max_retries: int = 2,
+        retry_sleep_cap_s: float = 2.0,
     ) -> dict:
         import time as _time
 
@@ -499,9 +583,29 @@ class ServingClient:
         kw = dict(deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
                   temperature=temperature, top_k=top_k, seed=seed,
                   client_req_id=key)
-        rid = self.submit(prompt, max_new_tokens, **kw)
         t0 = _time.monotonic()
         deadline = t0 + timeout_s
+        # shed → sleep-and-retry: a server shed carrying retry_after_ms is a
+        # promise, not a verdict — honor it (capped, and never past the
+        # caller's own timeout budget) up to max_retries times before
+        # surfacing Rejected. A shed without a hint stays terminal: the
+        # server said nothing about when retrying could work.
+        attempts = 0
+        while True:
+            try:
+                rid = self.submit(prompt, max_new_tokens, **kw)
+                break
+            except Rejected as e:
+                now = _time.monotonic()
+                if (e.retry_after_ms is None or attempts >= max_retries
+                        or now >= deadline):
+                    raise
+                attempts += 1
+                self.shed_retries += 1
+                _time.sleep(min(
+                    e.retry_after_ms / 1e3, retry_sleep_cap_s,
+                    max(0.0, deadline - now),
+                ))
         hedged = False
         while True:
             resp = self.poll(rid)

@@ -741,6 +741,22 @@ class ServingSession:
         return self.scheduler.cancel_tenant(tenant)
 
     # -- telemetry ----------------------------------------------------------
+    def progress_marker(self) -> tuple:
+        """A tuple that changes whenever the engine makes ANY observable
+        progress (decode steps, prefill chunks, retirements, cancellations).
+        The fleet ReplicaAgent compares successive markers to self-fence a
+        wedged engine: work pending + an unchanged marker past the fence
+        window + the engine parked between steps = stop claiming liveness
+        (serving/fleet.py)."""
+        sch = self.scheduler
+        return (
+            self.decode_steps,
+            self.prefill_chunks_committed,
+            sch.completed,
+            sch.cancelled,
+            self.engine_restarts,
+        )
+
     def decode_shape_signatures(self) -> int:
         """Distinct decode-step input signatures seen — 1 means the entire
         serving lifetime shared one compiled decode program."""

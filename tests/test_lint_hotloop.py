@@ -38,6 +38,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRAINER_PY = os.path.join(_REPO, "paddle_tpu", "trainer", "trainer.py")
 SERVING_PY = os.path.join(_REPO, "paddle_tpu", "serving", "session.py")
 SCHEDULER_PY = os.path.join(_REPO, "paddle_tpu", "serving", "scheduler.py")
+ROUTER_PY = os.path.join(_REPO, "paddle_tpu", "serving", "router.py")
 
 # calls that force a device sync when applied to a device array; jnp.* ops
 # (async, traced) are deliberately NOT matched — hence the lookbehinds
@@ -83,10 +84,17 @@ SPAN_CALL = re.compile(
 )
 SPAN_TAG = "span-ok"
 # (file, class, hot methods, max span-ok tags)
+#
+# ISSUE 15 added the router's dispatch/pump/reap surface: spans there are
+# per-ASSIGNMENT / per-FAILOVER / per-HEDGE (never per pump cycle — note
+# _pump_once is in the list precisely to keep it span-free), and the file-IO
+# + span-formatting bans below apply to those bodies too.
 SPAN_HOT_LOOPS = [
     (TRAINER_PY, "SGDTrainer", ("train", "_train_one_pass"), 2),
     (SERVING_PY, "ServingSession", ("_decode_once", "step", "_prefill_chunks"),
      2),
+    (ROUTER_PY, "Router",
+     ("_forward", "_failover_requests", "_reap_once", "_pump_once"), 3),
 ]
 HOT_IO_CALL = re.compile(r"(?<![\w.])open\(|\.write\(|json\.dump")
 SPAN_FMT = re.compile(
@@ -247,6 +255,15 @@ CLOCK_HOT_LOOPS = [
     (SCHEDULER_PY, "Scheduler",
      ("reap", "pop_admissions", "requeue_active", "retire"), 3),
     (SCHEDULER_PY, "ActiveSeq", ("append", "finished"), 1),
+    # router dispatch path (ISSUE 15): one read per submit (the admission
+    # stamp deadlines/hedge/park all derive from), one per pump cycle, one
+    # per reaper tick, and the per-EVENT stamps (eviction, failover batch,
+    # cancel, drain order, the evicted pump's grace check) — never one per
+    # request per cycle
+    (ROUTER_PY, "Router",
+     ("submit", "cancel", "drain", "_evict", "_failover_requests",
+      "_try_assign", "_choose_replica", "_forward", "_on_result",
+      "_pump_loop", "_pump_once", "_reap_once"), 8),
 ]
 
 
@@ -411,6 +428,59 @@ def test_updater_reshard_sites_tagged_and_pinned():
             f"{tagged} reshard-ok sites in {where}.{methods} (pinned "
             f"{count}): the sharded update's resharding structure changed — "
             "re-check the HLO collective pins and re-pin both"
+        )
+
+
+# -- router replica RPCs (ISSUE 15 multi-replica serving) ---------------------
+#
+# The router's whole reason to exist over "a proxy that asks each replica"
+# is that its DISPATCH decisions run on piggybacked state: load/health ride
+# replica heartbeats, results ride ONE batch poll per replica per pump
+# cycle, and the only blocking replica RPCs on the request path are the
+# submit forward itself, the pump's poll_many, and the cancel order (hedge
+# losers / client cancels). A per-request `.call(` anywhere else in the
+# assignment/pump/reap path is the "RPC Considered Harmful" regression this
+# lint pins — a fleet-size cap smuggled in as an innocent health probe.
+
+RPC_CALL = re.compile(r"\.call\(")
+RPC_TAG = "rpc-ok"
+# (file, class, dispatch-path methods, max rpc-ok tags)
+ROUTER_RPC_LOOPS = [
+    (ROUTER_PY, "Router",
+     ("submit", "_try_assign", "_choose_replica", "_forward", "_pump_once",
+      "_on_result", "_reap_once", "_failover_requests", "_send_cancels"), 3),
+]
+
+
+def test_no_untagged_replica_rpc_in_router_dispatch():
+    """Blocking replica RPCs in the router's assignment/pump/reap path must
+    be tagged: dispatch decisions read piggybacked state only, and the three
+    sanctioned calls (submit forward, batch poll, cancel order) name
+    themselves with `rpc-ok`."""
+    violations = []
+    for path, cls, methods, _budget in ROUTER_RPC_LOOPS:
+        v, _ = _scan(path, cls, methods, RPC_CALL, tag=RPC_TAG)
+        violations += v
+    assert not violations, (
+        "blocking replica RPC in the router dispatch path without an "
+        "`rpc-ok` tag — route the signal over replica heartbeats / the "
+        "pump's poll_many batch instead, or tag a genuinely per-event "
+        "(never per-request-per-cycle) site with `# rpc-ok: <why>`:\n  "
+        + "\n  ".join(violations)
+    )
+
+
+def test_sanctioned_router_rpc_sites_stay_rare():
+    """rpc-ok is a justification, not a loophole: the count is pinned so a
+    new blocking replica call in the dispatch path forces a review here."""
+    for path, cls, methods, budget in ROUTER_RPC_LOOPS:
+        _, tagged = _scan(path, cls, methods, RPC_CALL, tag=RPC_TAG)
+        assert len(tagged) <= budget, (
+            f"{len(tagged)} rpc-ok tags in the {cls} dispatch path "
+            f"(expected <= {budget}): a new sanctioned replica RPC was "
+            "added — confirm it is per-event (submit forward / batch poll "
+            "/ cancel), not per-request-per-cycle, and bump this bound "
+            "deliberately"
         )
 
 

@@ -1,0 +1,477 @@
+"""Multi-replica serving router (ISSUE 15).
+
+The load-bearing claims, each tested against REAL TCP replica servers:
+
+  * dispatch — requests route to the least-loaded live replica off
+    piggybacked heartbeat state, and tokens match the single-session oracle
+    (the router tier is result-invisible);
+  * fleet-wide shed — when every replica sheds, the router sheds with the
+    tightest retry_after_ms, and a router with no replicas sheds instead of
+    hanging;
+  * in-flight failover — a replica killed mid-stream has its outstanding
+    requests re-submitted to a survivor under the same idempotency key and
+    the SAME pinned seed, so re-execution is token-identical for greedy AND
+    sampled streams;
+  * exactly-once — the satellite pin: a partitioned-then-healed replica
+    answering a request the router already failed over is deduplicated (the
+    late winner dropped and counted), proven with two real servers;
+  * hedging — a token-less request past hedge_ttft_s is duplicated onto a
+    second replica; the first token wins and the loser is cancelled
+    server-side;
+  * planned drain — no new assignments, in-flight finishes, lease drops;
+  * client shed-retry — ServingClient.generate honors retry_after_ms with a
+    capped sleep-and-retry loop instead of surfacing Rejected on the first
+    shed (counted in client stats).
+
+Timing-sensitive tests use short leases + the deterministic wedge (parking
+the engine between steps on the session's generation lock) rather than
+sleeps-and-hope; every socket test carries the SIGALRM timeout marker."""
+
+import threading
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+VOCAB = 96
+
+PROMPT = [1, 5, 9, 11]
+
+
+def _wait(cond, timeout_s=15.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    import jax
+
+    from paddle_tpu.serving.model import LMConfig, ServableLM
+
+    model = ServableLM(
+        LMConfig(vocab=VOCAB, n_layers=2, d_model=32, n_heads=2, max_len=96)
+    )
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def make_session(model_and_params, **kw):
+    from paddle_tpu.serving.session import ServingSession
+
+    model, params = model_and_params
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_buckets", (8, 16, 32))
+    kw.setdefault("max_new_limit", 16)
+    return ServingSession(model, params, **kw)
+
+
+@pytest.fixture(scope="module")
+def reference(model_and_params):
+    """Oracle tokens from a direct single session: greedy and sampled."""
+    s = make_session(model_and_params)
+    greedy = s.submit(PROMPT, 8)
+    sampled = s.submit(PROMPT, 8, seed=77, temperature=0.8, top_k=8)
+    s.run_until_idle()
+    return {"greedy": greedy.tokens, "sampled": sampled.tokens}
+
+
+def make_fleet(model_and_params, n, lease_s=1.0, stall_fence_s=5.0,
+               session_kw=None, **router_kw):
+    """A RouterServer + n real TCP replica servers joined to it."""
+    from paddle_tpu.serving.router import RouterServer
+    from paddle_tpu.serving.server import ServingServer
+
+    router_kw.setdefault("poll_interval_s", 0.02)
+    router = RouterServer(lease_s=lease_s, **router_kw).start()
+    servers = []
+    for _ in range(n):
+        sess = make_session(model_and_params, **(session_kw or {}))
+        srv = ServingServer(
+            session=sess, router_endpoints=router.address,
+            stall_fence_s=stall_fence_s,
+        ).start()
+        servers.append((srv, sess))
+    assert _wait(lambda: len(router.fleet.live()) == n), "replicas must join"
+    return router, servers
+
+
+def stop_fleet(router, servers):
+    for srv, _ in servers:
+        srv.stop()
+    router.stop()
+
+
+# -- dispatch -----------------------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_router_end_to_end_result_invisible(model_and_params, reference):
+    """Through the router (real TCP, ServingClient) tokens match the direct
+    single-session oracle — the tier adds availability, not results."""
+    from paddle_tpu.serving.server import ServingClient
+
+    router, servers = make_fleet(model_and_params, 2)
+    try:
+        c = ServingClient(router.address)
+        out = c.generate(PROMPT, 8, timeout_s=60.0)
+        assert out["done"] and out["tokens"] == reference["greedy"]
+        st = c.stats()
+        assert st["live_replicas"] == 2 and st["completed"] >= 1
+        assert st["failovers"] == 0
+        c.close()
+    finally:
+        stop_fleet(router, servers)
+
+
+def test_fleet_choose_least_loaded():
+    """Assignment scoring is pure piggybacked state: occupancy normalized by
+    slot width, then the replica's own queue-wait estimate; registration
+    order breaks ties deterministically."""
+    from paddle_tpu.serving.fleet import FleetView
+
+    fleet = FleetView(lease_s=30.0)
+    a = fleet.register(("127.0.0.1", 1))
+    b = fleet.register(("127.0.0.1", 2))
+    assert fleet.choose().replica_id == a.replica_id  # idle tie -> index
+    a.load = {"queue_depth": 3, "active_slots": 4, "max_slots": 4,
+              "estimated_queue_wait_s": 0.5}
+    b.load = {"queue_depth": 0, "active_slots": 1, "max_slots": 4,
+              "estimated_queue_wait_s": 0.0}
+    assert fleet.choose().replica_id == b.replica_id
+    # the router's own in-flight books count too
+    b.outstanding.update(range(8))
+    assert fleet.choose().replica_id == a.replica_id
+    assert fleet.choose(exclude={a.replica_id}).replica_id == b.replica_id
+    assert fleet.choose(exclude={a.replica_id, b.replica_id}) is None
+
+
+@pytest.mark.timeout(120)
+def test_fleet_wide_shed_tightest_hint_never_hangs(model_and_params):
+    """Every replica saturated -> the router sheds with a retry_after_ms
+    hint (the tightest any replica offered) instead of hanging; a router
+    with NO replicas sheds immediately too."""
+    from paddle_tpu.serving.quota import QuotaExceeded
+    from paddle_tpu.serving.router import RouterServer
+
+    # max_queue=0: every replica-side submit sheds at the queue bound
+    router, servers = make_fleet(
+        model_and_params, 2, session_kw={"max_queue": 0}
+    )
+    try:
+        with pytest.raises(QuotaExceeded) as ei:
+            router.router.submit(PROMPT, 8)
+        assert ei.value.reason == "overload"
+        assert ei.value.retry_after_ms is not None
+        assert ei.value.retry_after_ms >= 1
+        assert router.router.shed == 1
+        # a shed leaves no fleet state behind
+        assert router.router.stats()["outstanding"] == 0
+    finally:
+        stop_fleet(router, servers)
+
+    empty = RouterServer(lease_s=1.0).start()
+    try:
+        with pytest.raises(QuotaExceeded) as ei:
+            empty.router.submit(PROMPT, 8)
+        assert ei.value.reason == "overload"
+        assert ei.value.retry_after_ms is not None
+    finally:
+        empty.stop()
+
+
+# -- failover -----------------------------------------------------------------
+
+
+def _wedge(session):
+    """Park the engine BETWEEN steps (it blocks acquiring the generation
+    lock before its next step): the deterministic stand-in for a stall —
+    requests stay in flight, nothing progresses, and releasing the lock
+    heals the replica. The session's own stall supervisor is configured
+    far above test timescales so only the ROUTER reacts."""
+    session._gen_lock.acquire()
+    return session._gen_lock
+
+
+@pytest.mark.timeout(120)
+@pytest.mark.parametrize("sampled", [False, True], ids=["greedy", "sampled"])
+def test_failover_replica_killed_mid_stream_token_identical(
+    model_and_params, reference, sampled
+):
+    """A replica killed with a request in flight: the router re-submits it
+    to the survivor under the same key + pinned seed — token-identical to
+    the oracle for greedy AND sampled streams."""
+    router, servers = make_fleet(
+        model_and_params, 2, lease_s=1.0,
+        session_kw={"engine_stall_timeout_s": 120.0},
+    )
+    try:
+        # wedge replica 0 (the idle tie-break target) so the request cannot
+        # finish before the kill lands
+        lock = _wedge(servers[0][1])
+        kw = (
+            dict(seed=77, temperature=0.8, top_k=8) if sampled else {}
+        )
+        h = router.router.submit(PROMPT, 8, **kw)
+        assert _wait(lambda: bool(h.assignments)), "must be assigned"
+        victim_id = next(iter(h.assignments))
+        victim = router.fleet.get(victim_id)
+        assert victim.index == 0
+        servers[0][0].kill()
+        toks = h.result(timeout=60.0)
+        lock.release()
+        assert toks == reference["sampled" if sampled else "greedy"]
+        assert h.failovers == 1
+        assert h.delivered_by != victim_id
+        assert router.router.failovers >= 1
+    finally:
+        servers[0][0].kill()  # idempotent
+        servers[1][0].stop()
+        router.stop()
+
+
+@pytest.mark.timeout(120)
+def test_late_winner_from_partitioned_replica_deduplicated(model_and_params,
+                                                          reference):
+    """THE exactly-once pin (satellite): replica A wedges past its lease
+    (its agent self-fences, the router evicts and fails the request over to
+    B, which delivers), then A HEALS and answers the same request — the
+    late winner must be dropped and counted, never double-delivered. Two
+    real servers, real TCP, real lease expiry."""
+    from paddle_tpu.serving.router import RouterServer
+    from paddle_tpu.serving.server import ServingServer
+
+    router = RouterServer(
+        lease_s=0.8, poll_interval_s=0.02, late_grace_s=30.0
+    ).start()
+    sess_a = make_session(model_and_params, engine_stall_timeout_s=120.0)
+    srv_a = ServingServer(
+        session=sess_a, router_endpoints=router.address, stall_fence_s=0.2
+    ).start()
+    sess_b = make_session(model_and_params)
+    srv_b = None
+    try:
+        assert _wait(lambda: len(router.fleet.live()) == 1)
+        # wedge A BEFORE the submit: the request queues there, parked
+        lock = _wedge(sess_a)
+        h = router.router.submit(PROMPT, 8)
+        assert _wait(lambda: bool(h.assignments))
+        a_id = next(iter(h.assignments))
+        # the survivor joins; A's agent self-fences (no progress), its lease
+        # lapses, and the router fails the request over to B
+        srv_b = ServingServer(
+            session=sess_b, router_endpoints=router.address,
+            stall_fence_s=30.0,
+        ).start()
+        toks = h.result(timeout=60.0)
+        assert toks == reference["greedy"]
+        assert h.failovers == 1 and h.delivered_by != a_id
+        assert router.fleet.get(a_id).state == "evicted"
+        dropped0 = router.router.late_results_dropped
+        assert dropped0 == 0
+        # HEAL the partition: A's engine resumes and completes the very
+        # request the router already delivered from B
+        lock.release()
+        assert _wait(
+            lambda: router.router.late_results_dropped == 1, timeout_s=30.0
+        ), "the late winner must be dropped and counted"
+        assert h.late_drops == 1
+        assert h.tokens == reference["greedy"], (
+            "the delivered result must be untouched by the late answer"
+        )
+        # exactly-once is also visible on the obs plane
+        from paddle_tpu.obs import metrics as obs_metrics
+
+        snap = obs_metrics.snapshot()
+        assert any(
+            k.startswith("paddle_tpu_router_late_results_dropped_total")
+            and v >= 1
+            for k, v in snap.items()
+        )
+        # the healed replica re-registers under a fresh lease and serves
+        assert _wait(
+            lambda: any(
+                r.state == "live" and r.replica_id != a_id
+                and r.endpoint == router.fleet.get(a_id).endpoint
+                for r in router.fleet.replicas()
+            ), timeout_s=15.0,
+        ), "a healed replica must rejoin under a fresh lease"
+    finally:
+        srv_a.stop()
+        if srv_b is not None:
+            srv_b.stop()
+        router.stop()
+
+
+@pytest.mark.timeout(120)
+def test_unplaceable_requests_fail_named_not_hang(model_and_params):
+    """Killing the LAST replica with work in flight: the request fails with
+    the named reason 'replica_lost' once the park window lapses — never a
+    silent hang."""
+    router, servers = make_fleet(
+        model_and_params, 1, lease_s=0.6,
+        session_kw={"engine_stall_timeout_s": 120.0},
+        park_give_up_s=1.0,
+    )
+    try:
+        _wedge(servers[0][1])
+        h = router.router.submit(PROMPT, 8)
+        assert _wait(lambda: bool(h.assignments))
+        servers[0][0].kill()
+        with pytest.raises(RuntimeError, match="replica_lost"):
+            h.result(timeout=60.0)
+        assert h.finish_reason == "replica_lost"
+    finally:
+        servers[0][0].kill()
+        router.stop()
+
+
+# -- hedging ------------------------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_hedge_first_token_wins_loser_cancelled(model_and_params, reference):
+    """A token-less request past hedge_ttft_s is duplicated onto the second
+    replica under the same key + seed; the first token wins, the loser is
+    cancelled server-side on its replica, and exactly one result lands."""
+    router, servers = make_fleet(
+        model_and_params, 2, lease_s=30.0, stall_fence_s=60.0,
+        session_kw={"engine_stall_timeout_s": 120.0},
+    )
+    try:
+        # hold replica 0's engine: the request it gets will sit token-less
+        # (lease stays alive — the fence window is far above test time, so
+        # HEDGING, not eviction, is what must rescue the request)
+        lock = _wedge(servers[0][1])
+        h = router.router.submit(PROMPT, 8, hedge_ttft_s=0.2)
+        assert _wait(lambda: bool(h.assignments))
+        first = next(iter(h.assignments))
+        assert router.fleet.get(first).index == 0
+        toks = h.result(timeout=60.0)
+        assert toks == reference["greedy"]
+        assert h.hedged and router.router.hedges == 1
+        assert h.delivered_by != first
+        # the loser was cancelled server-side on the wedged replica
+        lock.release()
+        assert _wait(
+            lambda: servers[0][1].scheduler.cancelled >= 1, timeout_s=15.0
+        ), "hedge loser must be cancelled on its replica"
+        assert router.router.late_results_dropped == 0
+    finally:
+        stop_fleet(router, servers)
+
+
+# -- planned drain ------------------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_drain_stops_assignments_finishes_in_flight_deregisters(
+    model_and_params, reference
+):
+    """`drain <replica>`: no new assignments land on it, in-flight streams
+    finish, then the lease drops (state 'drained') and the fleet serves on
+    without it — the autoscaling controller's shrink lever."""
+    router, servers = make_fleet(model_and_params, 2, lease_s=5.0)
+    try:
+        a_id = next(
+            r.replica_id for r in router.fleet.replicas() if r.index == 0
+        )
+        out = router.router.drain(a_id, deadline_s=30.0)
+        assert out.get("ok")
+        handles = [router.router.submit(PROMPT, 8) for _ in range(4)]
+        for h in handles:
+            assert h.result(timeout=60.0) == reference["greedy"]
+            assert h.delivered_by != a_id, "draining replica must get nothing"
+        # "drained" is transient: the idle pump closes right after, so the
+        # terminal observable state is drained-or-closed
+        assert _wait(
+            lambda: router.fleet.get(a_id).state in ("drained", "closed"),
+            timeout_s=15.0,
+        )
+        assert len(router.fleet.live()) == 1
+        assert router.router.drains_completed == 1
+        # new work still flows through the survivor
+        assert router.router.submit(PROMPT, 8).result(timeout=60.0) \
+            == reference["greedy"]
+    finally:
+        stop_fleet(router, servers)
+
+
+# -- client shed-retry (satellite) --------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_client_generate_honors_retry_after_ms(model_and_params):
+    """ServingClient.generate(max_retries=) converts a shed-with-hint into a
+    capped sleep-and-retry instead of surfacing Rejected on the first shed;
+    retries are counted in client stats. max_retries=0 keeps the old
+    fail-fast behavior."""
+    from paddle_tpu.serving.server import (
+        Rejected, ServingClient, ServingServer,
+    )
+
+    s = make_session(model_and_params, max_queue=1)
+    # hold the engine (serve_forever idempotence guard) so the queue stays
+    # full until the timer releases it — the first submit must shed
+    s._thread = threading.Thread(target=lambda: None)
+    srv = ServingServer(session=s).start()
+    try:
+        s.submit(PROMPT, 4)  # fills the queue (engine held)
+        # seed the service-time EWMA so the shed hint is a real wait, not
+        # the 10ms cold floor (the retry loop must actually sleep on it)
+        s.scheduler._ewma_service_s = 0.15
+        c = ServingClient(srv.address)
+        with pytest.raises(Rejected) as ei:
+            c.generate(PROMPT, 4, max_retries=0)
+        assert ei.value.retry_after_ms is not None
+        assert c.shed_retries == 0
+
+        def release():
+            time.sleep(0.3)
+            s._thread = None
+            s.serve_forever()
+
+        threading.Thread(target=release, daemon=True).start()
+        out = c.generate(PROMPT, 4, max_retries=10, timeout_s=60.0)
+        assert out["done"]
+        assert c.shed_retries >= 1, "the retry loop must have slept-and-retried"
+        c.close()
+    finally:
+        srv.stop()
+
+
+# -- poll_many (the pump's batch RPC) ----------------------------------------
+
+
+def test_poll_many_batches_and_scopes_tenancy(model_and_params):
+    """One poll_many round trip answers for N requests, each item checked
+    against ITS tenant — the router proxies many tenants over one pump
+    connection."""
+    from paddle_tpu.serving.server import ServingServer
+
+    s = make_session(model_and_params)
+    srv = ServingServer(session=s)
+    try:
+        r1 = srv.dispatch(
+            "submit", {"prompt": PROMPT, "max_new_tokens": 4}, "t1"
+        )["request_id"]
+        r2 = srv.dispatch(
+            "submit", {"prompt": PROMPT, "max_new_tokens": 4}, "t2"
+        )["request_id"]
+        s.run_until_idle()
+        out = srv.dispatch("poll_many", {"items": [
+            {"request_id": r1, "tenant_id": "t1"},
+            {"request_id": r2, "tenant_id": "t1"},   # wrong tenant
+            {"request_id": 999, "tenant_id": "t1"},  # unknown
+        ]}, None)["results"]
+        assert out[0]["done"] and out[0]["tokens"]
+        assert out[0]["request_id"] == r1
+        assert out[1]["err"] == "tenant"
+        assert out[2]["err"] == "unknown"
+    finally:
+        srv.stop()
