@@ -437,6 +437,77 @@ def run_serving(cpu_fallback: bool) -> dict:
     }
 
 
+def run_serving_speculative() -> list:
+    """Speculative-decoding leg (ISSUE 16): ONE stream — the case batching
+    cannot speed up — over high-overlap repeated-motif prompts, speculate_k
+    on vs off over identical geometry. Two cross-round metrics ride out:
+    `serving_single_stream_tokens_per_sec` (with the speedup-vs-non-
+    speculative column) and `serving_spec_acceptance_rate` (drafted tokens
+    the verify pass accepted — the workload-dependent number the speedup is
+    a function of). The full gated A/B lives in benchmarks/serving_bench.py;
+    this is the cheap tracked slice."""
+    import jax
+
+    from paddle_tpu.serving.session import make_demo_session
+    from paddle_tpu.serving.workload import (
+        make_prompts, make_repetitive_prompts, run_closed_loop,
+    )
+
+    vocab = int(os.environ.get("BENCH_SPEC_VOCAB", "32"))
+    k = int(os.environ.get("BENCH_SPEC_K", "8"))
+    max_new = int(os.environ.get("BENCH_SPEC_MAX_NEW", "64"))
+    requests = int(os.environ.get("BENCH_SPEC_REQUESTS", "8"))
+    prompts = make_repetitive_prompts(
+        requests, motif_len=4, repeats=6, vocab=vocab, bos_id=1, seed=3,
+    )
+    warm = make_prompts(2, lengths=(16, 32), vocab=vocab, bos_id=1, seed=7)
+    warm += make_repetitive_prompts(
+        1, motif_len=4, repeats=6, vocab=vocab, bos_id=1, seed=11,
+    )
+
+    def measure(speculate_k):
+        session = make_demo_session(
+            vocab=vocab, n_layers=2, d_model=64, n_heads=2, seed=0,
+            max_slots=4, page_size=16, prefill_buckets=(16, 32),
+            max_new_limit=max_new, speculate_k=speculate_k,
+        )
+        run_closed_loop(session, warm, max_new, concurrency=len(warm))
+        session.scheduler.reset_load_estimate()
+        res = run_closed_loop(session, prompts, max_new, concurrency=1)
+        return res, session.stats()
+
+    base, _ = measure(0)
+    spec, st = measure(k)
+    speedup = (
+        round(spec["tokens_per_sec"] / base["tokens_per_sec"], 2)
+        if base["tokens_per_sec"] else 0.0
+    )
+    platform = jax.devices()[0].platform
+    return [
+        {
+            "metric": "serving_single_stream_tokens_per_sec",
+            "value": spec["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": speedup,
+            "speedup_vs_non_speculative": speedup,
+            "non_speculative_tokens_per_sec": base["tokens_per_sec"],
+            "speculate_k": k,
+            "platform": platform,
+            "requests": requests,
+            "max_new_tokens": max_new,
+        },
+        {
+            "metric": "serving_spec_acceptance_rate",
+            "value": st["spec_acceptance_rate"],
+            "unit": "accepted/drafted",
+            "spec_rounds": st["spec_rounds"],
+            "spec_tokens_drafted": st["spec_tokens_drafted"],
+            "verify_shape_signatures": st["verify_shape_signatures"],
+            "platform": platform,
+        },
+    ]
+
+
 def run_serving_tp() -> dict:
     """Tensor-parallel serving leg (ISSUE 12): the SAME demo-LM geometry
     served single-chip and at TP=N (N = 4 when the host exposes >= 4
@@ -730,6 +801,11 @@ def run_bench(cpu_fallback: bool) -> dict:
     except Exception as exc:  # noqa: BLE001 — serving must not kill the headline
         sys.stderr.write(f"[bench] serving leg failed: {exc!r}\n")
         out["serving_error"] = repr(exc)[-400:]
+    try:
+        out["metrics"].extend(run_serving_speculative())
+    except Exception as exc:  # noqa: BLE001 — spec leg must not kill the headline
+        sys.stderr.write(f"[bench] serving speculative leg failed: {exc!r}\n")
+        out["serving_spec_error"] = repr(exc)[-400:]
     # LAST on purpose: this leg detaches the persistent compile cache (it
     # executes multi-device programs — see run_serving_tp docstring)
     try:

@@ -707,6 +707,84 @@ def serving_sampling_replay_leg(args, backend: str) -> dict:
     }
 
 
+def serving_spec_replay_leg(args, backend: str) -> dict:
+    """ISSUE 16: SPECULATIVE decode must stay result-transparent across an
+    engine crash — the fault fires mid-speculation (the `decode_raise` site
+    inside `_speculate`), the supervisor replays, and because drafting is a
+    pure function of each request's committed tokens and acceptance samples
+    through the same (seed, emitted-token-index) keys, the faulted run's
+    SAMPLED tokens are bitwise-equal to an unfaulted speculative run's.
+    Repetitive prompts make the drafter actually fire (gated: a leg where
+    speculation never ran proves nothing)."""
+    import time as _time
+
+    from paddle_tpu.core import faults
+
+    # self-similar prompts: the prompt-lookup drafter needs n-gram repeats
+    rng = __import__("numpy").random.RandomState(args.seed)
+    prompts = []
+    for i in range(args.serving_requests):
+        motif = [int(t) for t in rng.randint(3, 128, size=3)]
+        prompts.append(([1] + motif * 4)[: 5 + (i % 4) * 3])
+
+    def run(spec):
+        s = _serving_session(
+            args, engine_stall_timeout_s=args.serving_stall_timeout_s,
+            engine_restart_max=5, speculate_k=args.serving_speculate_k,
+        )
+        handles = []
+        s.serve_forever()
+        inj_cm = faults.inject(spec, seed=args.seed) if spec else None
+        try:
+            if inj_cm is not None:
+                inj = inj_cm.__enter__()
+            for i, p in enumerate(prompts):
+                handles.append(s.submit(
+                    p, args.serving_max_new, tenant=f"tenant{i % 3}",
+                    deadline_s=120.0, temperature=0.8, top_k=20,
+                ))
+                _time.sleep(args.serving_submit_gap_ms / 1e3)
+            deadline = _time.time() + 120
+            for h in handles:
+                h._event.wait(max(0.1, deadline - _time.time()))
+            fired = dict(inj.fired) if inj_cm is not None else {}
+        finally:
+            if inj_cm is not None:
+                inj_cm.__exit__(None, None, None)
+        st = s.stats()
+        s.stop()
+        return ([h.tokens for h in handles],
+                [h.finish_reason for h in handles], fired,
+                s.engine_restarts, st)
+
+    clean_toks, _, _, _, clean_st = run(None)
+    spec = f"decode_raise:step={args.serving_kill_step}"
+    fault_toks, reasons, fired, restarts, fault_st = run(spec)
+    named = _named_reasons()
+    bitwise = clean_toks == fault_toks
+    spec_ran = (clean_st["spec_rounds"] >= 1
+                and fault_st["spec_rounds"] >= 1)
+    return {
+        "spec": spec,
+        "platform": backend,
+        "temperature": 0.8,
+        "top_k": 20,
+        "speculate_k": args.serving_speculate_k,
+        "fault_fired": fired.get("decode_raise", 0),
+        "engine_restarts": restarts,
+        "spec_rounds": fault_st["spec_rounds"],
+        "spec_acceptance_rate": fault_st["spec_acceptance_rate"],
+        "speculation_exercised": bool(spec_ran),
+        "spec_replay_bitwise_equal": bool(bitwise),
+        "all_named": all(r in named for r in reasons),
+        "all_gates_pass": bool(
+            bitwise and spec_ran and restarts >= 1
+            and fired.get("decode_raise", 0) >= 1
+            and all(r in named for r in reasons)
+        ),
+    }
+
+
 def serving_overload_leg(args, backend: str) -> dict:
     """Capacity closed-loop, then open-loop at 1× and 2× capacity with
     deadlines armed: the goodput-retention gate (2× within 20% of the
@@ -1008,6 +1086,9 @@ def run_serving(args) -> dict:
     # ISSUE 11: crash replay must stay bitwise WITH sampling enabled (the
     # per-request seed + token-step key contract)
     legs["sampling_replay"] = serving_sampling_replay_leg(args, backend)
+    # ISSUE 16: crash mid-SPECULATION must also replay bitwise at
+    # temperature > 0 (drafting is a pure function of committed tokens)
+    legs["spec_replay"] = serving_spec_replay_leg(args, backend)
     overload = serving_overload_leg(args, backend)
     # the resilience counters must be READABLE off the obs plane — the same
     # registry the serving `metrics` RPC serves
@@ -1098,6 +1179,9 @@ def main():
     ap.add_argument("--serving_submit_gap_ms", type=float, default=15.0,
                     help="serving mode: arrival spacing in the crash legs so "
                          "the fault lands mid-stream under sustained load")
+    ap.add_argument("--serving_speculate_k", type=int, default=4,
+                    help="serving mode: draft length for the spec_replay "
+                         "leg (crash mid-speculation, bitwise replay gate)")
     ap.add_argument("--serving_kill_step", type=int, default=4,
                     help="serving mode: decode-step hit on which the "
                          "decode_raise/engine_stall fault fires (seeded)")

@@ -870,6 +870,17 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
                         "mid-stream never stalls running streams' inter-token "
                         "latency; also lifts the bucket cap on prompt length "
                         "(any prompt up to the model's max_len is admissible)")
+    p.add_argument("--speculate_k", type=int, default=0,
+                   help="prompt-lookup speculative decoding (0 = off): draft "
+                        "up to K continuation tokens per request per step "
+                        "from the request's own committed n-grams and score "
+                        "them all in ONE fixed-shape [1,K+1] verify call — "
+                        "the matched prefix commits, the first divergent "
+                        "token comes free from the verify logits, so "
+                        "high-overlap streams advance several tokens per "
+                        "step; tokens are identical to --speculate_k 0 "
+                        "(greedy AND seeded sampling: acceptance replays "
+                        "through the per-emitted-token key fold)")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="default sampling temperature for requests that do "
                         "not set one (0 = greedy argmax); sampling is "
@@ -994,6 +1005,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             num_pages=args.num_pages or None,
             prefill_buckets=buckets,
             prefill_chunk=args.prefill_chunk or None,
+            speculate_k=args.speculate_k,
             default_temperature=args.temperature,
             default_top_k=args.top_k,
             max_new_limit=args.max_new_limit,

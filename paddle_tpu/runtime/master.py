@@ -1295,6 +1295,40 @@ class MasterClient:
             f"{type(last_err).__name__}: {last_err})"
         ) from last_err
 
+    def call_stream(self, method: str, **kw) -> Iterator[dict]:
+        """One request whose reply is a FRAME STREAM (serving push
+        streaming, ISSUE 16): the request and its FIRST reply line go
+        through the normal reconnect/backoff path, then every subsequent
+        line on the same connection is yielded as a frame until one
+        carries `done` (or the first reply was an error). Delivered
+        frames are never replayed — a mid-stream failure raises
+        ConnectionError and resumable callers reattach with their token
+        cursor (the serving `from` cursor), on a FRESH call. The
+        connection is reusable after a clean `done`; an abandoned or
+        broken stream drops it (frames may still be buffered)."""
+        first = self._call(method, kw)
+        yield first
+        if "err" in first:
+            return
+        clean = False
+        try:
+            for line in self._rfile:
+                try:
+                    frame = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise ConnectionError(f"bad stream frame: {e}") from e
+                if frame.get("done"):
+                    clean = True
+                    yield frame
+                    return
+                yield frame
+            raise ConnectionError("stream closed before its final frame")
+        except OSError as e:
+            raise ConnectionError(f"stream broke mid-flight: {e}") from e
+        finally:
+            if not clean:
+                self.close()
+
     def close(self) -> None:
         if self._sock is not None:
             try:

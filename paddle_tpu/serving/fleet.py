@@ -85,6 +85,13 @@ class Replica:
         self.failovers = 0
         self.late_results_dropped = 0
         self.conn_failures = 0
+        # delta-poll cursors (ISSUE 16): fleet rid -> how many tokens of
+        # that request THIS replica has already sent us, so each pump cycle
+        # re-reads only the unseen suffix. Keyed per replica (a failover
+        # target starts at 0 and re-sends the full mirror) and dropped with
+        # the rids entry; purely an optimization — a lost cursor just means
+        # one full-width reply
+        self.poll_cursors: Dict[int, int] = {}
         self.evicted_at: Optional[float] = None
         self.drain_deadline: Optional[float] = None
         # set once the drain completed: the next heartbeat reply tells the

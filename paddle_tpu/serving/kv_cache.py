@@ -123,6 +123,24 @@ class PagedKVCache:
         self._table[slot, : len(pages)] = pages
         return pages
 
+    def trim(self, slot: int, total_len: int) -> int:
+        """Return the slot's surplus tail pages beyond what `total_len`
+        tokens need (speculative-decode rollback, ISSUE 16): admission
+        reserves `speculate_k` tokens of headroom so a verify chunk can
+        always scatter its K+1 positions, and once the request's remaining
+        budget can no longer use that headroom the surplus recycles here
+        instead of riding to retirement. Returns how many pages were freed;
+        idempotent (trimming to the current size is a no-op)."""
+        pages = self._slot_pages[slot]
+        keep = self.pages_needed(total_len)
+        if not pages or keep >= len(pages):
+            return 0
+        surplus = pages[keep:]
+        self._slot_pages[slot] = pages[:keep]
+        self._free.extend(surplus)
+        self._table[slot, keep:] = 0
+        return len(surplus)
+
     def release(self, slot: int) -> int:
         """Return the slot's pages to the free list (KV recycling); returns
         how many were freed. Idempotent for an empty slot."""

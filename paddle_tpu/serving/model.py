@@ -410,6 +410,41 @@ class ServableLM:
         Returns (k_pages, v_pages, tok [1] int32 — sampled at position
         length-1, meaningful only on the final chunk; the host fetches it
         exactly once, there)."""
+        b, c = tokens.shape
+        logits, kc, vc = self._chunk_forward(
+            params, k_pages, v_pages, tokens, starts, block_rows
+        )
+        # last valid position falls in this chunk only on the final chunk;
+        # clamp keeps the index in range for the earlier ones (tok unused)
+        last_in_chunk = jnp.clip(lengths - 1 - starts, 0, c - 1)
+        last = jnp.take_along_axis(
+            logits, last_in_chunk[:, None, None], axis=1
+        )[:, 0]
+        tok = self._sample(
+            last, seeds, jnp.zeros_like(lengths), temps, top_ks
+        )
+        k_pages, v_pages = self.commit_prefill(
+            k_pages, v_pages, kc, vc, lengths, block_rows, starts,
+        )
+        return k_pages, v_pages, tok
+
+    def _chunk_forward(
+        self,
+        params,
+        k_pages: Array,      # [L, NP, PS, KD]
+        v_pages: Array,
+        tokens: Array,       # [1, C] int32
+        starts: Array,       # [1] int32 — position of tokens[:, 0]
+        block_rows: Array,   # [1, max_pages_per_seq] int32
+    ) -> Tuple[Array, Array, Array]:
+        """The ONE chunk-shaped forward: attention = (already-committed
+        pages, masked to positions < start) ++ (causal within the chunk).
+        Shared by `prefill_chunk` (long-prompt prefill) and `verify_chunk`
+        (speculative-decode scoring, ISSUE 16), so the two cannot drift —
+        the verify call literally IS a prefill-chunk forward over
+        [last_token, draft_1..K]. Returns (logits [1, C, V], kc, vc
+        [L, 1, C, KD]); the pools are only READ here — each caller commits
+        through `commit_prefill` itself."""
         cfg = self.cfg
         b, c = tokens.shape
         h_, hd = cfg.n_heads, cfg.head_dim
@@ -453,20 +488,64 @@ class ServableLM:
             x = self._mlp(params, i, x)
         # replicated logits: the one all-gather, sampling collective-free
         logits = self._constrain(_rms(x, params["lnf"]) @ params["unembed"])
-        # last valid position falls in this chunk only on the final chunk;
-        # clamp keeps the index in range for the earlier ones (tok unused)
-        last_in_chunk = jnp.clip(lengths - 1 - starts, 0, c - 1)
-        last = jnp.take_along_axis(
-            logits, last_in_chunk[:, None, None], axis=1
-        )[:, 0]
-        tok = self._sample(
-            last, seeds, jnp.zeros_like(lengths), temps, top_ks
+        return logits, jnp.stack(kcs), jnp.stack(vcs)
+
+    # -- speculative decoding (ISSUE 16) ------------------------------------
+    def verify_chunk(
+        self,
+        params,
+        k_pages: Array,      # [L, NP, PS, KD] (donated: chunk KV commits here)
+        v_pages: Array,
+        tokens: Array,       # [1, K+1] int32: last committed token + K drafts
+        starts: Array,       # [1] int32 — position of the last committed token
+        block_rows: Array,   # [1, max_pages_per_seq] int32 — the slot's row
+        seeds: Array,        # [1] uint32 — the request's sampling seed
+        steps0: Array,       # [1] int32 — emitted-token index of sampled[0]
+        temps: Array,        # [1] f32
+        top_ks: Array,       # [1] int32
+    ) -> Tuple[Array, Array, Array]:
+        """Score K drafted tokens in ONE prefill-chunk-shaped call
+        (prompt-lookup speculative decoding, ISSUE 16). The chunk is
+        [last_token, draft_1..K] at positions [starts .. starts+K]: the
+        logits at chunk position i are exactly what a sequential decode
+        would see after emitting drafts 1..i, so `sampled[i]` is the token
+        the model WOULD emit there — the host accepts draft_{i+1} while
+        sampled[i] == draft_{i+1} and takes the first divergent token free.
+
+        The replay/determinism contract is carried by `steps0`: position i
+        samples through fold_in(PRNGKey(seed), steps0 + i) — keyed by the
+        EMITTED TOKEN INDEX, never the engine step — so a crash replay or
+        router failover that re-runs speculation from the prompt re-draws
+        the same keys in the same order and regenerates bitwise-identical
+        tokens even at temperature > 0.
+
+        All K+1 positions' K/V commit into the slot's pages here (fused,
+        pools donated — the prefill_chunk convention). Rejected positions
+        leave stale K/V behind, which is harmless by construction: every
+        attention mask excludes positions at/after the committed frontier
+        (`ctx_idx < starts` here, `ctx_idx <= positions` in decode), and
+        the next verify/decode step REWRITES each position before it can
+        become visible. Returns (k_pages, v_pages, sampled [K+1] int32)."""
+        b, c = tokens.shape
+        logits, kc, vc = self._chunk_forward(
+            params, k_pages, v_pages, tokens, starts, block_rows
         )
+        lane = jnp.arange(c, dtype=jnp.int32)
+        sampled = self._sample(
+            logits[0],                                   # [K+1, V]
+            jnp.broadcast_to(seeds, (c,)),
+            steps0 + lane,                               # emitted-token index
+            jnp.broadcast_to(temps, (c,)),
+            jnp.broadcast_to(top_ks, (c,)),
+        )
+        # commit every chunk position (lengths = starts + K + 1): positions
+        # past the slot's reserved pages fall through the block-table row's
+        # zero entries into dump page 0, so over-speculation near the budget
+        # end can never corrupt a neighbour
         k_pages, v_pages = self.commit_prefill(
-            k_pages, v_pages, jnp.stack(kcs), jnp.stack(vcs),
-            lengths, block_rows, starts,
+            k_pages, v_pages, kc, vc, starts + c, block_rows, starts,
         )
-        return k_pages, v_pages, tok
+        return k_pages, v_pages, sampled
 
     # -- page pool plumbing -------------------------------------------------
     def commit_prefill(

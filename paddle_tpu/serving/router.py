@@ -216,6 +216,12 @@ class Router:
         self._pumps: List[threading.Thread] = []
         self._stop = threading.Event()
         self._reaper: Optional[threading.Thread] = None
+        # push-streaming seam (ISSUE 16): pumps bump this sequence whenever
+        # a mirror grows or finishes; RouterServer pusher threads diff the
+        # handle's token mirror and write frames on their own time — the
+        # pump threads never touch a client socket
+        self._stream_cv = threading.Condition()
+        self._stream_seq = 0
         # fleet counters (also exported via obs metrics)
         self.submitted = 0
         self.completed = 0
@@ -409,6 +415,22 @@ class Router:
         with self._lock:
             return self._handles.get(int(request_id))
 
+    def _notify_streams(self) -> None:
+        """Wake RouterServer frame pushers: a mirror advanced or a handle
+        reached a terminal state (same contract as the session's engine-step
+        bump — no socket writes happen here)."""
+        with self._stream_cv:
+            self._stream_seq += 1
+            self._stream_cv.notify_all()
+
+    def stream_wait(self, seq: int, timeout: float = 0.25) -> int:
+        """Block (pusher side) until the mirrors advance past `seq` or the
+        timeout elapses; returns the current sequence."""
+        with self._stream_cv:
+            if self._stream_seq == seq:
+                self._stream_cv.wait(timeout)
+            return self._stream_seq
+
     def cancel(self, request_id: int) -> bool:
         # clock-ok: once per client CANCEL order, not on any per-step path
         now = time.monotonic()
@@ -424,6 +446,7 @@ class Router:
             self._unassigned.discard(h.request_id)
         self._send_cancels(cancels)
         h._event.set()
+        self._notify_streams()
         return True
 
     def stats(self) -> Dict[str, Any]:
@@ -464,6 +487,7 @@ class Router:
             finished = h._finish_locked(RouterHandle.CANCELLED, reason, now)
         if finished:
             h._event.set()
+            self._notify_streams()
 
     def _discard(self, h: RouterHandle, now: Optional[float] = None) -> None:
         """Remove a front-door-shed (or bad) request from the fleet books —
@@ -480,6 +504,7 @@ class Router:
             )
         if finished:
             h._event.set()
+            self._notify_streams()
 
     def _submit_client(self, rep: Replica) -> Tuple[threading.Lock, MasterClient]:
         with self._lock:
@@ -594,6 +619,7 @@ class Router:
             if rep is not None:
                 rep.outstanding.discard(h.request_id)
                 rep.rids.pop(h.request_id, None)
+                rep.poll_cursors.pop(h.request_id, None)
             cancels.append((rep_id, rrid, h.tenant))
             del h.assignments[rep_id]
         return cancels
@@ -720,15 +746,20 @@ class Router:
         (no RPC happened — proves nothing about the connection)."""
         with self._lock:
             pairs = [
-                (rid, rrid, self._handles[rid].tenant)
+                (rid, rrid, self._handles[rid].tenant,
+                 rep.poll_cursors.get(rid, 0))
                 for rid, rrid in rep.rids.items()
                 if rid in self._handles
             ]
         if not pairs:
             return None
+        # delta poll (ISSUE 16): each item names the cursor this pump
+        # already folded, so steady-state cycles move O(new tokens) per
+        # request instead of O(all tokens) — the replica clamps a stale
+        # cursor back to a full reply, so this is never a correctness seam
         items = [
-            {"request_id": rrid, "tenant_id": tenant}
-            for _, rrid, tenant in pairs
+            {"request_id": rrid, "tenant_id": tenant, "from": cur}
+            for _, rrid, tenant, cur in pairs
         ]
         try:
             # rpc-ok: the sanctioned batch poll — per pump CYCLE per
@@ -743,7 +774,7 @@ class Router:
         for entry in resp.get("results", []):
             if isinstance(entry, dict) and "request_id" in entry:
                 by_rrid[int(entry["request_id"])] = entry
-        for rid, rrid, _tenant in pairs:
+        for rid, rrid, _tenant, _cur in pairs:
             entry = by_rrid.get(rrid)
             if entry is not None:
                 self._on_result(rep, rid, entry, now)
@@ -756,12 +787,14 @@ class Router:
         later one (the failed-over original finally answering) is dropped
         and counted."""
         delivered = False
+        grew = False
         cancels: List[Tuple[str, int, str]] = []
         late = False
         with self._lock:
             h = self._handles.get(rid)
             if h is None:
                 rep.rids.pop(rid, None)
+                rep.poll_cursors.pop(rid, None)
                 rep.outstanding.discard(rid)
                 return
             if entry.get("err"):
@@ -769,6 +802,7 @@ class Router:
                 # handle GC): that assignment is void — re-place unless a
                 # partner still runs it
                 rep.rids.pop(rid, None)
+                rep.poll_cursors.pop(rid, None)
                 rep.outstanding.discard(rid)
                 h.assignments.pop(rep.replica_id, None)
                 if not h._finished and not h.assignments:
@@ -776,13 +810,34 @@ class Router:
                         h.t_parked = now
                     self._unassigned.add(rid)
                 return
-            toks = entry.get("tokens") or []
+            toks = [int(t) for t in (entry.get("tokens") or [])]
             if not entry.get("done"):
+                base = entry.get("from")
+                # advance this pump's cursor to what the replica now holds
+                # (a delta reply echoes tokens_so_far; a legacy full reply
+                # just counts its tokens)
+                rep.poll_cursors[rid] = (
+                    int(entry.get("tokens_so_far", len(toks)))
+                    if base is not None else len(toks)
+                )
                 if toks and not h._finished:
-                    h.tokens = [int(t) for t in toks]
-                    if h.t_first_token is None:
-                        h.t_first_token = now
-                    if len(h.assignments) > 1:
+                    if base is None:
+                        merged = toks  # legacy full-list reply
+                    elif int(base) > len(h.tokens):
+                        # cursor ran ahead of the mirror (stale books):
+                        # drop the gapped suffix and refetch full next cycle
+                        merged = None
+                        rep.poll_cursors[rid] = 0
+                    else:
+                        merged = h.tokens[: int(base)] + toks
+                    # grow-only: the mirror is a prefix-consistent record —
+                    # a slower replica's shorter view never rolls it back
+                    if merged is not None and len(merged) > len(h.tokens):
+                        h.tokens = merged
+                        grew = True
+                        if h.t_first_token is None:
+                            h.t_first_token = now
+                    if grew and len(h.assignments) > 1:
                         # first token wins: cancel the hedge loser(s)
                         winner = rep.replica_id
                         for rep_id, rrid in list(h.assignments.items()):
@@ -792,10 +847,12 @@ class Router:
                             if other is not None:
                                 other.outstanding.discard(rid)
                                 other.rids.pop(rid, None)
+                                other.poll_cursors.pop(rid, None)
                             cancels.append((rep_id, rrid, h.tenant))
                             del h.assignments[rep_id]
             else:
                 rep.rids.pop(rid, None)
+                rep.poll_cursors.pop(rid, None)
                 rep.outstanding.discard(rid)
                 h.assignments.pop(rep.replica_id, None)
                 status = (
@@ -828,6 +885,8 @@ class Router:
             self._send_cancels(cancels)
         if delivered:
             h._event.set()
+        if delivered or grew:
+            self._notify_streams()
 
     # -- reaper --------------------------------------------------------------
     def _reap_loop(self) -> None:
@@ -863,6 +922,7 @@ class Router:
                         # otherwise the straggler runs twice and its
                         # eventual completion miscounts as a late winner
                         rrid = rep.rids.pop(rid, None)
+                        rep.poll_cursors.pop(rid, None)
                         h = self._handles.get(rid)
                         if rrid is not None and h is not None:
                             cancels.append((rep.replica_id, rrid, h.tenant))
@@ -962,6 +1022,8 @@ class RouterServer:
         self._srv.daemon_threads = True
         self._srv.ctx = self  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+        self.stream_frames = 0
+        self._stream_lock = threading.Lock()
 
     @property
     def address(self) -> tuple:
@@ -1000,6 +1062,7 @@ class RouterServer:
         if method == "stats":
             out = r.stats()
             out["live_tenants"] = self.membership.live
+            out["stream_frames_pushed"] = self.stream_frames
             return out
         if method == "metrics":
             return {"text": obs_metrics.to_prometheus_text()}
@@ -1025,7 +1088,14 @@ class RouterServer:
                 # router's internal exception class
                 return {"err": str(e)}
             if method == "submit":
-                return {"request_id": h.request_id}
+                out = {"request_id": h.request_id}
+                if req.get("stream"):
+                    # push streaming THROUGH the router (ISSUE 16): frames
+                    # follow on this connection as the pump advances the
+                    # mirror; the pump's poll stays authoritative
+                    out["stream"] = True
+                    out["_stream"] = (h, 0)
+                return out
             try:
                 h.result(timeout=float(req.get("timeout_s", 120.0)),
                          cancel_on_timeout=False)
@@ -1037,7 +1107,9 @@ class RouterServer:
             except RuntimeError:
                 pass  # cancelled: _completion names the reason
             return dict(self._completion(h), request_id=h.request_id)
-        if method in ("poll", "cancel"):
+        if method in ("poll", "cancel", "stream"):
+            from paddle_tpu.serving.server import clamp_cursor
+
             h = r.get_handle(int(req["request_id"]))
             if h is None:
                 return {"err": f"unknown request_id {req['request_id']}"}
@@ -1045,10 +1117,17 @@ class RouterServer:
                 return {"err": "request belongs to another tenant"}
             if method == "cancel":
                 return {"cancelled": r.cancel(h.request_id), "done": h.done}
+            if method == "stream":
+                cur = clamp_cursor(req.get("from"), len(h.tokens))
+                return {
+                    "request_id": h.request_id, "stream": True,
+                    "from": cur, "_stream": (h, cur),
+                }
             if not h.done:
                 toks = list(h.tokens)
+                cur = clamp_cursor(req.get("from"), len(toks))
                 return {"done": False, "tokens_so_far": len(toks),
-                        "tokens": toks}
+                        "tokens": toks[cur:], "from": cur}
             return self._completion(h)
         return {"err": f"unknown method {method!r}"}
 
@@ -1060,6 +1139,23 @@ class RouterServer:
             "finish_reason": h.finish_reason,
             "cancelled": h.status == RouterHandle.CANCELLED,
         }
+
+    # -- push-stream plumbing (shared with server._Handler._push_frames) ----
+    def stream_wait(self, seq: int, timeout: float = 0.25) -> int:
+        return self.router.stream_wait(seq, timeout)
+
+    @staticmethod
+    def _stream_final(h: RouterHandle) -> dict:
+        return {
+            "done": True,
+            "finish_reason": h.finish_reason,
+            "cancelled": h.status == RouterHandle.CANCELLED,
+        }
+
+    def note_frames(self, n: int) -> None:
+        with self._stream_lock:
+            self.stream_frames += n
+        stats.FT_EVENTS.incr("router_stream_frames", n)
 
     def start(self) -> "RouterServer":
         self.router.start()

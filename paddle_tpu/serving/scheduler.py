@@ -176,7 +176,7 @@ class ActiveSeq:
     already-decoding slots."""
 
     __slots__ = ("handle", "prompt", "last_token", "next_pos", "generated",
-                 "t_started", "prefill_pos")
+                 "t_started", "prefill_pos", "engine_steps")
 
     def __init__(self, handle: RequestHandle, prompt: List[int]):
         self.handle = handle
@@ -186,6 +186,11 @@ class ActiveSeq:
         self.generated: int = 0
         self.t_started: Optional[float] = None  # set at admission
         self.prefill_pos: int = len(prompt)  # chunked path resets to 0
+        # engine steps this sequence actually consumed (one per decode step
+        # it rode, one per verify round): with speculative decoding emitting
+        # >1 token per step, `generated` stops being a step count — the
+        # retire-time EWMA prices steps off THIS when speculation is on
+        self.engine_steps: int = 0
 
     @property
     def prefilling(self) -> bool:
@@ -223,10 +228,17 @@ class Scheduler:
         quotas: Optional[TenantQuotas] = None,
         prefill_chunk: Optional[int] = None,
         largest_bucket: Optional[int] = None,
+        speculate_k: int = 0,
     ):
         self.cache = cache
         self.max_queue = max_queue
         self.quotas = quotas
+        # speculative decoding (ISSUE 16): admission reserves K extra
+        # tokens of page headroom per request so a verify chunk's K+1
+        # scatter always has pages behind it; the session trims the surplus
+        # back to the free list once a request's remaining budget can no
+        # longer use it (kv_cache.trim). 0 = today's exact reservation.
+        self.speculate_k = max(0, int(speculate_k))
         # chunked-prefill geometry (None = whole-prompt prefill): the load
         # estimator charges each chunk one engine step, so a flood of long
         # prompts raises the wait estimate the way it raises real TTFT;
@@ -375,7 +387,9 @@ class Scheduler:
         if svc is None:
             return 0.0
         free_slot = any(a is None for a in self.slots)
-        fits_now = free_slot and self.cache.can_reserve(total_len)
+        fits_now = free_slot and self.cache.can_reserve(
+            total_len + self.speculate_k
+        )
         depth = len(self.waiting)
         step_s = self._ewma_step_s or 0.0
         c = self.prefill_chunk
@@ -557,7 +571,10 @@ class Scheduler:
                 if self.slots[slot] is not None:
                     continue
                 w = self.waiting[0]
-                total = w.handle.prompt_len + w.handle.max_new_tokens
+                # +K speculative headroom (0 when speculation is off, so
+                # the reservation is bitwise today's)
+                total = (w.handle.prompt_len + w.handle.max_new_tokens
+                         + self.speculate_k)
                 if not self.cache.can_reserve(total):
                     break  # FIFO: do not starve the head by skipping it
                 self.waiting.popleft()
@@ -587,8 +604,16 @@ class Scheduler:
         REQUEST_HISTOGRAM.observe(act.handle.t_done - act.handle.t_submit)
         svc = act.handle.t_done - (act.t_started or act.handle.t_submit)
         # engine steps this request actually occupied: its decode steps plus
-        # its extra prefill chunks — prices one chunk for the load estimate
-        steps = max(1, act.generated + self._chunk_steps(act.handle.prompt_len))
+        # its extra prefill chunks — prices one chunk for the load estimate.
+        # With speculation on, `generated` over-counts steps (a verify round
+        # commits several accepted tokens in ONE step), so the EWMA prices
+        # off the sequence's real step count instead (+1 for the prefill
+        # step that emitted the first token, matching generated's accounting)
+        if self.speculate_k:
+            occupied = act.engine_steps + 1
+        else:
+            occupied = act.generated
+        steps = max(1, occupied + self._chunk_steps(act.handle.prompt_len))
         with self.lock:
             a = self.SERVICE_EWMA_ALPHA
             self._ewma_service_s = (

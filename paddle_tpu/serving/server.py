@@ -50,6 +50,25 @@ from paddle_tpu.serving.session import ServingSession
 log = logging.getLogger("paddle_tpu.serving")
 
 
+def encode_frame(obj: Any) -> bytes:
+    """Wire encoding for ONE push-stream frame (ISSUE 16). Line-JSON today
+    — the same framing the request/reply plane already speaks, so every
+    client, router pump and chaos site handles frames for free; this
+    function is the single seam a binary framing would replace."""
+    return json.dumps(obj).encode() + b"\n"
+
+
+def clamp_cursor(val: Any, n: int) -> int:
+    """Clamp a client-supplied delta-poll/stream cursor into [0, n]: a
+    stale, negative or garbage cursor degrades to a bigger (or full)
+    token suffix, never an error or an out-of-range slice."""
+    try:
+        c = int(val or 0)
+    except (TypeError, ValueError):
+        return 0
+    return max(0, min(c, n))
+
+
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         srv: ServingServer = self.server.ctx  # type: ignore[attr-defined]
@@ -80,7 +99,14 @@ class _Handler(socketserver.StreamRequestHandler):
             except Exception as e:  # a bad request must not kill the server
                 log.warning("serving RPC failed: %r", e)
                 resp = {"err": f"{type(e).__name__}: {e}"}
+            stream = (
+                resp.pop("_stream", None) if isinstance(resp, dict) else None
+            )
             self._reply(resp)
+            if stream is not None:
+                # push mode: this connection becomes a frame stream for one
+                # request (until its final frame, then the read loop resumes)
+                self._push_frames(srv, *stream)
 
     def _reply(self, obj: Any) -> None:
         try:
@@ -88,6 +114,44 @@ class _Handler(socketserver.StreamRequestHandler):
             self.wfile.flush()
         except (OSError, ValueError):
             pass  # peer vanished; its retry path handles it
+
+    def _push_frames(self, srv: Any, handle: Any, cursor: int) -> None:
+        """Push token frames for one request until it finishes or the peer
+        vanishes. Frames are DELTAS from `cursor` (the same cursor contract
+        delta-poll uses, so a reattach after a dropped connection resumes
+        mid-stream without re-sending tokens). All socket writes happen on
+        THIS handler thread — the engine only bumps a step sequence
+        (`stream_wait`); a slow or dead client stalls its own pusher, never
+        a decode step. Polling the same request stays authoritative: a
+        stream is a fast path, not the source of truth."""
+        seq = 0
+        while True:
+            next_seq = srv.stream_wait(seq)
+            # done BEFORE tokens: completion is latched after the final
+            # append, so a True here guarantees the token read is complete
+            # (the reverse order could stamp `done` on a truncated frame)
+            done = handle.done
+            toks = list(handle.tokens)
+            n = len(toks)
+            if n > cursor or done:
+                frame = {
+                    "request_id": handle.request_id,
+                    "from": cursor,
+                    "tokens": toks[cursor:],
+                    "tokens_so_far": n,
+                }
+                cursor = n
+                if done:
+                    frame.update(srv._stream_final(handle))
+                try:
+                    self.wfile.write(encode_frame(frame))
+                    self.wfile.flush()
+                except (OSError, ValueError):
+                    return  # peer went away; poll/reattach picks it back up
+                srv.note_frames(1)
+                if done:
+                    return
+            seq = next_seq
 
 
 class ServingServer:
@@ -166,6 +230,10 @@ class ServingServer:
         self.stall_fence_s = float(stall_fence_s)
         self._agent = None
         self._killed = False
+        # push-streaming observability: frames written by pusher threads
+        # (exported via stats + the obs counter; the engine never writes)
+        self.stream_frames = 0
+        self._stream_lock = threading.Lock()
 
     @property
     def address(self) -> tuple:
@@ -188,6 +256,7 @@ class ServingServer:
             out = dict(self.session.stats()) if self.session else {}
             out["live_tenants"] = self.membership.live
             out["evicted_tenants"] = self.membership.evicted
+            out["stream_frames_pushed"] = self.stream_frames
             if self.master_endpoints is not None:
                 out["master"] = self._master_health()
             return out
@@ -228,7 +297,15 @@ class ServingServer:
                     if req_key is not None:
                         self._by_client_id[req_key] = handle.request_id
             if method == "submit":
-                return {"request_id": handle.request_id}
+                out: Dict[str, Any] = {"request_id": handle.request_id}
+                if req.get("stream"):
+                    # opt-in push streaming (ISSUE 16): the ack carries the
+                    # request id as usual, then token frames follow on this
+                    # SAME connection until the final frame — submit and
+                    # first-frame latency share one round trip
+                    out["stream"] = True
+                    out["_stream"] = (handle, 0)
+                return out
             try:
                 # cancel_on_timeout=False: the blocking-generate contract is
                 # "still running; poll request_id later" — the caller chose
@@ -248,27 +325,39 @@ class ServingServer:
                 pass  # cancelled: _completion below names the reason
             return dict(self._completion(handle),
                         request_id=handle.request_id)
-        if method in ("poll", "cancel"):
+        if method in ("poll", "cancel", "stream"):
             with self._handles_lock:
                 handle = self._handles.get(int(req["request_id"]))
             if handle is None:
                 return {"err": f"unknown request_id {req['request_id']}"}
-            # request ids are sequential — poll/cancel must enforce the SAME
-            # tenancy as submit, or guessing ids reads (or kills) other
-            # tenants' requests
+            # request ids are sequential — poll/cancel/stream must enforce
+            # the SAME tenancy as submit, or guessing ids reads (or kills)
+            # other tenants' requests
             if handle.tenant != self._tenant_for(tenant_id):
                 return {"err": "request belongs to another tenant"}
             if method == "cancel":
                 return {"cancelled": handle.cancel(), "done": handle.done}
+            if method == "stream":
+                # (re)attach a push stream mid-request: the client's `from`
+                # cursor (tokens it already holds) resumes the frame stream
+                # exactly where a dropped connection left off
+                cur = clamp_cursor(req.get("from"), len(handle.tokens))
+                return {
+                    "request_id": handle.request_id, "stream": True,
+                    "from": cur, "_stream": (handle, cur),
+                }
             if not handle.done:
                 # incremental delivery: the tokens generated SO FAR ride
-                # every poll (the cheap first step toward streaming, and
-                # what makes a TTFT-deadline miss client-observable)
+                # every poll, from the client's `from` cursor on — a
+                # delta-poll re-sends only the unseen suffix (`from` absent
+                # = 0 = today's full-list reply, bit-for-bit)
                 toks = list(handle.tokens)
+                cur = clamp_cursor(req.get("from"), len(toks))
                 return {
                     "done": False,
                     "tokens_so_far": len(toks),
-                    "tokens": toks,
+                    "tokens": toks[cur:],
+                    "from": cur,
                 }
             # non-destructive: a lost response must be re-readable; the
             # reaper GCs finished handles after handle_ttl_s
@@ -294,12 +383,18 @@ class ServingServer:
                 elif handle.tenant != self._tenant_for(it.get("tenant_id")):
                     out.append({"request_id": rid, "err": "tenant"})
                 elif handle.done:
+                    # completions stay FULL-token replies (no cursor): the
+                    # terminal result is the authoritative record the
+                    # router's dedup latch delivers exactly once
                     out.append(dict(self._completion(handle),
                                     request_id=rid))
                 else:
+                    toks = list(handle.tokens)
+                    cur = clamp_cursor(it.get("from"), len(toks))
                     out.append({
                         "request_id": rid, "done": False,
-                        "tokens": list(handle.tokens),
+                        "tokens": toks[cur:], "from": cur,
+                        "tokens_so_far": len(toks),
                     })
             return {"results": out}
         if method == "generate_config":
@@ -381,6 +476,33 @@ class ServingServer:
             "finish_reason": handle.finish_reason,
             "cancelled": handle.status == RequestHandle.CANCELLED,
         }
+
+    # -- push-stream plumbing (shared with _Handler._push_frames) -----------
+    def stream_wait(self, seq: int, timeout: float = 0.25) -> int:
+        """Pusher-thread wait for the next engine step boundary (delegates
+        to the session's step-sequence condition; the timeout doubles as
+        the liveness tick for cancellations that finish without a step)."""
+        if self.session is None:
+            self._stop_evt.wait(timeout)
+            return seq
+        return self.session.stream_wait(seq, timeout)
+
+    @staticmethod
+    def _stream_final(handle: RequestHandle) -> dict:
+        """Terminal fields for a stream's final frame — delta-shaped (the
+        client accumulated the tokens), completion metadata inline."""
+        return {
+            "done": True,
+            "finish_reason": handle.finish_reason,
+            "cancelled": handle.status == RequestHandle.CANCELLED,
+        }
+
+    def note_frames(self, n: int) -> None:
+        from paddle_tpu.serving.session import SERVING_EVENTS
+
+        with self._stream_lock:
+            self.stream_frames += n
+        SERVING_EVENTS.incr("serving_stream_frames", n)
 
     def _generate_config(self, req: dict) -> dict:
         """Whole-request generation against the long-lived GenerationSession
@@ -548,6 +670,7 @@ class ServingClient:
         self.lease_s: float = 30.0
         self.hedges = 0  # hedged retries issued (TTFT-deadline misses)
         self.shed_retries = 0  # submits retried after a shed's retry_after_ms
+        self.stream_reattaches = 0  # dropped push-streams resumed by cursor
 
     def register(self) -> str:
         resp = self._client.call("register")
@@ -670,8 +793,85 @@ class ServingClient:
             )
         return int(resp["request_id"])
 
-    def poll(self, request_id: int) -> dict:
-        return self._client.call("poll", request_id=request_id, **self._id_kw())
+    def poll(self, request_id: int, from_: Optional[int] = None) -> dict:
+        """Poll a request; with `from_` set, the not-done reply carries only
+        tokens[from_:] (delta poll — `tokens_so_far` still counts them all,
+        and `from` echoes the clamped cursor the suffix starts at)."""
+        kw: Dict[str, Any] = {"request_id": request_id, **self._id_kw()}
+        if from_ is not None:
+            kw["from"] = int(from_)
+        return self._client.call("poll", **kw)
+
+    def stream(
+        self,
+        prompt=None,
+        max_new_tokens: Optional[int] = None,
+        request_id: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        ttft_deadline_s: Optional[float] = None,
+        client_req_id: Optional[str] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        seed: Optional[int] = None,
+        reattach_retries: int = 4,
+    ):
+        """Push-streaming generator: yields token frames as the server emits
+        them (each a dict with the `tokens` delta; the final frame carries
+        `done`/`finish_reason`). With `prompt` given this is submit with
+        `stream=True` — the ack and the first frame share one connection and
+        one round trip; with `request_id` it attaches to an in-flight
+        request. Runs on a DEDICATED connection (the request/reply client
+        stays usable concurrently). A dropped stream reattaches up to
+        `reattach_retries` times via the `stream` RPC with the token cursor,
+        so delivered tokens are never re-sent and never lost; the submit
+        leg rides the usual idempotency key, so a retried attach after a
+        lost ack reattaches to the original request."""
+        if (prompt is None) == (request_id is None):
+            raise ValueError("stream() needs exactly one of prompt/request_id")
+        key = client_req_id or uuid.uuid4().hex
+        cursor = 0
+        failures = 0
+        conn = MasterClient(
+            self._client.endpoints, timeout=self._client.timeout, retries=2,
+        )
+        try:
+            while True:
+                if request_id is None:
+                    frames = conn.call_stream(
+                        "submit", prompt=list(prompt),
+                        max_new_tokens=max_new_tokens, stream=True,
+                        deadline_s=deadline_s,
+                        ttft_deadline_s=ttft_deadline_s,
+                        temperature=temperature, top_k=top_k, seed=seed,
+                        client_req_id=key, **self._id_kw(),
+                    )
+                else:
+                    frames = conn.call_stream(
+                        "stream", **{"from": cursor},
+                        request_id=request_id, **self._id_kw(),
+                    )
+                try:
+                    ack = next(frames)
+                    if "err" in ack:
+                        raise Rejected(
+                            f"stream rejected: {ack['err']}",
+                            reason=ack.get("rejected"),
+                            retry_after_ms=ack.get("retry_after_ms"),
+                        )
+                    request_id = int(ack["request_id"])
+                    for frame in frames:
+                        cursor = int(frame.get("tokens_so_far", cursor))
+                        yield frame
+                        if frame.get("done"):
+                            return
+                except ConnectionError:
+                    failures += 1
+                    if failures > max(0, int(reattach_retries)):
+                        raise
+                    self.stream_reattaches += 1
+                    conn.close()  # reattach from `cursor` on a fresh socket
+        finally:
+            conn.close()
 
     def cancel(self, request_id: int) -> dict:
         """Cancel a submitted request server-side (pages recycle at the next

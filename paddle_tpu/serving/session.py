@@ -108,6 +108,7 @@ class ServingSession:
         prefill_chunk: Optional[int] = None,
         default_temperature: float = 0.0,
         default_top_k: int = 0,
+        speculate_k: int = 0,
     ):
         import jax
 
@@ -135,7 +136,13 @@ class ServingSession:
         # session-wide sampling defaults; per-request values win (ISSUE 11)
         self.default_temperature = float(default_temperature)
         self.default_top_k = int(default_top_k)
-        pages_per_seq = -(-max_ctx // page_size)
+        # speculative decoding (ISSUE 16): K drafted tokens verified per
+        # round through ONE [1, K+1] prefill-chunk-shaped executable.
+        # 0 (the default) compiles nothing extra and takes exactly today's
+        # code path — `--speculate_k 0` bitwise-recovers PR-15 behavior.
+        self.speculate_k = max(0, int(speculate_k))
+        # per-seq page budget covers the verify chunk's K-token overshoot
+        pages_per_seq = -(-(max_ctx + self.speculate_k) // page_size)
         if num_pages is None:
             # worst case every slot at full context, plus the dump page
             num_pages = max_slots * pages_per_seq + 1
@@ -153,6 +160,7 @@ class ServingSession:
         self.scheduler = Scheduler(
             self.cache, max_queue=max_queue, quotas=quotas,
             prefill_chunk=self.prefill_chunk, largest_bucket=self.buckets[-1],
+            speculate_k=self.speculate_k,
         )
         self.k_pages, self.v_pages = self.cache.make_pools()
 
@@ -163,12 +171,34 @@ class ServingSession:
         self._prefill = jax.jit(model.prefill)
         self._commit = jax.jit(model.commit_prefill, donate_argnums=(0, 1))
         self._prefill_chunk = jax.jit(model.prefill_chunk, donate_argnums=(1, 2))
+        # the verify executable only exists when speculation is on: K=0
+        # compiles nothing and the engine step never calls _speculate's body
+        self._verify = (
+            jax.jit(model.verify_chunk, donate_argnums=(1, 2))
+            if self.speculate_k else None
+        )
 
         self.recompiles = stats.RecompileStats(warn_threshold=2)
+        # the verify chunk's own one-signature gate ([1, K+1] fixed shape:
+        # drafts, starts and sampling identity are data, never shape)
+        self.verify_recompiles = stats.RecompileStats(warn_threshold=2)
         self.decode_steps = 0
         self.tokens_generated = 0
         self.prefill_chunks_committed = 0
         self._chunk_rr_slot = -1  # round-robin cursor over prefilling slots
+        # speculative-decode telemetry (acceptance rate = accepted / drafted)
+        self.spec_rounds = 0
+        self.spec_tokens_drafted = 0
+        self.spec_tokens_accepted = 0
+        self.spec_pages_trimmed = 0
+        # per-slot prompt-lookup drafters, keyed (slot -> (request_id,
+        # drafter)); lazily built, dropped at retirement / engine recovery
+        self._drafters: Dict[int, tuple] = {}
+        # push-streaming seam (ISSUE 16): the engine bumps a sequence number
+        # once per step and wakes pusher threads; ALL socket writes happen on
+        # those threads (server.py), so frame emission never blocks a step
+        self._stream_cv = threading.Condition()
+        self._stream_seq = 0
         # session-level request deadline defaults; per-tenant quota defaults
         # (quota.py deadlines_for) take precedence, explicit per-request
         # values beat both
@@ -243,7 +273,9 @@ class ServingSession:
         if not self._chunked_prompt(prompt):
             # whole-prompt (bucketed) prefill path: prompt must fit a bucket
             _bucket_for(self.buckets, len(prompt))
-        need = self.cache.pages_needed(len(prompt) + max_new)
+        need = self.cache.pages_needed(
+            len(prompt) + max_new + self.speculate_k
+        )
         if need > min(self.cache.max_pages_per_seq, self.cache.num_pages - 1):
             # an undersized pool must reject at the front door, not leave the
             # queue head unadmittable forever
@@ -450,14 +482,131 @@ class ServingSession:
                 if reason is not None:
                     self.scheduler.retire(slot, reason)
 
-    def _decode_once(self) -> None:
+    def _drafter_for(self, slot: int, act):
+        """This slot's prompt-lookup drafter, rebuilt when the slot was
+        recycled to a different request (stale entries are bounded by
+        max_slots; retirement and engine recovery drop them eagerly)."""
+        from paddle_tpu.serving.speculation import PromptLookupDrafter
+
+        rid = act.handle.request_id
+        ent = self._drafters.get(slot)
+        if ent is None or ent[0] != rid:
+            ent = (rid, PromptLookupDrafter())
+            self._drafters[slot] = ent
+        return ent[1]
+
+    def _speculate(self) -> set:
+        """One prompt-lookup draft/verify round for EVERY eligible slot
+        (ISSUE 16): the slot's drafter proposes up to K continuation tokens
+        from the request's own committed n-grams, one [1, K+1] verify_chunk
+        call scores them all against the paged cache, and the matched prefix
+        commits — the first divergent token comes free from the verify
+        logits, so a round always advances the slot by >= 1 token. Slots
+        with no draft (or exhausted budget) fall through to _decode_once.
+
+        Eligibility is a pure function of the REQUEST's own state (its
+        committed tokens decide whether a draft exists), never of batch
+        composition or engine scheduling — that is what keeps crash replay
+        and router failover bitwise at temperature > 0: a replay regrows the
+        same committed prefix, drafts the same tokens, samples through the
+        same (seed, emitted-token-index) keys, and accepts the same prefix.
+        Returns the slots advanced this round (skipped by _decode_once)."""
+        advanced: set = set()
+        if not self.speculate_k:
+            return advanced
+        candidates = [
+            (slot, act) for slot, act in self.scheduler.active_slots()
+            if not act.prefilling
+        ]
+        if candidates and _faults.get().active:
+            # chaos site (spec_replay): the engine faults mid-speculation —
+            # recovery must replay the in-flight drafts bitwise; gated on
+            # live candidates so step=N counts real verify attempts
+            _faults.get().maybe_raise("decode_raise")
+        k = self.speculate_k
+        for slot, act in candidates:
+            h = act.handle
+            remaining = h.max_new_tokens - act.generated
+            if remaining <= 1:
+                # the +K page headroom is no longer reachable (every future
+                # write lands inside the base reservation): recycle it now
+                # instead of riding it to retirement
+                self.spec_pages_trimmed += self.cache.trim(
+                    slot, h.prompt_len + h.max_new_tokens
+                )
+                continue
+            drafter = self._drafter_for(slot, act)
+            drafter.sync(act.prompt, h.tokens)
+            draft = drafter.draft(k)
+            if not draft:
+                continue
+            toks = np.zeros((1, k + 1), np.int32)
+            toks[0, 0] = act.last_token
+            toks[0, 1:1 + len(draft)] = draft  # short drafts zero-pad
+            starts = np.array([act.next_pos], np.int32)
+            steps0 = np.array([act.generated], np.int32)
+            seeds, temps, top_ks = self._sampling_row(h)
+            rows = self.cache.slot_row(slot)
+            # one-signature assertion data: the verify shape is [1, K+1]
+            # no matter the draft, the request mix, or the round
+            self.verify_recompiles.record(
+                stats.batch_signature(
+                    {"tokens": toks, "starts": starts, "block_rows": rows,
+                     "seeds": seeds, "steps0": steps0, "temps": temps,
+                     "top_ks": top_ks}
+                )
+            )
+            # span-ok: ring-buffer write only, constant name, int attrs —
+            # the verify loop is hot-path like the decode loop (lint-pinned)
+            with trace.span(
+                "serving.verify_chunk", request_id=h.request_id,
+                drafted=len(draft),
+            ):
+                self.k_pages, self.v_pages, sampled = self._verify(
+                    self.params, self.k_pages, self.v_pages, toks,
+                    starts, rows, seeds, steps0, temps, top_ks,
+                )
+                # sync-ok: ONE fetch per verify round — the K+1 sampled
+                # tokens, which the host needs to run acceptance (the
+                # autoregressive loop's EOS/budget checks ride the same
+                # fetch); pages stay donated through, logits never land
+                out = np.asarray(sampled)
+            act.engine_steps += 1
+            limit = min(len(draft), remaining - 1)
+            n_match = 0
+            while n_match < limit and int(out[n_match]) == draft[n_match]:
+                n_match += 1
+            emit = [int(out[i]) for i in range(n_match + 1)]
+            # never commit past EOS: a drafted continuation that crosses the
+            # stop token truncates there (the tail was never "emitted")
+            for j, t in enumerate(emit):
+                if t == self.cfg.eos_id:
+                    emit = emit[: j + 1]
+                    break
+            for t in emit:
+                act.append(t)
+            self.tokens_generated += len(emit)
+            self.spec_rounds += 1
+            self.spec_tokens_drafted += len(draft)
+            self.spec_tokens_accepted += max(0, len(emit) - 1)
+            SERVING_EVENTS.incr("serving_spec_rounds")
+            SERVING_EVENTS.incr("serving_spec_accepted", max(0, len(emit) - 1))
+            advanced.add(slot)
+            reason = act.finished(self.cfg.eos_id)
+            if reason is not None:
+                self._drafters.pop(slot, None)
+                self.scheduler.retire(slot, reason)
+        return advanced
+
+    def _decode_once(self, skip: frozenset = frozenset()) -> None:
         """One continuous-batching decode step: every active, fully-prefilled
         slot advances by one token inside the single fixed-shape executable
         (slots mid-chunked-prefill sit this one out as inactive lanes — their
-        KV is still being committed)."""
+        KV is still being committed; slots in `skip` already advanced through
+        a speculative verify round this step)."""
         active = [
             (slot, act) for slot, act in self.scheduler.active_slots()
-            if not act.prefilling
+            if not act.prefilling and slot not in skip
         ]
         if not active:
             return
@@ -512,9 +661,11 @@ class ServingSession:
         SERVING_EVENTS.incr("serving_decode_steps")
         for slot, act in active:
             act.append(toks[slot])
+            act.engine_steps += 1
             self.tokens_generated += 1
             reason = act.finished(self.cfg.eos_id)
             if reason is not None:
+                self._drafters.pop(slot, None)
                 self.scheduler.retire(slot, reason)
 
     def step(self, now: Optional[float] = None) -> bool:
@@ -534,8 +685,37 @@ class ServingSession:
         self._admit(now)
         self._prefill_chunks()
         before = self.decode_steps
-        self._decode_once()
-        return self.decode_steps != before or bool(self.scheduler.active_slots())
+        spec_before = self.spec_rounds
+        advanced = self._speculate()
+        self._decode_once(advanced)
+        self._notify_streams()
+        return (
+            self.decode_steps != before
+            or self.spec_rounds != spec_before
+            or bool(self.scheduler.active_slots())
+        )
+
+    # -- push-streaming seam (ISSUE 16) -------------------------------------
+    def _notify_streams(self) -> None:
+        """Wake frame pushers at this step boundary. The engine's entire
+        contribution to push streaming is this sequence-number bump: no
+        socket writes, no file I/O, no per-stream work — pusher threads
+        (server.py) diff token lists and emit frames on their own time, so
+        a slow or dead client can never block an engine step."""
+        with self._stream_cv:
+            self._stream_seq += 1
+            self._stream_cv.notify_all()
+
+    def stream_wait(self, seq: int, timeout: float = 0.1) -> int:
+        """Block (pusher-thread side) until the engine advances past step
+        sequence `seq` or `timeout` elapses; returns the current sequence.
+        The timeout doubles as the liveness tick — pushers re-check their
+        handles even when the engine idles (cancellations complete without
+        a step)."""
+        with self._stream_cv:
+            if self._stream_seq == seq:
+                self._stream_cv.wait(timeout)
+            return self._stream_seq
 
     def run_until_idle(self) -> None:
         """Drive the engine on the calling thread until queue + slots drain
@@ -695,6 +875,9 @@ class ServingSession:
         requeued, expired = self.scheduler.requeue_active(t0)
         self.cache.reset()
         self.k_pages, self.v_pages = self.cache.make_pools()
+        # drafters are derived state: replayed requests regrow them from
+        # the prompt (deterministically — same drafts, same acceptances)
+        self._drafters.clear()
         SERVING_EVENTS.incr("serving_requests_replayed", requeued)
         trace.span_from_monotonic(
             "serving.engine_restart", t0,
@@ -755,12 +938,22 @@ class ServingSession:
             sch.completed,
             sch.cancelled,
             self.engine_restarts,
+            # a single-stream speculative workload can advance through
+            # verify rounds alone (decode skipped every step) — without
+            # this term the fleet agent would self-fence a healthy engine
+            self.spec_rounds,
         )
 
     def decode_shape_signatures(self) -> int:
         """Distinct decode-step input signatures seen — 1 means the entire
         serving lifetime shared one compiled decode program."""
         return self.recompiles.total_signatures()
+
+    def verify_shape_signatures(self) -> int:
+        """Distinct verify_chunk input signatures seen — 1 means every
+        speculative round shared one compiled [1, K+1] program (0 when
+        speculation never ran)."""
+        return self.verify_recompiles.total_signatures()
 
     def stats(self) -> Dict:
         sch = self.scheduler
@@ -793,6 +986,15 @@ class ServingSession:
             "prefill_chunks_committed": self.prefill_chunks_committed,
             "default_temperature": self.default_temperature,
             "default_top_k": self.default_top_k,
+            "speculate_k": self.speculate_k,
+            "spec_rounds": self.spec_rounds,
+            "spec_tokens_drafted": self.spec_tokens_drafted,
+            "spec_tokens_accepted": self.spec_tokens_accepted,
+            "spec_acceptance_rate": round(
+                self.spec_tokens_accepted / self.spec_tokens_drafted, 4
+            ) if self.spec_tokens_drafted else 0.0,
+            "spec_pages_trimmed": self.spec_pages_trimmed,
+            "verify_shape_signatures": self.verify_shape_signatures(),
         }
 
 

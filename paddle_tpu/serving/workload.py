@@ -36,6 +36,28 @@ def make_prompts(
     return out
 
 
+def make_repetitive_prompts(
+    n: int,
+    motif_len: int,
+    repeats: int,
+    vocab: int,
+    bos_id: int,
+    seed: int = 0,
+) -> List[List[int]]:
+    """High-overlap prompts for the speculative-decoding legs (ISSUE 16):
+    each prompt is BOS + a short random motif repeated, so the prompt-lookup
+    drafter has dense n-gram matches from the first generated token. A
+    per-prompt motif keeps the workload shape-diverse across requests while
+    every individual request stays self-similar — the regime prompt-lookup
+    speculation is built for (extraction, code edits, templated text)."""
+    rs = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        motif = [int(t) for t in rs.randint(3, vocab, size=motif_len)]
+        out.append([bos_id] + motif * repeats)
+    return out
+
+
 def make_mixed_prompts(
     n: int,
     short_lengths: Sequence[int],
@@ -118,13 +140,19 @@ def run_closed_loop(
         # inter-token latency: a stream's gap between consecutive tokens,
         # measured from this driver's step boundary (first token = TTFT,
         # excluded — ITL isolates the steady-stream stall a co-scheduled
-        # prefill causes)
+        # prefill causes). A step may deliver SEVERAL tokens to one stream
+        # (a speculative verify round, ISSUE 16): the gap amortizes over
+        # them and each delivered token contributes ONE sample, so the
+        # percentiles stay per-token — a multi-token step must pull p50
+        # down in proportion to the tokens it delivered, not count once
+        # alongside the single-token steps
         for rid, (_, h) in in_flight.items():
             n_prev, t_prev = token_seen[rid]
             n_now = len(h.tokens)
             if n_now > n_prev:
                 if t_prev is not None:
-                    itl_ms.append((now - t_prev) * 1e3 / (n_now - n_prev))
+                    gap = (now - t_prev) * 1e3 / (n_now - n_prev)
+                    itl_ms.extend([gap] * (n_now - n_prev))
                 token_seen[rid] = (n_now, now)
         done = [rid for rid, (_, h) in in_flight.items() if h.done]
         for rid in done:

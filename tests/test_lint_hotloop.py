@@ -39,6 +39,7 @@ TRAINER_PY = os.path.join(_REPO, "paddle_tpu", "trainer", "trainer.py")
 SERVING_PY = os.path.join(_REPO, "paddle_tpu", "serving", "session.py")
 SCHEDULER_PY = os.path.join(_REPO, "paddle_tpu", "serving", "scheduler.py")
 ROUTER_PY = os.path.join(_REPO, "paddle_tpu", "serving", "router.py")
+SERVER_PY = os.path.join(_REPO, "paddle_tpu", "serving", "server.py")
 
 # calls that force a device sync when applied to a device array; jnp.* ops
 # (async, traced) are deliberately NOT matched — hence the lookbehinds
@@ -59,10 +60,14 @@ SERVING_SYNC_CALL = re.compile(
 # engine step while a long prompt commits (its ONE sanctioned fetch is the
 # final chunk's sampled first token — per REQUEST, not per chunk), so it
 # obeys the same np.asarray/float( ban as the decode loop.
+# ISSUE 16 added _speculate: its ONE sanctioned fetch is the verify round's
+# K+1 sampled tokens (per ROUND per slot — acceptance runs on host), so the
+# verify loop obeys the same budget discipline as the decode loop.
 HOT_LOOPS = [
     (TRAINER_PY, "SGDTrainer", ("train", "_train_one_pass"), SYNC_CALL, 4),
-    (SERVING_PY, "ServingSession", ("_decode_once", "step", "_prefill_chunks"),
-     SERVING_SYNC_CALL, 2),
+    (SERVING_PY, "ServingSession",
+     ("_decode_once", "step", "_prefill_chunks", "_speculate"),
+     SERVING_SYNC_CALL, 3),
 ]
 
 # a tag on the offending line or in the contiguous comment block above it
@@ -91,8 +96,9 @@ SPAN_TAG = "span-ok"
 # + span-formatting bans below apply to those bodies too.
 SPAN_HOT_LOOPS = [
     (TRAINER_PY, "SGDTrainer", ("train", "_train_one_pass"), 2),
-    (SERVING_PY, "ServingSession", ("_decode_once", "step", "_prefill_chunks"),
-     2),
+    (SERVING_PY, "ServingSession",
+     ("_decode_once", "step", "_prefill_chunks", "_speculate",
+      "_notify_streams"), 3),
     (ROUTER_PY, "Router",
      ("_forward", "_failover_requests", "_reap_once", "_pump_once"), 3),
 ]
@@ -251,7 +257,8 @@ CLOCK_TAG = "clock-ok"
 CLOCK_HOT_LOOPS = [
     (SERVING_PY, "ServingSession",
      ("step", "_admit", "_prefill_chunks", "_observe_ttft", "_decode_once",
-      "_engine_loop", "_supervise", "_recover"), 4),
+      "_speculate", "_notify_streams", "_engine_loop", "_supervise",
+      "_recover"), 4),
     (SCHEDULER_PY, "Scheduler",
      ("reap", "pop_admissions", "requeue_active", "retire"), 3),
     (SCHEDULER_PY, "ActiveSeq", ("append", "finished"), 1),
@@ -319,7 +326,7 @@ PUT_TAG = "tp-ok"
 # (file, class, engine-loop methods, max tp-ok tags)
 PUT_HOT_LOOPS = [
     (SERVING_PY, "ServingSession",
-     ("step", "_admit", "_prefill_chunks", "_decode_once"), 1),
+     ("step", "_admit", "_prefill_chunks", "_decode_once", "_speculate"), 1),
 ]
 
 
@@ -510,4 +517,70 @@ def test_span_args_not_formatted_in_hot_loops():
         "string formatting inside a hot-loop span call (evaluates even with "
         "tracing off) — pass raw ints/strings instead:\n  "
         + "\n  ".join(violations)
+    )
+
+
+# -- push-stream emit path (ISSUE 16 token streaming) -------------------------
+#
+# Push streaming splits in two on purpose: the ENGINE's entire contribution
+# is a sequence-number bump under a condition variable (_notify_streams /
+# stream_wait — same pair on the router's mirror), while every socket write
+# happens on a server handler thread (server._Handler._push_frames; the
+# router server reuses the same handler). That is what makes a slow or dead
+# subscriber unable to block a decode step. Two pins keep the separation
+# honest: the engine-side seam stays free of socket/frame emission, and
+# encode_frame() — the framing seam call_stream() parses against — is called
+# from the handler push loop only.
+
+STREAM_EMIT = re.compile(
+    r"\.sendall\(|(?<![\w.])encode_frame\(|\.makefile\(|\bwfile\b"
+)
+# (file, class, engine-side stream-seam methods)
+STREAM_SEAM = [
+    (SERVING_PY, "ServingSession",
+     ("_notify_streams", "stream_wait", "step", "_decode_once",
+      "_speculate")),
+    (ROUTER_PY, "Router",
+     ("_notify_streams", "stream_wait", "_on_result", "_pump_once")),
+]
+
+
+def test_engine_stream_seam_is_socket_free():
+    """No socket/frame emission in the engine-side stream seam: the engine
+    and the router's pump announce progress with a seq bump + notify_all and
+    NOTHING else — pusher threads (which own the sockets) do the writing, so
+    backpressure from one subscriber never reaches the decode loop."""
+    violations = []
+    for path, cls, methods in STREAM_SEAM:
+        v, _ = _scan(path, cls, methods, STREAM_EMIT, tag=None)
+        violations += v
+    assert not violations, (
+        "socket/frame emission in the engine-side stream seam — frames are "
+        "written by server handler threads (_Handler._push_frames) only; "
+        "the engine/router signal progress via stream_wait's condition "
+        "variable:\n  " + "\n  ".join(violations)
+    )
+
+
+def test_frame_encoding_only_in_handler_push_loop():
+    """encode_frame() has exactly one call site: _Handler._push_frames. Any
+    second caller is a second framing implementation waiting to drift from
+    what MasterClient.call_stream parses."""
+    with open(SERVER_PY) as f:
+        source = f.read()
+    spans = list(_hot_spans(ast.parse(source), "_Handler", ("_push_frames",)))
+    assert spans, f"_Handler._push_frames moved/renamed — update {__file__}"
+    _, lo, hi = spans[0]
+    call = re.compile(r"(?<![\w.])encode_frame\(")
+    offenders = []
+    for ln, text in enumerate(source.splitlines(), 1):
+        code = text.split("#", 1)[0]
+        if not call.search(code) or code.lstrip().startswith("def "):
+            continue
+        if not (lo <= ln <= hi):
+            offenders.append(f"server.py:{ln}: {text.strip()}")
+    assert not offenders, (
+        "encode_frame() called outside _Handler._push_frames — keep one "
+        "framing seam so pushed frames and call_stream's parser cannot "
+        "drift apart:\n  " + "\n  ".join(offenders)
     )

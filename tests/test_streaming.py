@@ -1,0 +1,313 @@
+"""Push token streaming + delta polling (ISSUE 16).
+
+The load-bearing claims, each tested directly:
+
+  * delta poll — `poll(from=N)` returns tokens[N:] with the cursor echoed
+    and `tokens_so_far` still counting everything; assembling the deltas
+    reproduces the full sequence EXACTLY (the prefix-consistency
+    regression test); garbage/out-of-range cursors clamp instead of
+    throwing; a DONE reply always carries the full token list (the
+    authoritative record router dedup relies on), and a poll without
+    `from` is bit-for-bit the legacy reply;
+  * poll_many — per-item cursors, same contract, completions full;
+  * push streaming — `stream=True` on submit delivers frames on the
+    submit connection as the engine emits tokens (speculative rounds push
+    multi-token deltas); frames are prefix-consistent and the final frame
+    carries done/finish_reason; tokens match the non-streamed oracle;
+  * mid-flight attach — the `stream` RPC attaches to an in-flight request
+    at a cursor, so a dropped subscriber resumes without replaying
+    delivered tokens;
+  * the router — the same client streams through RouterServer (frames cut
+    at mirror-advance granularity), with delta polling on the same handle
+    and identical tokens to the routed non-streamed path."""
+
+import time
+
+import pytest
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    import jax
+
+    from paddle_tpu.serving.model import LMConfig, ServableLM
+
+    model = ServableLM(
+        LMConfig(vocab=VOCAB, n_layers=2, d_model=32, n_heads=2, max_len=96)
+    )
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def make_session(model_and_params, **kw):
+    from paddle_tpu.serving.session import ServingSession
+
+    model, params = model_and_params
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_buckets", (8, 16, 32))
+    kw.setdefault("max_new_limit", 24)
+    return ServingSession(model, params, **kw)
+
+
+PROMPT = [1] + [5, 9, 11] * 4  # repetitive: speculative rounds land
+PLAIN = [1, 3, 4, 5, 6, 7, 8]
+
+
+def _drain_poll(client, rid, deadline_s=30.0):
+    """Assemble a request's tokens from delta polls only. Returns the
+    deltas collected before the done reply plus the done reply itself; the
+    assembly must be a PREFIX of the done reply's full list (tokens emitted
+    between the last delta and completion arrive only in the final)."""
+    assembled = []
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        resp = client.poll(rid, from_=len(assembled))
+        assert "err" not in resp, resp
+        if resp.get("done"):
+            assert resp["tokens"][:len(assembled)] == assembled, (
+                "delta assembly is not a prefix of the done reply"
+            )
+            return assembled, resp
+        base = resp["from"]
+        assert base == len(assembled), "server re-cut the cursor"
+        assert resp["tokens_so_far"] == base + len(resp["tokens"])
+        assembled.extend(resp["tokens"])
+        time.sleep(0.002)
+    raise AssertionError(f"request {rid} never finished")
+
+
+def test_delta_poll_prefix_consistent(model_and_params):
+    """The satellite-1 regression: a client that only ever reads suffixes
+    reconstructs the full sequence bit-for-bit. Deterministic mid-flight
+    coverage: the test holds the engine and steps it by hand between
+    polls, so every delta reply is observed against a known token count."""
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    s = make_session(model_and_params, speculate_k=4)
+    s._thread = True  # hold the engine: ServingServer.start() must not spawn it
+    srv = ServingServer(session=s).start()
+    try:
+        c = ServingClient(srv.address)
+        rid = c.submit(PROMPT, 16)
+        assembled, delta_polls = [], 0
+        for _ in range(200):
+            if not s.scheduler.has_work():
+                break
+            s.step()
+            resp = c.poll(rid, from_=len(assembled))
+            if resp.get("done"):
+                # done replies carry the FULL list; fold in the unseen tail
+                assert resp["tokens"][:len(assembled)] == assembled
+                assembled = list(resp["tokens"])
+                break
+            assert resp["from"] == len(assembled)
+            assert resp["tokens_so_far"] == len(assembled) + len(resp["tokens"])
+            assembled.extend(resp["tokens"])
+            delta_polls += 1
+        final = c.poll(rid, from_=len(assembled))
+        assert final["done"] and final["finish_reason"] in ("length", "eos")
+        # the done reply stays FULL whatever the cursor (the router's
+        # exactly-once dedup record), and the assembly is exactly it
+        assert final["tokens"] == assembled
+        assert delta_polls >= 2 and len(assembled) == 16
+
+        # legacy poll (no `from`) is byte-for-byte the full reply
+        legacy = c.poll(rid)
+        assert legacy["done"] and legacy["tokens"] == assembled
+
+        # cursor clamping: garbage and past-the-end clamp instead of throw
+        r = srv.dispatch("poll", {"request_id": rid, "from": 999}, "default")
+        assert r["tokens"] == assembled  # done replies stay full regardless
+        r = srv.dispatch("poll", {"request_id": rid, "from": "junk"}, "default")
+        assert r["tokens"] == assembled
+        c.close()
+    finally:
+        s._thread = None
+        srv.stop()
+
+
+def test_poll_many_delta_cursors(model_and_params):
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    s = make_session(model_and_params)
+    srv = ServingServer(session=s).start()
+    try:
+        c = ServingClient(srv.address)
+        rids = [c.submit(p, 12) for p in (PROMPT, PLAIN)]
+        cursors = {rid: 0 for rid in rids}
+        assembled = {rid: [] for rid in rids}
+        finals = {}
+        deadline = time.monotonic() + 30
+        while len(finals) < len(rids) and time.monotonic() < deadline:
+            items = [
+                {"request_id": rid, "from": cursors[rid]}
+                for rid in rids if rid not in finals
+            ]
+            resp = srv.dispatch("poll_many", {"items": items}, "default")
+            for entry in resp["results"]:
+                rid = entry["request_id"]
+                if entry.get("done"):
+                    finals[rid] = entry
+                    continue
+                assert entry["from"] == cursors[rid]
+                assembled[rid].extend(entry["tokens"])
+                cursors[rid] = entry["tokens_so_far"]
+            time.sleep(0.005)
+        assert len(finals) == len(rids), "poll_many requests never finished"
+        for rid in rids:
+            # completions carry the FULL list — the exactly-once dedup record
+            assert finals[rid]["tokens"][:len(assembled[rid])] == assembled[rid]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def _assemble_frames(frames_iter):
+    """Fold push frames into (tokens, final_frame, n_frames), asserting
+    prefix consistency: each frame's delta lands at its `from` cursor."""
+    assembled, final, n = [], None, 0
+    for frame in frames_iter:
+        n += 1
+        if "tokens" in frame:
+            assert frame["from"] == len(assembled), "stream frame re-cut"
+            assembled.extend(frame["tokens"])
+            assert frame["tokens_so_far"] == len(assembled)
+        if frame.get("done"):
+            final = frame
+            break
+    return assembled, final, n
+
+
+def test_push_stream_roundtrip(model_and_params):
+    """stream=True submit: frames on the submit connection, multi-token
+    deltas from speculative rounds, oracle-identical tokens, clean final
+    frame — and the connection's framing survives for a SECOND stream."""
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    s = make_session(model_and_params, speculate_k=4)
+    srv = ServingServer(session=s).start()
+    try:
+        c = ServingClient(srv.address)
+        toks, final, n_frames = _assemble_frames(c.stream(PROMPT, 16))
+        assert final is not None and final["finish_reason"] in ("length", "eos")
+        assert n_frames >= 1
+        # oracle: the same request non-streamed on a fresh identical engine
+        oracle = make_session(model_and_params, speculate_k=4)
+        h = oracle.submit(PROMPT, 16)
+        oracle.run_until_idle()
+        oracle.stop()
+        assert toks == h.tokens
+        # stats surface counts the pushed frames
+        assert c.stats()["stream_frames_pushed"] >= n_frames
+        # the generator-based client reuses nothing: a second stream works
+        toks2, final2, _ = _assemble_frames(c.stream(PROMPT, 16))
+        assert toks2 == toks and final2["finish_reason"] == final["finish_reason"]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_stream_attach_midflight(model_and_params):
+    """The `stream` RPC attaches to an in-flight request AT A CURSOR: a
+    subscriber that already holds a prefix receives only the rest (never a
+    replay of delivered tokens), and prefix + frames equals the full
+    sequence. This is the reattach path a dropped push-stream resumes on.
+    The engine is held and stepped by a pump thread so the attach lands
+    mid-flight deterministically."""
+    import threading
+
+    from paddle_tpu.runtime.master import MasterClient
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    s = make_session(model_and_params)
+    s._thread = True  # hold the engine; the pump below steps it
+    srv = ServingServer(session=s).start()
+    try:
+        c = ServingClient(srv.address)
+        rid = c.submit(PROMPT, 20)
+        # step by hand until a prefix exists, BEFORE any pusher runs
+        prefix = []
+        for _ in range(50):
+            s.step()
+            resp = c.poll(rid, from_=0)
+            if len(resp.get("tokens") or []) >= 3:
+                prefix = list(resp["tokens"])
+                break
+        assert prefix and not resp.get("done")
+        # pump the rest of the generation while the stream is attached
+        pump = threading.Thread(
+            target=lambda: [
+                (s.step(), time.sleep(0.002))
+                for _ in iter(lambda: s.scheduler.has_work(), False)
+            ],
+            daemon=True,
+        )
+        pump.start()
+        conn = MasterClient([srv.address], timeout=10.0)
+        frames = conn.call_stream(
+            "stream", **{"from": len(prefix)}, request_id=rid,
+        )
+        ack = next(frames)
+        assert "err" not in ack and ack["from"] == len(prefix)
+        got = list(prefix)
+        final = None
+        for frame in frames:
+            assert frame["from"] == len(got), "attach replayed or skipped"
+            got.extend(frame["tokens"])
+            assert frame["tokens_so_far"] == len(got)
+            if frame.get("done"):
+                final = frame
+                break
+        pump.join(timeout=30)
+        full = c.poll(rid)
+        assert full["done"] and got == full["tokens"] and len(got) == 20
+        assert final is not None and final["finish_reason"] in ("length", "eos")
+        conn.close()
+        c.close()
+    finally:
+        s._thread = None
+        srv.stop()
+
+
+def test_router_stream_and_delta_poll(model_and_params):
+    """Streaming THROUGH the router: client frames cut as the router's
+    mirror advances, tokens identical to the routed non-streamed path, and
+    delta polling works against the router's mirror too."""
+    from paddle_tpu.serving.router import RouterServer
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    router = RouterServer(lease_s=5.0, poll_interval_s=0.005).start()
+    sessions = [
+        make_session(model_and_params, speculate_k=4) for _ in range(2)
+    ]
+    servers = [
+        ServingServer(session=s, router_endpoints=router.address).start()
+        for s in sessions
+    ]
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and len(router.fleet.live()) < 2:
+            time.sleep(0.02)
+        c = ServingClient(router.address)
+        toks, final, n_frames = _assemble_frames(c.stream(PROMPT, 16))
+        assert final is not None and n_frames >= 1
+        oracle = c.generate(PROMPT, 16)
+        assert toks == oracle["tokens"], (
+            "streamed tokens must equal the routed non-streamed path "
+            "(replica choice cannot change results)"
+        )
+        # delta poll against the router mirror
+        rid = c.submit(PLAIN, 8)
+        assembled, final2 = _drain_poll(c, rid)
+        assert final2["tokens"][:len(assembled)] == assembled
+        assert len(final2["tokens"]) == 8
+        assert router.stream_frames >= n_frames
+        c.close()
+    finally:
+        for srv in servers:
+            srv.stop()
+        router.stop()
