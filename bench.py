@@ -472,7 +472,6 @@ def run_serving_speculative() -> list:
             max_new_limit=max_new, speculate_k=speculate_k,
         )
         run_closed_loop(session, warm, max_new, concurrency=len(warm))
-        session.scheduler.reset_load_estimate()
         res = run_closed_loop(session, prompts, max_new, concurrency=1)
         return res, session.stats()
 

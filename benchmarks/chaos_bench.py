@@ -64,9 +64,25 @@ KV page leaks on surviving replicas, goodput retention >= 0.7 vs the
 unfaulted 3-replica run, and failover re-execution token-bitwise for both
 greedy and sampled streams (the router pins every request's seed).
 
+--mode autoscale (ISSUE 17) drills the goodput-driven autoscaler: a real
+router + replica fleet and a real TCP master share a fixed chip budget, and
+an idle → 2× burst → idle offered-load schedule (calibrated to one
+replica's measured capacity) is replayed twice over identical arrivals —
+once against a static provision-for-peak fleet, once against the minimum
+fleet plus the controller, which must spawn replicas into the burst
+(reclaiming chips from training via resize epochs when none are free) and
+drain + lend chips back when idle. The controller is KILLED by the seeded
+`controller_kill` fault mid-resize-epoch and a cold restart must reconcile
+from observed state. Gates: burst-phase goodput retention >= 0.8 vs static,
+idle-phase serving chips >= 30% below static, zero lost requests in both
+runs, exactly-once task accounting across every triggered resize epoch, no
+epoch left open, the kill landed mid-epoch, and the restarted controller
+went on to make decisions.
+
 Usage:
   JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py
-      [--mode local|cluster|resize|serving|router] [--faults SPEC] [--seed N]
+      [--mode local|cluster|resize|serving|router|autoscale]
+      [--faults SPEC] [--seed N]
 """
 
 from __future__ import annotations
@@ -799,13 +815,12 @@ def serving_overload_leg(args, backend: str) -> dict:
     def fresh():
         s = _serving_session(args)
         # round 1 warms every executable; its per-request times include the
-        # jit compiles (seconds), which would poison the service-time EWMA
-        # the load-aware admission check reasons from — so reset and re-seed
-        # with a steady-state round 2 (milliseconds)
+        # jit compiles (seconds), but the session resets the poisoned EWMA
+        # itself at the first clean post-compile step (ISSUE 17) — round 2
+        # then re-seeds it with steady-state (millisecond) service times
         warm = make_prompts(4, lengths=(8, 16), vocab=128, bos_id=1, seed=9)
         run_closed_loop(s, warm, args.serving_max_new,
                         concurrency=args.serving_slots)
-        s.scheduler.reset_load_estimate()
         seed_round = make_prompts(8, lengths=lengths, vocab=128, bos_id=1,
                                   seed=10)
         run_closed_loop(s, seed_round, args.serving_max_new,
@@ -1062,6 +1077,496 @@ def run_router(args) -> dict:
     }
 
 
+def run_autoscale(args) -> dict:
+    """Autoscaler drill (ISSUE 17): the goodput-driven controller steering a
+    REAL fleet — router + in-process replicas on the serving side, a real
+    TCP master + cluster_reader consumers on the training side — through an
+    idle → 2× burst → idle offered-load schedule, with the controller
+    KILLED (seeded `controller_kill`) mid-resize-epoch and a fresh one
+    started cold to reconcile from observed state.
+
+    Two runs over the IDENTICAL arrival schedule (workload.expand_schedule):
+
+      * static: max_replicas always on, no controller — the
+        provision-for-peak baseline;
+      * autoscaled: min_replicas + the controller, which must spawn into
+        the burst (borrowing chips back from training via resize epochs
+        when none are free) and drain + lend chips to training when idle.
+
+    Gates: burst-phase goodput retention >= 0.8 vs static; mean serving
+    chips across the idle phases >= 30% below static; zero lost requests
+    (every accepted request ends with a named reason, both runs);
+    exactly-once task accounting across every triggered resize epoch
+    (done == ntasks, discarded == 0, no epoch left open); the kill landed
+    mid-epoch and the restarted controller went on to act."""
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    import jax
+
+    from paddle_tpu.core import faults
+    from paddle_tpu.runtime import recordio
+    from paddle_tpu.runtime.autoscaler import (
+        AutoscalerController, ScaleConfig,
+    )
+    from paddle_tpu.runtime.master import (
+        MasterClient, MasterServer, TaskMaster, cluster_reader,
+    )
+    from paddle_tpu.serving.quota import QuotaExceeded
+    from paddle_tpu.serving.router import RouterServer
+    from paddle_tpu.serving.server import ServingServer
+    from paddle_tpu.serving.workload import (
+        expand_schedule, make_prompts, run_closed_loop,
+    )
+
+    backend = jax.default_backend()
+    max_rep = args.autoscale_max_replicas
+    init_world = args.autoscale_train_world
+    max_new = args.autoscale_max_new
+    lengths = (5, 8, 11)
+
+    def warmed_session():
+        # a heavier demo model than the other serving drills: more tokens
+        # per request makes one replica's capacity a few tens of rps, so
+        # the calibrated burst is a rate a Python submit loop can actually
+        # sustain and queue waits move on human-scale thresholds
+        from paddle_tpu.serving.session import make_demo_session
+
+        s = make_demo_session(
+            vocab=128, n_layers=4, d_model=64, n_heads=4, seed=0,
+            max_slots=args.serving_slots, page_size=8,
+            prefill_buckets=(8, 16), max_new_limit=max_new,
+        )
+        # two warm waves: the first pays every jit trace, and the SECOND
+        # re-seeds the service-time EWMA with clean post-compile samples —
+        # the session's auto-reset (ISSUE 17) fires at the first clean
+        # step, but wave-1 requests completing after it still carry their
+        # compile stalls, so without wave 2 the wait estimator's floor
+        # would sit seconds high and the router would shed everything
+        warm = make_prompts(4, lengths=(8, 16), vocab=128, bos_id=1, seed=9)
+        run_closed_loop(s, warm, max_new, concurrency=args.serving_slots)
+        meas = make_prompts(16, lengths=lengths, vocab=128, bos_id=1,
+                            seed=11)
+        run_closed_loop(s, meas, max_new, concurrency=args.serving_slots)
+        return s
+
+    # calibrate the schedule to THIS host: one replica's closed-loop
+    # capacity prices the burst (2x one replica: the static max fleet can
+    # absorb it, the autoscaled min fleet cannot — until it scales)
+    cap_sess = warmed_session()
+    cap = run_closed_loop(
+        cap_sess,
+        make_prompts(args.serving_requests, lengths=lengths, vocab=128,
+                     bos_id=1, seed=args.seed),
+        max_new, concurrency=args.serving_slots,
+    )
+    cap_rps = (cap["requests"] / cap["wall_s"]) if cap["wall_s"] else 10.0
+    svc_s = max(1e-3, cap["p50_latency_ms"] / 1e3)
+    # the wait estimator never reads zero: an empty queue still prices one
+    # EWMA service time (the request's own decode).  Measure that floor on
+    # the drained calibration session and put the controller's low band
+    # ABOVE it, or scale-down can never fire.
+    idle_floor_s = float(cap_sess.scheduler.estimate_wait_s())
+    deadline_s = max(1.5, args.serving_deadline_svc_mult * svc_s)
+    low_wait_s = max(3.0 * svc_s, 2.5 * idle_floor_s)
+    high_wait_s = max(6.0 * svc_s, 5.0 * idle_floor_s, 0.4 * deadline_s,
+                      2.0 * low_wait_s)
+    burst_rate = args.autoscale_burst_mult * cap_rps
+    burst_s = min(args.autoscale_burst_s,
+                  max(2.0, args.autoscale_burst_cap / burst_rate))
+    idle_rate = max(1.0, 0.05 * cap_rps)
+    schedule = [
+        (args.autoscale_idle_s, idle_rate),
+        (burst_s, burst_rate),
+        (args.autoscale_tail_s, idle_rate),
+    ]
+    total_s = sum(d for d, _ in schedule)
+    arrivals = expand_schedule(10 ** 6, schedule)
+    prompts = make_prompts(len(arrivals), lengths=lengths, vocab=128,
+                           bos_id=1, seed=args.seed)
+    # the idle-phase windows the chips gate integrates over
+    idle_windows = [
+        (0.0, args.autoscale_idle_s),
+        (total_s - args.autoscale_tail_s, total_s),
+    ]
+
+    def drive(r) -> dict:
+        """Replay the arrival schedule against the router; per-phase
+        accounting keyed by each request's ARRIVAL phase."""
+        handles, hphase = {}, {}
+        shed_by_phase = {}
+        t0 = _time.time()
+        for idx, (off, ph) in enumerate(arrivals):
+            now = _time.time()
+            if t0 + off > now:
+                _time.sleep(t0 + off - now)
+            try:
+                handles[idx] = r.submit(
+                    prompts[idx], max_new,
+                    tenant=f"tenant{idx % 3}", deadline_s=deadline_s,
+                )
+                hphase[idx] = ph
+            except QuotaExceeded:
+                shed_by_phase[ph] = shed_by_phase.get(ph, 0) + 1
+        done_deadline = _time.time() + 120
+        for h in handles.values():
+            h._event.wait(max(0.1, done_deadline - _time.time()))
+        wall = _time.time() - t0
+        named = _named_reasons()
+        all_accounted = all(h.done for h in handles.values()) and all(
+            h.finish_reason in named for h in handles.values()
+        )
+        phases = []
+        for p, (dur, rate) in enumerate(schedule):
+            idxs = [i for i, ph in hphase.items() if ph == p]
+            ok = sum(
+                1 for i in idxs if handles[i].status == handles[i].DONE
+            )
+            phases.append({
+                "phase": p, "duration_s": round(dur, 2),
+                "rate_rps": round(rate, 2),
+                "offered": sum(1 for _, ph in arrivals if ph == p),
+                "accepted": len(idxs),
+                "shed": shed_by_phase.get(p, 0),
+                "completed_ok": ok,
+                "goodput_rps": round(ok / dur, 2) if dur else 0.0,
+            })
+        return {
+            "accepted": len(handles),
+            "shed": sum(shed_by_phase.values()),
+            "completed_ok": sum(
+                1 for h in handles.values() if h.status == h.DONE
+            ),
+            "all_accounted_with_named_reason": bool(all_accounted),
+            "phases": phases,
+            "wall_s": round(wall, 3),
+        }
+
+    def sampler(router_srv, msrv, samples, stop_evt, t0):
+        """Chip-ledger sampling: serving chips = live + draining replicas
+        (a draining replica still holds its chip); training chips = the
+        resize plane's world."""
+        while not stop_evt.wait(0.15):
+            reps = router_srv.router.fleet.replicas()
+            serving = sum(
+                1 for rep in reps if rep.state in ("live", "draining")
+            )
+            world = (
+                msrv.resize.info()["world"] if msrv is not None
+                else init_world
+            )
+            samples.append((_time.time() - t0, serving, world))
+
+    def idle_mean_chips(samples, col) -> float:
+        vals = [
+            s[col] for s in samples
+            if any(lo <= s[0] <= hi for lo, hi in idle_windows)
+        ]
+        return (sum(vals) / len(vals)) if vals else 0.0
+
+    def run_static() -> dict:
+        router = RouterServer(lease_s=1.0, poll_interval_s=0.01).start()
+        servers = [
+            ServingServer(
+                session=(cap_sess if i == 0 else warmed_session()),
+                router_endpoints=router.address,
+            ).start()
+            for i in range(max_rep)
+        ]
+        deadline = _time.time() + 30
+        while _time.time() < deadline and len(router.fleet.live()) < max_rep:
+            _time.sleep(0.02)
+        samples, stop_evt = [], threading.Event()
+        smp = threading.Thread(
+            target=sampler,
+            args=(router, None, samples, stop_evt, _time.time()),
+            daemon=True,
+        )
+        smp.start()
+        out = drive(router.router)
+        stop_evt.set()
+        smp.join(timeout=5)
+        for srv in servers:
+            srv.stop()
+        router.stop()
+        out["idle_serving_chips_mean"] = round(
+            idle_mean_chips(samples, 1), 3
+        )
+        return out
+
+    def run_autoscaled() -> dict:
+        tmp = tempfile.mkdtemp(prefix="chaos_autoscale_")
+        nrec = args.autoscale_tasks * args.records_per_task
+        msrv = router = None
+        boot = None
+        controllers = []
+        try:
+            # training plane: real master (resize epochs) + consumers that
+            # drain through every epoch's barrier mid-pass
+            shards = recordio.convert(
+                os.path.join(tmp, "ds"),
+                lambda: ({"sid": i} for i in range(nrec)),
+                records_per_file=args.records_per_task,
+            )
+            msrv = MasterServer(
+                TaskMaster(timeout_s=30.0, failure_max=10), lease_s=1.5,
+                resize_drain_timeout_s=6.0, initial_world=init_world,
+            ).start()
+            boot = MasterClient(msrv.address)
+            boot.call("set_dataset", shards=shards, chunks_per_task=1)
+            consumed = [[] for _ in range(args.consumers)]
+            # size the per-record work so the training pass outlives the
+            # whole load schedule — otherwise the consumers finish before
+            # the controller's first resize and every drain barrier is
+            # trivially empty (nobody left to drain through it)
+            work_s = max(args.autoscale_work_ms / 1e3,
+                         (total_s + 8.0) * args.consumers / nrec)
+
+            def consume(i):
+                rd = cluster_reader(
+                    msrv.address, client_kw={"retries": 40, "timeout": 5},
+                    poll_interval=0.05,
+                )
+                for rec in rd():
+                    consumed[i].append(rec["sid"])
+                    _time.sleep(work_s)
+
+            consumers = [
+                threading.Thread(target=consume, args=(i,), daemon=True)
+                for i in range(args.consumers)
+            ]
+
+            # serving plane: router + ONE live replica; the spawn lever
+            # draws warmed sessions from a pool through the spawner seam
+            # (the subprocess ReplicaSpawner's in-process stand-in)
+            router = RouterServer(lease_s=1.0, poll_interval_s=0.01).start()
+            # all-fresh sessions: cap_sess was consumed by the static run
+            # (ServingServer.stop() retires its engine)
+            pool = [warmed_session() for _ in range(max_rep + 1)]
+            servers = []
+
+            class _InProcSpawner:
+                def __init__(self):
+                    self.spawned = 0
+                    self.exhausted = 0
+
+                def spawn(self):
+                    if not pool:
+                        self.exhausted += 1
+                        return None
+                    self.spawned += 1
+                    sess = pool.pop(0)
+                    srv = ServingServer(
+                        session=sess, router_endpoints=router.address,
+                    )
+                    # drained replica exits and releases its chip (the
+                    # --exit_on_drain lifecycle, in-process: stop off the
+                    # agent thread, which fires this callback)
+                    srv.on_drained = lambda srv=srv: threading.Thread(
+                        target=srv.stop, daemon=True
+                    ).start()
+                    srv.start()
+                    servers.append(srv)
+                    return srv
+
+                def reap(self):
+                    return len(servers)
+
+                def stop_all(self):
+                    pass  # the drill stops servers itself
+
+            spawner = _InProcSpawner()
+            spawner.spawn()  # the min fleet
+            deadline = _time.time() + 30
+            while _time.time() < deadline and len(router.fleet.live()) < 1:
+                _time.sleep(0.02)
+            # consumers start only now — AFTER the (slow) pool warm-up —
+            # so the training pass overlaps the controller's lifetime
+            for t in consumers:
+                t.start()
+
+            cfg = ScaleConfig(
+                chips_total=args.autoscale_chips, chips_per_replica=1,
+                min_replicas=1, max_replicas=max_rep,
+                train_min_world=1,
+                train_max_world=args.autoscale_train_max_world,
+                high_wait_s=high_wait_s, low_wait_s=low_wait_s,
+                high_ticks=2, low_ticks=5,
+                serving_cooldown_s=0.8, train_cooldown_s=1.0,
+                flap_window_s=1.5, startup_quiet_s=0.4,
+                backoff_base_s=0.5, backoff_max_s=8.0,
+                resize_timeout_s=30.0, drain_deadline_s=8.0,
+            )
+
+            def build_ctl():
+                return AutoscalerController(
+                    router_endpoints=router.address,
+                    master_endpoints=msrv.address,
+                    config=cfg, spawner=spawner,
+                    tick_s=args.autoscale_tick_s,
+                )
+
+            ctl = build_ctl().start()
+            controllers.append(ctl)
+            kill_info = {}
+
+            def killer():
+                # wait for a resize epoch to be IN FLIGHT, then fire the
+                # seeded controller_kill at the top of the next tick —
+                # death lands mid-epoch; a cold controller takes over
+                deadline = _time.time() + total_s
+                while _time.time() < deadline:
+                    if msrv.resize.info()["state"] != "idle":
+                        break
+                    _time.sleep(0.02)
+                else:
+                    kill_info["no_epoch_started"] = True
+                    return
+                kill_info["epoch_state_at_kill"] = (
+                    msrv.resize.info()["state"]
+                )
+                faults.ACTIVE.configure("controller_kill:step=0", args.seed)
+                wait = _time.time() + 15
+                while not ctl.dead and _time.time() < wait:
+                    _time.sleep(0.02)
+                faults.ACTIVE.configure("")
+                kill_info["killed"] = bool(ctl.dead)
+                ctl2 = build_ctl().start()
+                controllers.append(ctl2)
+
+            kt = threading.Thread(target=killer, daemon=True)
+            kt.start()
+            samples, stop_evt = [], threading.Event()
+            smp = threading.Thread(
+                target=sampler,
+                args=(router, msrv, samples, stop_evt, _time.time()),
+                daemon=True,
+            )
+            smp.start()
+            out = drive(router.router)
+            stop_evt.set()
+            smp.join(timeout=5)
+            kt.join(timeout=5)
+            for c in controllers:
+                c.stop()
+            for t in consumers:
+                t.join(timeout=120)
+            st = boot.call("stats")
+            rz = msrv.resize.info()
+            flat = [x for c in consumed for x in c]
+            out.update({
+                "idle_serving_chips_mean": round(
+                    idle_mean_chips(samples, 1), 3
+                ),
+                "max_serving_chips": max((s[1] for s in samples), default=0),
+                "max_train_world": max((s[2] for s in samples), default=0),
+                "spawner": {
+                    "spawned": spawner.spawned,
+                    "pool_exhausted": spawner.exhausted,
+                },
+                "controllers": [c.stats() for c in controllers],
+                "kill": kill_info,
+                "router": {
+                    k: v for k, v in router.router.stats().items()
+                    if k != "replicas"
+                },
+                "master": {
+                    "done": st.get("done"),
+                    "discarded": st.get("discarded"),
+                    "resize_completed": rz.get("completed", 0),
+                    "resize_state": rz.get("state"),
+                    "final_world": rz.get("world"),
+                    "records_delivered": len(flat),
+                    "records_replayed": len(flat) - len(set(flat)),
+                    "coverage_complete": set(flat) == set(range(nrec)),
+                },
+            })
+            for srv in servers:
+                srv.stop()
+            return out
+        finally:
+            faults.ACTIVE.configure("")
+            for c in controllers:
+                c.stop()
+            if boot is not None:
+                boot.close()
+            if router is not None:
+                router.stop()
+            if msrv is not None:
+                msrv.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    static = run_static()
+    auto = run_autoscaled()
+
+    def burst_goodput(run):
+        return run["phases"][1]["goodput_rps"]
+
+    retention = (
+        burst_goodput(auto) / burst_goodput(static)
+        if burst_goodput(static) else 0.0
+    )
+    reduction = (
+        1.0 - auto["idle_serving_chips_mean"]
+        / static["idle_serving_chips_mean"]
+        if static["idle_serving_chips_mean"] else 0.0
+    )
+    m = auto["master"]
+    exactly_once = (
+        m["done"] == args.autoscale_tasks and m["discarded"] == 0
+        and m["coverage_complete"]
+    )
+    kill = auto["kill"]
+    gates = {
+        "burst_goodput_retention_ge_0p8": retention >= 0.8,
+        "idle_chips_reduction_ge_0p3": reduction >= 0.3,
+        "zero_lost_requests": bool(
+            static["all_accounted_with_named_reason"]
+            and auto["all_accounted_with_named_reason"]
+        ),
+        "exactly_once_tasks": bool(exactly_once),
+        "no_epoch_left_open": (
+            m["resize_state"] == "idle" and m["resize_completed"] >= 1
+        ),
+        "controller_killed_mid_epoch": bool(
+            kill.get("killed")
+            and kill.get("epoch_state_at_kill") in ("draining", "go")
+        ),
+        "restarted_controller_acted": (
+            len(auto["controllers"]) == 2
+            and auto["controllers"][1]["decisions"] >= 1
+        ),
+        "scaled_up_into_burst": auto["max_serving_chips"] >= 2,
+        "chips_lent_to_training": auto["max_train_world"] > init_world,
+    }
+    return {
+        "metric": "autoscale_burst_goodput_retention",
+        "value": round(retention, 3),
+        "unit": "x burst-phase goodput, autoscaled-from-min vs static-max "
+                "fleet (controller killed+restarted mid-epoch)",
+        "platform": backend,
+        "all_gates_pass": all(gates.values()),
+        "gates": gates,
+        "idle_chips_reduction": round(reduction, 3),
+        "calibration": {
+            "one_replica_capacity_rps": round(cap_rps, 2),
+            "svc_p50_s": round(svc_s, 4),
+            "idle_floor_s": round(idle_floor_s, 4),
+            "low_wait_s": round(low_wait_s, 4),
+            "high_wait_s": round(high_wait_s, 4),
+            "deadline_s": round(deadline_s, 3),
+            "schedule": [
+                [round(d, 2), round(r, 2)] for d, r in schedule
+            ],
+        },
+        "static": static,
+        "autoscaled": auto,
+        "seed": args.seed,
+    }
+
+
 def run_serving(args) -> dict:
     """Serving resilience drill (see module docstring)."""
     import jax
@@ -1121,13 +1626,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="local",
                     choices=["local", "cluster", "resize", "serving",
-                             "router"],
+                             "router", "autoscale"],
                     help="local: in-process throughput-under-faults; "
                          "cluster: multi-process master-failover drill; "
                          "resize: live elastic grow/shrink mid-pass drill; "
                          "serving: engine-kill + overload-shedding drill; "
                          "router: multi-replica kill+wedge failover drill "
-                         "(exactly-once, page-leak, goodput + bitwise gates)")
+                         "(exactly-once, page-leak, goodput + bitwise "
+                         "gates); autoscale: goodput-driven controller "
+                         "vs idle/burst/idle load, killed+restarted "
+                         "mid-resize-epoch")
     ap.add_argument("--faults", default=DEFAULT_FAULTS,
                     help="input-side fault mix for the chaos mode")
     ap.add_argument("--seed", type=int, default=0)
@@ -1215,7 +1723,49 @@ def main():
                          "parked between steps (longer than the lease, so "
                          "it is evicted; then it heals and its stale "
                          "answers exercise the late-winner dedup)")
+    ap.add_argument("--autoscale_chips", type=int, default=4,
+                    help="autoscale mode: total chip budget shared by the "
+                         "serving fleet and the training world")
+    ap.add_argument("--autoscale_max_replicas", type=int, default=3,
+                    help="autoscale mode: serving fleet ceiling (and the "
+                         "static baseline's constant fleet size)")
+    ap.add_argument("--autoscale_train_world", type=int, default=1,
+                    help="autoscale mode: training world at t=0")
+    ap.add_argument("--autoscale_train_max_world", type=int, default=2,
+                    help="autoscale mode: training world ceiling (chips "
+                         "lent by the idle serving fleet)")
+    ap.add_argument("--autoscale_idle_s", type=float, default=3.0,
+                    help="autoscale mode: leading idle-phase duration")
+    ap.add_argument("--autoscale_burst_s", type=float, default=8.0,
+                    help="autoscale mode: burst-phase duration ceiling")
+    ap.add_argument("--autoscale_tail_s", type=float, default=6.0,
+                    help="autoscale mode: trailing idle-phase duration")
+    ap.add_argument("--autoscale_burst_mult", type=float, default=2.0,
+                    help="autoscale mode: burst rate as a multiple of one "
+                         "replica's measured closed-loop capacity")
+    ap.add_argument("--autoscale_burst_cap", type=float, default=600.0,
+                    help="autoscale mode: max burst arrivals (shortens the "
+                         "burst phase on very fast hosts)")
+    ap.add_argument("--autoscale_max_new", type=int, default=48,
+                    help="autoscale mode: decode tokens per request (more "
+                         "than the other serving drills, so one replica's "
+                         "capacity is a rate a Python submit loop can "
+                         "oversubscribe)")
+    ap.add_argument("--autoscale_tick_s", type=float, default=0.2,
+                    help="autoscale mode: controller tick period")
+    ap.add_argument("--autoscale_tasks", type=int, default=16,
+                    help="autoscale mode: training tasks for the "
+                         "exactly-once-across-resizes gate")
+    ap.add_argument("--autoscale_work_ms", type=float, default=400.0,
+                    help="autoscale mode: per-record consumer work (keeps "
+                         "the training pass alive across the whole load "
+                         "schedule so resizes land mid-pass)")
     args = ap.parse_args()
+
+    if args.mode == "autoscale":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(run_autoscale(args)))
+        return
 
     if args.mode == "serving":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

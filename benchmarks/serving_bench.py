@@ -92,10 +92,9 @@ def run_one(args, concurrency: int, prompts):
         session, warm_prompts, args.max_new, concurrency=len(warm_prompts)
     )
     sigs_after_warmup = session.decode_shape_signatures()
-    # the warmup's compile-heavy per-request times must not leak into the
-    # measured run's load-aware admission (they read as second-scale service
-    # times and would shed everything against --deadline_s)
-    session.scheduler.reset_load_estimate()
+    # the warmup's compile-heavy per-request times never leak into the
+    # measured run's load-aware admission: the session resets the EWMA
+    # itself at the first clean post-compile step (ISSUE 17)
     res = run_closed_loop(
         session, prompts, args.max_new, concurrency,
         deadline_s=args.deadline_s or None,
@@ -168,7 +167,6 @@ def run_mixed_length(args):
         )
         run_closed_loop(session, warm, args.max_new, concurrency=len(warm))
         sigs0 = session.decode_shape_signatures()
-        session.scheduler.reset_load_estimate()
         prompts = make_mixed_prompts(
             args.requests, short_lengths=(5, 11, 16), long_len=long_len,
             long_every=12, burst=args.mixed_burst, vocab=args.vocab,
@@ -300,7 +298,6 @@ def run_speculative(args):
         run_closed_loop(session, warm, args.spec_max_new, concurrency=len(warm))
         sigs0 = session.decode_shape_signatures()
         vsigs0 = session.verify_shape_signatures()
-        session.scheduler.reset_load_estimate()
         res = run_closed_loop(
             session, prompts, args.spec_max_new, concurrency=1,
         )
@@ -393,7 +390,6 @@ def run_streaming(args):
         bos_id=1, seed=7,
     )
     run_closed_loop(session, warm, args.stream_max_new, concurrency=len(warm))
-    session.scheduler.reset_load_estimate()
     router = RouterServer(lease_s=5.0, poll_interval_s=0.005).start()
     server = ServingServer(session=session, router_endpoints=router.address)
     server.start()
@@ -535,7 +531,6 @@ def run_tp_child(args):
     )
     run_closed_loop(session, warm, args.max_new, concurrency=len(warm))
     sigs0 = session.decode_shape_signatures()
-    session.scheduler.reset_load_estimate()
     res = run_closed_loop(session, prompts, args.max_new, concurrency=16)
     tokens = res.pop("results")
     st = session.stats()
@@ -683,7 +678,6 @@ def run_replicas(args):
                 bos_id=1, seed=7,
             )
             run_closed_loop(s, warm, args.max_new, concurrency=len(warm))
-            s.scheduler.reset_load_estimate()
             sessions.append(s)
         router = RouterServer(lease_s=5.0, poll_interval_s=0.005).start()
         servers = [

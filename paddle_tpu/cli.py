@@ -948,6 +948,10 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
                    help="replica self-fence: with work pending and no engine "
                         "progress for this long (between steps), heartbeats "
                         "to the router stop so its lease can lapse")
+    p.add_argument("--exit_on_drain", action="store_true",
+                   help="exit cleanly when a router-ordered planned drain "
+                        "completes (the autoscaler's spawn/drain replica "
+                        "lifecycle, ISSUE 17)")
     # demo model shape knobs (ignored with --load)
     p.add_argument("--max_len", type=int, default=0,
                    help="demo model position-embedding capacity (0 = largest "
@@ -1052,6 +1056,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         )
         return 2
 
+    stop_evt = threading.Event()
     server = ServingServer(
         session=session, gen_session=gen_session,
         host=args.host, port=args.port, lease_s=args.lease_s,
@@ -1060,8 +1065,11 @@ def cmd_serve(args: argparse.Namespace) -> int:
         router_endpoints=args.router_endpoints,
         advertise_host=args.advertise_host,
         stall_fence_s=args.stall_fence_s,
+        # autoscaler spawn/drain lifecycle (ISSUE 17): a router-ordered
+        # drain completing shuts this process down cleanly, releasing the
+        # chip the controller reclaimed
+        on_drained=(stop_evt.set if args.exit_on_drain else None),
     ).start()
-    stop_evt = threading.Event()
     _signal.signal(_signal.SIGTERM, lambda *_: stop_evt.set())
     _signal.signal(_signal.SIGINT, lambda *_: stop_evt.set())
     print(json.dumps({"role": "serve", "address": list(server.address)}),

@@ -57,6 +57,15 @@ Known sites (hooks live next to the code they sabotage):
                    supervisor's stall watchdog must supersede
                    and restart it; stall length via
                    PADDLE_TPU_SERVING_STALL_S (default 300)
+    controller_kill  autoscaler controller dies at the top of (runtime.autoscaler
+                   a tick — the fleet it steered must degrade  .AutoscalerController.tick)
+                   to a static fleet (liveness never depends
+                   on the controller); a restarted controller
+                   reconciles from observed state
+    scale_decision_stall  autoscaler tick wedges before        (runtime.autoscaler
+                   deciding — must stall only the controller,  .AutoscalerController.tick)
+                   never serving/training; stall length via
+                   PADDLE_TPU_SCALE_STALL_S (default 300)
 
 Seeding: `PADDLE_TPU_FAULTS_SEED` (or the `seed` argument). Each site gets
 its own `random.Random(f"{seed}:{site}")` stream, so the fire pattern of one

@@ -346,6 +346,38 @@ def observe_router_shed(reason: str) -> None:
     ).inc(reason=reason)
 
 
+def observe_scale_decision(lever: str, direction: str) -> None:
+    """The autoscaler admitted one scale action past its hysteresis /
+    cooldown / flap gates; lever is 'serving' (spawn/drain) or 'train'
+    (resize epoch), direction 'grow' or 'shrink'. Counters (not gauges) on
+    purpose: decisions accumulate, and the controller's own process is
+    expendable — rates come from deltas, not last-values."""
+    REGISTRY.counter(
+        "paddle_tpu_autoscaler_decisions_total",
+        "autoscaler scale actions admitted, by lever and direction",
+    ).inc(lever=lever, direction=direction)
+
+
+def observe_scale_suppressed(reason: str) -> None:
+    """The decision engine wanted an action but a rate-limit gate held it:
+    reason is 'startup' (post-restart quiet period), 'cooldown',
+    'flap' (direction reversal inside the flap window) or 'backoff'
+    (after a rejected/timed-out resize)."""
+    REGISTRY.counter(
+        "paddle_tpu_autoscaler_suppressed_total",
+        "autoscaler actions suppressed by rate-limit gates, by reason",
+    ).inc(reason=reason)
+
+
+def observe_scale_rejected(lever: str) -> None:
+    """A pulled lever refused the order (resize rejected by the master's
+    one-epoch-at-a-time rule, or timed out) — the backoff trigger."""
+    REGISTRY.counter(
+        "paddle_tpu_autoscaler_rejected_total",
+        "autoscaler lever pulls rejected or timed out, by lever",
+    ).inc(lever=lever)
+
+
 # -- heartbeat snapshots + fleet aggregation ---------------------------------
 
 

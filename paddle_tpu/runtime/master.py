@@ -820,6 +820,7 @@ class MasterServer:
         snapshot_interval_s: float = 0.0,
         resize_on_membership: bool = False,
         resize_drain_timeout_s: Optional[float] = None,
+        initial_world: int = 0,
     ):
         self.master = master or TaskMaster()
         self.master_lock = threading.Lock()
@@ -838,6 +839,12 @@ class MasterServer:
                 else max(4.0 * lease_s, 10.0)
             )
         )
+        # autoscaler hook (ISSUE 17): seed the resize plane's world so
+        # `stats()["resize"]["world"]` answers "what IS the training world"
+        # even before the first epoch — the stateless-reconciling
+        # controller re-derives desired state from this observed value
+        # instead of journaling its own actions
+        self.resize.world = int(initial_world)
         self.resize_on_membership = resize_on_membership
         # membership churn that lands while an epoch is in flight parks here
         # (announce() rejects overlapping epochs); the reaper re-announces

@@ -41,11 +41,14 @@ from paddle_tpu.runtime.master import EndpointsLike, MasterClient
 log = logging.getLogger("paddle_tpu.serving.fleet")
 
 # the load-snapshot keys a replica heartbeat piggybacks (subset of
-# ServingSession.stats()): everything the router's least-loaded choice and
-# fleet-wide shed reason about, nothing more — heartbeats stay small
+# ServingSession.stats()): everything the router's least-loaded choice,
+# fleet-wide shed AND the autoscaler's pressure signals (cumulative shed /
+# deadline-miss counters, ISSUE 17) reason about, nothing more — heartbeats
+# stay small and the controller reads the whole fleet with zero new RPCs
 LOAD_KEYS = (
     "queue_depth", "active_slots", "max_slots", "free_pages",
     "estimated_queue_wait_s", "engine_restarts", "decode_steps",
+    "shed", "deadline_misses",
 )
 
 

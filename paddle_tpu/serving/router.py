@@ -478,6 +478,17 @@ class Router:
                 ],
                 default=0.0,
             ),
+            # fleet-wide cumulative pressure counters, summed from the
+            # piggybacked per-replica snapshots (fleet.LOAD_KEYS) — the
+            # autoscaler's shed/deadline-miss signal, zero extra RPCs
+            "fleet_shed": sum(
+                int(r["load"].get("shed", 0) or 0)
+                for r in reps if r["state"] == "live"
+            ),
+            "fleet_deadline_misses": sum(
+                int(r["load"].get("deadline_misses", 0) or 0)
+                for r in reps if r["state"] == "live"
+            ),
         }
 
     # -- assignment path -----------------------------------------------------
@@ -1193,6 +1204,25 @@ def _main(argv: Optional[List[str]] = None) -> int:
                          "request past this is duplicated onto a second "
                          "replica, first token wins")
     sv.add_argument("--drain_deadline_s", type=float, default=30.0)
+    # autoscaler co-process (ISSUE 17): run the goodput-driven controller
+    # beside this router — it watches the stats this process already
+    # aggregates from heartbeats and pulls the spawn/drain (and, with
+    # --autoscale_master, training resize) levers. The router never
+    # depends on it: kill the controller and the fleet is simply static.
+    sv.add_argument("--autoscale", action="store_true",
+                    help="run an autoscaler controller for this router's "
+                         "fleet (see paddle_tpu/runtime/autoscaler.py)")
+    sv.add_argument("--autoscale_master", default=None,
+                    help="master host:port — arms the training resize "
+                         "lever so training borrows idle serving chips")
+    sv.add_argument("--autoscale_tick_s", type=float, default=1.0)
+    sv.add_argument("--autoscale_chips", type=int, default=8,
+                    help="total chip budget arbitrated across both fleets")
+    sv.add_argument("--autoscale_min_replicas", type=int, default=1)
+    sv.add_argument("--autoscale_max_replicas", type=int, default=8)
+    sv.add_argument("--autoscale_spawn_arg", action="append", default=None,
+                    help="repeatable: extra argv for spawned replicas "
+                         "(default: --demo)")
     for name in ("drain", "status"):
         p = sub.add_parser(name)
         p.add_argument("--endpoint", required=True, help="router host:port")
@@ -1208,12 +1238,45 @@ def _main(argv: Optional[List[str]] = None) -> int:
             hedge_ttft_s=args.hedge_ttft_s or None,
             drain_deadline_s=args.drain_deadline_s,
         ).start()
-        _signal.signal(_signal.SIGTERM, lambda *_: srv.stop())
-        _signal.signal(_signal.SIGINT, lambda *_: srv.stop())
-        print(json.dumps({"role": "router", "address": list(srv.address)}),
+        ctl = None
+        if args.autoscale:
+            from paddle_tpu.runtime.autoscaler import (
+                AutoscalerController, ReplicaSpawner, ScaleConfig,
+            )
+
+            ctl = AutoscalerController(
+                router_endpoints=srv.address,
+                master_endpoints=args.autoscale_master,
+                config=ScaleConfig(
+                    chips_total=args.autoscale_chips,
+                    min_replicas=args.autoscale_min_replicas,
+                    max_replicas=args.autoscale_max_replicas,
+                ),
+                spawner=ReplicaSpawner(
+                    srv.address,
+                    extra_args=(args.autoscale_spawn_arg
+                                if args.autoscale_spawn_arg is not None
+                                else ["--demo"]),
+                ),
+                tick_s=args.autoscale_tick_s,
+            ).start()
+
+        def _shutdown(*_):
+            if ctl is not None:
+                ctl.stop()
+            srv.stop()
+
+        _signal.signal(_signal.SIGTERM, _shutdown)
+        _signal.signal(_signal.SIGINT, _shutdown)
+        print(json.dumps({"role": "router", "address": list(srv.address),
+                          "autoscale": bool(args.autoscale)}),
               flush=True)
         while srv._thread is not None and srv._thread.is_alive():
             time.sleep(0.05)
+        if ctl is not None:
+            ctl.stop()
+            if ctl.spawner is not None:
+                ctl.spawner.stop_all()
         return 0
     client = MasterClient(args.endpoint)
     try:

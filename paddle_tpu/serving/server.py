@@ -172,6 +172,7 @@ class ServingServer:
         router_endpoints: Optional[EndpointsLike] = None,
         advertise_host: Optional[str] = None,
         stall_fence_s: float = 5.0,
+        on_drained=None,
     ):
         if session is None and gen_session is None:
             raise ValueError("need a ServingSession and/or a GenerationSession")
@@ -228,6 +229,11 @@ class ServingServer:
         self.router_endpoints = router_endpoints
         self.advertise_host = advertise_host
         self.stall_fence_s = float(stall_fence_s)
+        # autoscaler drain lever (ISSUE 17): fired by the replica agent when
+        # a router-ordered planned drain completes — the spawn/drain
+        # lifecycle hook (the serve CLI's --exit_on_drain shuts the process
+        # down here, releasing the chip the controller reclaimed)
+        self.on_drained = on_drained
         self._agent = None
         self._killed = False
         # push-streaming observability: frames written by pusher threads
@@ -584,6 +590,7 @@ class ServingServer:
                 self.router_endpoints, self.session,
                 advertise=(self.advertise_host or host, port),
                 stall_fence_s=self.stall_fence_s,
+                on_drained=self.on_drained,
             ).start()
         return self
 

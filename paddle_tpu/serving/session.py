@@ -164,19 +164,42 @@ class ServingSession:
         )
         self.k_pages, self.v_pages = self.cache.make_pools()
 
+        # warmup detection (ISSUE 17): each wrapped body runs ONLY while jax
+        # traces it — exactly once per new input signature per executable,
+        # i.e. precisely when a compile happens (prefill buckets included,
+        # which the per-signature RecompileStats below never see) — so the
+        # counter is a "this step compiled something" signal at zero
+        # steady-state cost, on any backend, with or without the persistent
+        # compile cache
+        self._jit_traces = 0
+
+        def _traced(fn):
+            def wrapped(*a, **kw):
+                self._jit_traces += 1
+                return fn(*a, **kw)
+            return wrapped
+
         # the executables; jit's shape cache turns the bucket list into
         # "a few padded lengths" -> a few compiles, decode into exactly one,
         # and the chunk program ([1, C] fixed shape) into exactly one more
-        self._decode = jax.jit(model.decode_step, donate_argnums=(1, 2))
-        self._prefill = jax.jit(model.prefill)
-        self._commit = jax.jit(model.commit_prefill, donate_argnums=(0, 1))
-        self._prefill_chunk = jax.jit(model.prefill_chunk, donate_argnums=(1, 2))
+        self._decode = jax.jit(_traced(model.decode_step),
+                               donate_argnums=(1, 2))
+        self._prefill = jax.jit(_traced(model.prefill))
+        self._commit = jax.jit(_traced(model.commit_prefill),
+                               donate_argnums=(0, 1))
+        self._prefill_chunk = jax.jit(_traced(model.prefill_chunk),
+                                      donate_argnums=(1, 2))
         # the verify executable only exists when speculation is on: K=0
         # compiles nothing and the engine step never calls _speculate's body
         self._verify = (
-            jax.jit(model.verify_chunk, donate_argnums=(1, 2))
+            jax.jit(_traced(model.verify_chunk), donate_argnums=(1, 2))
             if self.speculate_k else None
         )
+        # compile-heavy steps observe second-scale "service times" that
+        # poison the load estimator's EWMA (PR 10); the step loop resets it
+        # automatically at the FIRST step that ran clean after any compile,
+        # so benches and drills no longer reset by hand
+        self._load_est_dirty = False
 
         self.recompiles = stats.RecompileStats(warn_threshold=2)
         # the verify chunk's own one-signature gate ([1, K+1] fixed shape:
@@ -681,6 +704,7 @@ class ServingSession:
             # with occupancy; tests/test_lint_hotloop.py pins this site)
             now = time.monotonic()
         self._last_progress = now  # supervisor stall-watchdog heartbeat
+        traces_before = self._jit_traces
         self.scheduler.reap(now)
         self._admit(now)
         self._prefill_chunks()
@@ -689,6 +713,16 @@ class ServingSession:
         advanced = self._speculate()
         self._decode_once(advanced)
         self._notify_streams()
+        # auto EWMA reset (ISSUE 17): a step that compiled an executable
+        # retired requests with second-scale service times; the first CLEAN
+        # step afterwards forgets the poisoned estimate and lets
+        # steady-state retirements re-seed it — a later first-hit bucket
+        # compile re-arms the same healing
+        if self._jit_traces != traces_before:
+            self._load_est_dirty = True
+        elif self._load_est_dirty:
+            self._load_est_dirty = False
+            self.scheduler.reset_load_estimate()
         return (
             self.decode_steps != before
             or self.spec_rounds != spec_before

@@ -188,21 +188,56 @@ def run_closed_loop(
     }
 
 
+def expand_schedule(
+    n: int,
+    schedule: Sequence,  # [(duration_s, rate_rps), ...]
+) -> List:
+    """Flatten a time-varying load schedule into absolute arrival offsets.
+
+    Each `(duration_s, rate_rps)` phase contributes evenly spaced arrivals
+    for its duration (rate 0 = an idle phase: time passes, nothing arrives).
+    Returns `[(offset_s, phase_idx), ...]`, at most `n` entries — shared by
+    `run_open_loop` and the chaos bench's autoscale drill (which replays the
+    same offsets against a ROUTER instead of an engine), so "the burst" is
+    the identical arrival pattern in both."""
+    arrivals = []
+    t = 0.0
+    for p, (dur, rate) in enumerate(schedule):
+        dur = float(dur)
+        rate = float(rate)
+        if rate > 0:
+            interval = 1.0 / rate
+            k = 0
+            while k * interval < dur and len(arrivals) < n:
+                arrivals.append((t + k * interval, p))
+                k += 1
+        t += dur
+    return arrivals
+
+
 def run_open_loop(
     session,
     prompts: List[List[int]],
     max_new_tokens: int,
-    rate_rps: float,
+    rate_rps: Optional[float] = None,
     tenants: Sequence[str] = ("default",),
     deadline_s: Optional[float] = None,
     ttft_deadline_s: Optional[float] = None,
+    schedule: Optional[Sequence] = None,
 ) -> Dict:
     """Open-loop (offered-load) driver — the overload model: arrivals land
-    at `rate_rps` REGARDLESS of completions, so offered load above capacity
-    builds a queue instead of throttling itself (the closed loop can never
-    overload a server; this is what exercises shedding). The engine is
-    driven inline on this thread, one step per iteration, arrivals replayed
-    from a fixed schedule, so a run is reproducible modulo host timing.
+    on a fixed offered schedule REGARDLESS of completions, so offered load
+    above capacity builds a queue instead of throttling itself (the closed
+    loop can never overload a server; this is what exercises shedding). The
+    engine is driven inline on this thread, one step per iteration, arrivals
+    replayed from the precomputed schedule, so a run is reproducible modulo
+    host timing.
+
+    Offered load is either a constant `rate_rps`, or a time-varying
+    `schedule` of `(duration_s, rate_rps)` phases (ISSUE 17: the autoscale
+    gate's idle → burst → idle shape). With a schedule, the report gains a
+    `phases` list — per-phase offered/shed/goodput — because a burst phase's
+    collapse would otherwise be averaged away by its idle neighbours.
 
     Goodput = requests that completed WITHIN their deadline per second of
     wall clock — the number the chaos bench's 2× overload gate compares
@@ -210,34 +245,54 @@ def run_open_loop(
     from paddle_tpu.serving.quota import QuotaExceeded
 
     n = len(prompts)
-    interval = 1.0 / float(rate_rps)
+    if schedule is not None:
+        arrivals = expand_schedule(n, schedule)
+        phase_specs = [(float(d), float(r)) for d, r in schedule]
+    else:
+        if rate_rps is None:
+            raise ValueError("run_open_loop needs rate_rps or schedule")
+        interval = 1.0 / float(rate_rps)
+        arrivals = [(i * interval, 0) for i in range(n)]
+        phase_specs = None
     handles = []
+    handle_phase = []  # parallel to handles: arrival phase index
     shed = 0
+    shed_by_phase: Dict[int, int] = {}
+    offered_by_phase: Dict[int, int] = {}
     i = 0
     t0 = time.monotonic()
-    while i < n or session.scheduler.has_work():
+    while i < len(arrivals) or session.scheduler.has_work():
         now = time.monotonic()
-        while i < n and t0 + i * interval <= now:
+        while i < len(arrivals) and t0 + arrivals[i][0] <= now:
+            phase = arrivals[i][1]
+            offered_by_phase[phase] = offered_by_phase.get(phase, 0) + 1
             try:
                 handles.append(session.submit(
                     prompts[i], max_new_tokens,
                     tenant=tenants[i % len(tenants)],
                     deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
                 ))
+                handle_phase.append(phase)
             except QuotaExceeded:
                 shed += 1
+                shed_by_phase[phase] = shed_by_phase.get(phase, 0) + 1
             i += 1
         if session.scheduler.has_work():
             session.step(now)
-        elif i < n:
-            time.sleep(max(0.0, min(0.002, t0 + i * interval - now)))
+        elif i < len(arrivals):
+            time.sleep(max(0.0, min(0.002, t0 + arrivals[i][0] - now)))
     dt = time.monotonic() - t0
 
     completed_ok = sum(1 for h in handles if h.status == h.DONE)
     missed = sum(1 for h in handles if h.finish_reason == "deadline")
-    return {
-        "offered_rps": round(rate_rps, 2),
-        "requests_offered": n,
+    offered_rps = (
+        rate_rps if schedule is None
+        else n / sum(d for d, _ in phase_specs)
+        if phase_specs and sum(d for d, _ in phase_specs) > 0 else 0.0
+    )
+    report = {
+        "offered_rps": round(float(offered_rps), 2),
+        "requests_offered": len(arrivals),
         "accepted": len(handles),
         "shed": shed,
         "completed_ok": completed_ok,
@@ -247,3 +302,21 @@ def run_open_loop(
         "goodput_rps": round(completed_ok / dt, 2) if dt > 0 else 0.0,
         "wall_s": round(dt, 4),
     }
+    if phase_specs is not None:
+        phases = []
+        for p, (dur, rate) in enumerate(phase_specs):
+            ok = sum(
+                1 for h, hp in zip(handles, handle_phase)
+                if hp == p and h.status == h.DONE
+            )
+            phases.append({
+                "phase": p,
+                "duration_s": dur,
+                "rate_rps": rate,
+                "offered": offered_by_phase.get(p, 0),
+                "shed": shed_by_phase.get(p, 0),
+                "completed_ok": ok,
+                "goodput_rps": round(ok / dur, 2) if dur > 0 else 0.0,
+            })
+        report["phases"] = phases
+    return report

@@ -562,6 +562,98 @@ def test_engine_stream_seam_is_socket_free():
     )
 
 
+# -- autoscaler controller loop (ISSUE 17) ------------------------------------
+#
+# The controller's contract is "zero new RPCs on anyone's hot path": its
+# entire network footprint is one cold-path `stats` poll per endpoint per
+# tick (_observe) plus one lever call per ADMITTED decision (_actuate's
+# drain order / resize announce — cooldown-rate-limited, so never per-tick).
+# The decision engine itself (ScaleDecider.decide/_admit) is PURE: no RPCs,
+# no clock reads — every cooldown/flap/backoff comparison uses the single
+# `now` stamp the tick takes once. These pins keep a "quick health probe"
+# or a second clock from sneaking into the reconcile loop.
+
+AUTOSCALER_PY = os.path.join(_REPO, "paddle_tpu", "runtime", "autoscaler.py")
+# (file, class, methods, max rpc-ok tags)
+AUTOSCALER_RPC_LOOPS = [
+    (AUTOSCALER_PY, "AutoscalerController",
+     ("_observe", "_actuate", "_watch_resize", "tick", "_drain_victim"), 4),
+]
+# (file, class, methods, max clock-ok tags)
+AUTOSCALER_CLOCK_LOOPS = [
+    (AUTOSCALER_PY, "AutoscalerController",
+     ("_observe", "_actuate", "_watch_resize", "tick", "_drain_victim"), 1),
+]
+# the pure decision engine: no tags allowed at all — a single RPC or clock
+# read in decide()/_admit() breaks both determinism and the test story
+DECIDER_PURE = [
+    (AUTOSCALER_PY, "ScaleDecider",
+     ("decide", "_admit", "_suppress", "note_resize_rejected",
+      "note_resize_ok")),
+]
+
+
+def test_no_untagged_rpc_in_controller_loop():
+    """Blocking RPCs in the controller's reconcile loop must be tagged: the
+    sanctioned four are the two once-per-tick stats polls (_observe) and the
+    two per-admitted-decision lever calls (_actuate)."""
+    violations = []
+    for path, cls, methods, _budget in AUTOSCALER_RPC_LOOPS:
+        v, _ = _scan(path, cls, methods, RPC_CALL, tag=RPC_TAG)
+        violations += v
+    assert not violations, (
+        "blocking RPC in the autoscaler reconcile loop without an `rpc-ok` "
+        "tag — observation rides the existing stats endpoints once per tick "
+        "and actuation is one lever call per admitted decision; anything "
+        "else is a new RPC on the control loop:\n  " + "\n  ".join(violations)
+    )
+
+
+def test_sanctioned_controller_rpc_sites_stay_rare():
+    for path, cls, methods, budget in AUTOSCALER_RPC_LOOPS:
+        _, tagged = _scan(path, cls, methods, RPC_CALL, tag=RPC_TAG)
+        assert len(tagged) <= budget, (
+            f"{len(tagged)} rpc-ok tags in the {cls} reconcile loop "
+            f"(expected <= {budget}): a new sanctioned RPC site was added — "
+            "confirm it is once-per-tick (observe) or per-admitted-decision "
+            "(actuate) and bump this bound deliberately"
+        )
+
+
+def test_controller_tick_reads_the_clock_exactly_once():
+    """One wall-clock read per tick, tagged: every cooldown / flap-window /
+    backoff comparison inside the decision engine uses that single stamp, so
+    rate-limit decisions cannot disagree within a tick."""
+    for path, cls, methods, budget in AUTOSCALER_CLOCK_LOOPS:
+        violations, tagged = _scan(path, cls, methods, CLOCK_CALL,
+                                   tag=CLOCK_TAG)
+        assert not violations, (
+            "untagged wall-clock read in the controller loop — thread "
+            "tick()'s single stamp through instead:\n  "
+            + "\n  ".join(violations)
+        )
+        assert len(tagged) <= budget, (
+            f"{len(tagged)} clock-ok tags in the {cls} loop (expected <= "
+            f"{budget}): the controller should take ONE stamp per tick"
+        )
+
+
+def test_scale_decider_is_pure():
+    """The decision engine makes no RPCs and reads no clocks, tagged or
+    otherwise — `now` is an argument. That purity is what lets
+    tests/test_autoscaler.py pin hysteresis/cooldown/flap/backoff behavior
+    with a fake clock and zero sockets."""
+    for path, cls, methods in DECIDER_PURE:
+        for pattern, what in ((RPC_CALL, "RPC"), (CLOCK_CALL, "clock read")):
+            v, _ = _scan(path, cls, methods, pattern, tag=None)
+            assert not v, (
+                f"{what} inside the pure decision engine ({cls}) — decide() "
+                "takes signals and a caller-supplied `now`; move the side "
+                "effect to the controller's observe/actuate phases:\n  "
+                + "\n  ".join(v)
+            )
+
+
 def test_frame_encoding_only_in_handler_push_loop():
     """encode_frame() has exactly one call site: _Handler._push_frames. Any
     second caller is a second framing implementation waiting to drift from

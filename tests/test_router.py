@@ -81,9 +81,37 @@ def reference(model_and_params):
     return {"greedy": greedy.tokens, "sampled": sampled.tokens}
 
 
-def make_fleet(model_and_params, n, lease_s=1.0, stall_fence_s=5.0,
+def warm_session(sess):
+    """Compile a session's executables BEFORE its replica holds a lease.
+
+    First-generate jit compile takes seconds; on a 1-core host two engines
+    tracing concurrently time-slice the agent heartbeat threads, so a short
+    lease can lapse mid-compile and the eviction reads as a spurious
+    failover. Sampling params are data lanes (one decode executable covers
+    greedy AND sampled), so one tiny greedy generate covers every path the
+    tests drive.  The warm request's own service time spans the compiles,
+    so the load-estimate EWMAs are explicitly forgotten afterwards: warming
+    is sequential and later sessions hit the compile cache the first one
+    filled, which would otherwise leave ASYMMETRIC queue-wait estimates and
+    flip the least-loaded tie-break the dispatch tests pin.  A session
+    configured to shed everything (max_queue=0) never compiles either —
+    nothing to warm."""
+    from paddle_tpu.serving.quota import QuotaExceeded
+
+    try:
+        sess.submit(PROMPT, 4)
+    except QuotaExceeded:
+        return sess
+    sess.run_until_idle()
+    sess.scheduler.reset_load_estimate()
+    return sess
+
+
+def make_fleet(model_and_params, n, lease_s=3.0, stall_fence_s=5.0,
                session_kw=None, **router_kw):
-    """A RouterServer + n real TCP replica servers joined to it."""
+    """A RouterServer + n real TCP replica servers joined to it; sessions
+    are pre-warmed (see warm_session) so no lease window spans a compile.
+    Tests that pin EVICTION timing pass their own short lease explicitly."""
     from paddle_tpu.serving.router import RouterServer
     from paddle_tpu.serving.server import ServingServer
 
@@ -91,7 +119,7 @@ def make_fleet(model_and_params, n, lease_s=1.0, stall_fence_s=5.0,
     router = RouterServer(lease_s=lease_s, **router_kw).start()
     servers = []
     for _ in range(n):
-        sess = make_session(model_and_params, **(session_kw or {}))
+        sess = warm_session(make_session(model_and_params, **(session_kw or {})))
         srv = ServingServer(
             session=sess, router_endpoints=router.address,
             stall_fence_s=stall_fence_s,
@@ -249,11 +277,15 @@ def test_late_winner_from_partitioned_replica_deduplicated(model_and_params,
     router = RouterServer(
         lease_s=0.8, poll_interval_s=0.02, late_grace_s=30.0
     ).start()
-    sess_a = make_session(model_and_params, engine_stall_timeout_s=120.0)
+    # warm BOTH sessions before any replica holds a lease: B's compile must
+    # not time-slice A's heartbeats inside the deliberately short lease
+    sess_a = warm_session(
+        make_session(model_and_params, engine_stall_timeout_s=120.0)
+    )
+    sess_b = warm_session(make_session(model_and_params))
     srv_a = ServingServer(
         session=sess_a, router_endpoints=router.address, stall_fence_s=0.2
     ).start()
-    sess_b = make_session(model_and_params)
     srv_b = None
     try:
         assert _wait(lambda: len(router.fleet.live()) == 1)
@@ -356,11 +388,15 @@ def test_hedge_first_token_wins_loser_cancelled(model_and_params, reference):
         assert toks == reference["greedy"]
         assert h.hedged and router.router.hedges == 1
         assert h.delivered_by != first
-        # the loser was cancelled server-side on the wedged replica
-        lock.release()
+        # the loser is cancelled server-side WHILE still wedged (the cancel
+        # order rides the pump; the parked engine is not needed) — waiting
+        # for it BEFORE healing the wedge keeps this deterministic: a warmed
+        # engine released first could race the cancel and finish, turning
+        # the loser into a late result instead of a cancellation
         assert _wait(
             lambda: servers[0][1].scheduler.cancelled >= 1, timeout_s=15.0
         ), "hedge loser must be cancelled on its replica"
+        lock.release()
         assert router.router.late_results_dropped == 0
     finally:
         stop_fleet(router, servers)
