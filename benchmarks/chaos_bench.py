@@ -1567,6 +1567,416 @@ def run_autoscale(args) -> dict:
     }
 
 
+def run_ha(args) -> dict:
+    """Control-plane HA drill (ISSUE 18), two legs:
+
+    Router leg — 2 replicas carry [primary, standby] endpoint lists; mixed
+    greedy + seeded-sampled requests AND one live push-stream are wedged
+    in flight (the deterministic between-steps wedge) when the primary
+    router is KILLED. The armed RouterStandby must confirm the death, bind,
+    sweep the re-registering replicas' `outstanding` books, and finish
+    everything. Gates: zero client errors; every request's tokens BITWISE
+    identical to an unfaulted run over the same prompts/seeds (exactly-once
+    falls out: equal length + equal content admits no duplicate delivery);
+    >= 1 cursor reattach on the stream; exactly one router takeover in
+    FT_EVENTS; the sweep adopted >= 1 request; zero KV pages leaked on
+    either (surviving) replica in both runs.
+
+    Autoscaler leg — a REAL master + cluster_reader consumers on the
+    training plane; the serving side is a scripted stats source holding
+    queue wait above the scale-up band plus a counting spawner (the real-
+    fleet version of this pressure loop is `--mode autoscale`; this leg
+    isolates the HA mechanics). The primary controller borrows a chip from
+    training (resize epoch), is KILLED mid-epoch (seeded controller_kill),
+    and the AutoscalerStandby watching its liveness port must take over
+    with a fresh controller that reconciles from observed state and
+    completes the scale-up. Gates: the kill landed mid-epoch; exactly one
+    autoscaler takeover; the standby's controller acted (second spawn);
+    every training record consumed exactly once across the interrupted
+    epoch; the epoch settled (resize plane idle)."""
+    import socket as _socket
+    import threading
+    import time as _time
+
+    import jax
+
+    from paddle_tpu.core import stats as core_stats
+    from paddle_tpu.serving.router import RouterServer, RouterStandby
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+    from paddle_tpu.serving.workload import make_prompts
+
+    backend = jax.default_backend()
+    n_rep = 2
+    n_req = args.ha_requests
+    max_new = args.serving_max_new
+    prompts = make_prompts(
+        n_req + 1, lengths=(5, 8, 11), vocab=128, bos_id=1, seed=args.seed,
+    )
+    sampling = [
+        (dict(temperature=0.8, top_k=20, seed=1000 + i) if i % 2 else {})
+        for i in range(n_req)
+    ]
+
+    def router_leg(faulted: bool) -> dict:
+        primary = RouterServer(lease_s=1.5, poll_interval_s=0.01).start()
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        sb_port = s.getsockname()[1]
+        s.close()
+        endpoints = [list(primary.address), ["127.0.0.1", sb_port]]
+        box = {}
+        stop_evt = threading.Event()
+        if faulted:
+            standby = RouterStandby(
+                primary.address, port=sb_port, poll_s=0.1,
+                stop_evt=stop_evt, lease_s=1.5, poll_interval_s=0.01,
+            )
+            threading.Thread(
+                target=lambda: box.update(srv=standby.run()), daemon=True,
+            ).start()
+        servers = []
+        for _ in range(n_rep):
+            sess = _serving_session(args)
+            srv = ServingServer(
+                session=sess, router_endpoints=endpoints,
+                stall_fence_s=30.0,
+            ).start()
+            servers.append((srv, sess))
+        deadline = _time.time() + 30
+        while _time.time() < deadline and len(primary.fleet.live()) < n_rep:
+            _time.sleep(0.02)
+        # wedge BOTH replicas between decode steps: every request below is
+        # provably in flight when the router dies
+        gates = [sess._gen_lock for _, sess in servers]
+        for g in gates:
+            g.acquire()
+        released = False
+        results, errs, stream_out = {}, [], {"tokens": [], "reattaches": 0}
+
+        def gen(i):
+            c = ServingClient(endpoints, timeout=3.0)
+            try:
+                out = c.generate(
+                    prompts[i], max_new, timeout_s=150.0, **sampling[i],
+                )
+                results[i] = list(out["tokens"])
+            except Exception as e:
+                errs.append((i, repr(e)))
+            finally:
+                c.close()
+
+        def consume_stream():
+            c = ServingClient(endpoints, timeout=3.0)
+            try:
+                for fr in c.stream(prompts[n_req], max_new,
+                                   reattach_retries=30):
+                    stream_out["tokens"].extend(fr["tokens"])
+                    if fr.get("done"):
+                        break
+                stream_out["reattaches"] = c.stream_reattaches
+            except Exception as e:
+                errs.append(("stream", repr(e)))
+            finally:
+                c.close()
+
+        threads = [
+            threading.Thread(target=gen, args=(i,), daemon=True)
+            for i in range(n_req)
+        ] + [threading.Thread(target=consume_stream, daemon=True)]
+        tk_before = core_stats.FT_EVENTS.get("router_takeover")
+        t0 = _time.time()
+        try:
+            for t in threads:
+                t.start()
+            deadline = _time.time() + 60
+            while _time.time() < deadline and sum(
+                len(srv.dispatch("outstanding", {}, None)["requests"])
+                for srv, _ in servers
+            ) < n_req + 1:
+                _time.sleep(0.05)
+            adopted = 0
+            if faulted:
+                primary.kill()
+                deadline = _time.time() + 30
+                while _time.time() < deadline and box.get("srv") is None:
+                    _time.sleep(0.05)
+                new = box["srv"]
+                deadline = _time.time() + 60
+                while _time.time() < deadline and (
+                    new is None or len(new.fleet.live()) < n_rep
+                    or new.router.adopted < 1
+                ):
+                    _time.sleep(0.05)
+                adopted = new.router.adopted if new is not None else 0
+            for g in gates:
+                g.release()
+            released = True
+            for t in threads:
+                t.join(timeout=150.0)
+            wall = _time.time() - t0
+            drain_deadline = _time.time() + 60
+            while _time.time() < drain_deadline and any(
+                s.scheduler.has_work() for _, s in servers
+            ):
+                _time.sleep(0.05)
+            leaks = {
+                i: sess.cache.pages_in_use
+                for i, (_, sess) in enumerate(servers)
+            }
+            return {
+                "completed": len(results),
+                "errors": errs,
+                "stream_tokens": len(stream_out["tokens"]),
+                "stream_reattaches": stream_out["reattaches"],
+                "takeovers": (
+                    core_stats.FT_EVENTS.get("router_takeover") - tk_before
+                ),
+                "adopted_by_standby": adopted,
+                "leaked_pages_by_replica": leaks,
+                "zero_page_leak": all(v == 0 for v in leaks.values()),
+                "wall_s": round(wall, 3),
+                "_tokens": dict(results),
+                "_stream": list(stream_out["tokens"]),
+            }
+        finally:
+            if not released:
+                for g in gates:
+                    g.release()
+            stop_evt.set()
+            for srv, _ in servers:
+                srv.stop()
+            primary.stop()
+            if box.get("srv") is not None:
+                box["srv"].stop()
+
+    def autoscaler_leg() -> dict:
+        import shutil
+        import tempfile
+
+        from paddle_tpu.core import faults
+        from paddle_tpu.runtime import recordio
+        from paddle_tpu.runtime.autoscaler import (
+            AutoscalerController, AutoscalerStandby, ScaleConfig,
+        )
+        from paddle_tpu.runtime.master import (
+            MasterClient, MasterServer, TaskMaster, cluster_reader,
+        )
+
+        tmp = tempfile.mkdtemp(prefix="chaos_ha_autoscale_")
+        nrec = args.autoscale_tasks * args.records_per_task
+        msrv = boot = None
+
+        class _Spawner:
+            def __init__(self):
+                self.spawned = 0
+
+            def spawn(self):
+                self.spawned += 1
+
+            def reap(self):
+                return self.spawned
+
+            def stop_all(self):
+                pass
+
+        spawner = _Spawner()
+        spawner.spawn()  # the min fleet
+
+        class _ScriptedRouter:
+            """Queue wait pinned above the scale-up band; live replicas
+            mirror the spawner's count — observation only, no fleet."""
+
+            def call(self, method, **kw):
+                if method == "stats":
+                    return {
+                        "replicas": [
+                            {"replica_id": f"fake-{i}", "state": "live",
+                             "outstanding": 0, "load": {}}
+                            for i in range(spawner.spawned)
+                        ],
+                        "estimated_queue_wait_s": 50.0,
+                        "shed": 0,
+                    }
+                return {"ok": True}
+
+            def close(self):
+                pass
+
+        try:
+            shards = recordio.convert(
+                os.path.join(tmp, "ds"),
+                lambda: ({"sid": i} for i in range(nrec)),
+                records_per_file=args.records_per_task,
+            )
+            msrv = MasterServer(
+                TaskMaster(timeout_s=30.0, failure_max=10), lease_s=1.5,
+                resize_drain_timeout_s=6.0, initial_world=2,
+            ).start()
+            boot = MasterClient(msrv.address)
+            boot.call("set_dataset", shards=shards, chunks_per_task=1)
+            consumed = [[] for _ in range(args.consumers)]
+            # keep the training pass alive long enough for the kill +
+            # takeover + reconcile to land mid-pass
+            work_s = max(0.15, 12.0 * args.consumers / nrec)
+
+            def consume(i):
+                rd = cluster_reader(
+                    msrv.address, client_kw={"retries": 40, "timeout": 5},
+                    poll_interval=0.05,
+                )
+                for rec in rd():
+                    consumed[i].append(rec["sid"])
+                    _time.sleep(work_s)
+
+            consumers = [
+                threading.Thread(target=consume, args=(i,), daemon=True)
+                for i in range(args.consumers)
+            ]
+            for t in consumers:
+                t.start()
+            # chips_total = 1 serving + 2 training: full, so scale-up must
+            # borrow a chip back from training via a resize epoch
+            cfg = ScaleConfig(
+                chips_total=3, chips_per_replica=1,
+                min_replicas=1, max_replicas=2,
+                train_min_world=1, train_max_world=2,
+                high_wait_s=5.0, low_wait_s=1.0,
+                high_ticks=2, low_ticks=50,
+                serving_cooldown_s=0.3, train_cooldown_s=0.3,
+                flap_window_s=0.5, startup_quiet_s=0.1,
+                backoff_base_s=0.5, backoff_max_s=4.0,
+                resize_timeout_s=30.0, drain_deadline_s=8.0,
+            )
+
+            def build_ctl():
+                return AutoscalerController(
+                    config=cfg, spawner=spawner, tick_s=0.05,
+                    router_client=_ScriptedRouter(),
+                    master_client=MasterClient(msrv.address),
+                )
+
+            tk_before = core_stats.FT_EVENTS.get("autoscaler_takeover")
+            ctl = AutoscalerController(
+                config=cfg, spawner=spawner, tick_s=0.05,
+                router_client=_ScriptedRouter(),
+                master_client=MasterClient(msrv.address),
+                liveness_port=0,
+            ).start()
+            box = {}
+            standby = AutoscalerStandby(
+                ctl.liveness_address, build_ctl, poll_s=0.1,
+            )
+            threading.Thread(
+                target=lambda: box.update(ctl=standby.run()), daemon=True,
+            ).start()
+            leg = {}
+            # wait for the primary's resize epoch, then kill it MID-epoch
+            deadline = _time.time() + 30
+            while (_time.time() < deadline
+                   and msrv.resize.info()["state"] == "idle"):
+                _time.sleep(0.02)
+            leg["epoch_state_at_kill"] = msrv.resize.info()["state"]
+            faults.ACTIVE.configure("controller_kill:step=0", args.seed)
+            deadline = _time.time() + 15
+            while not ctl.dead and _time.time() < deadline:
+                _time.sleep(0.02)
+            faults.ACTIVE.configure("")
+            leg["primary_killed"] = bool(ctl.dead)
+            # the standby confirms the dropped liveness port, takes over,
+            # and its controller reconciles + completes the scale-up
+            deadline = _time.time() + 60
+            while _time.time() < deadline and (
+                box.get("ctl") is None or spawner.spawned < 2
+            ):
+                _time.sleep(0.05)
+            leg["standby_took_over"] = box.get("ctl") is not None
+            leg["takeovers"] = (
+                core_stats.FT_EVENTS.get("autoscaler_takeover") - tk_before
+            )
+            leg["spawned"] = spawner.spawned
+            for t in consumers:
+                t.join(timeout=120.0)
+            leg["consumers_done"] = not any(t.is_alive() for t in consumers)
+            flat = sorted(x for lst in consumed for x in lst)
+            leg["tasks_exactly_once"] = flat == list(range(nrec))
+            leg["final_world"] = msrv.resize.info()["world"]
+            leg["epoch_settled"] = msrv.resize.info()["state"] == "idle"
+            if box.get("ctl") is not None:
+                box["ctl"].stop()
+            ctl.stop()
+            return leg
+        finally:
+            if boot is not None:
+                boot.close()
+            if msrv is not None:
+                msrv.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    clean = router_leg(faulted=False)
+    faulted = router_leg(faulted=True)
+    clean_toks = clean.pop("_tokens")
+    fault_toks = faulted.pop("_tokens")
+    clean_stream = clean.pop("_stream")
+    fault_stream = faulted.pop("_stream")
+    mismatches = [
+        i for i in range(n_req) if fault_toks.get(i) != clean_toks.get(i)
+    ]
+    greedy_checked = sum(1 for i in fault_toks if i % 2 == 0)
+    sampled_checked = sum(1 for i in fault_toks if i % 2 == 1)
+    auto = autoscaler_leg()
+    fidelity = (
+        (n_req - len(mismatches)) / n_req if n_req else 0.0
+    )
+    ok = (
+        not clean["errors"] and not faulted["errors"]
+        and not mismatches
+        and greedy_checked >= 1 and sampled_checked >= 1
+        and fault_stream == clean_stream and len(fault_stream) > 0
+        and faulted["stream_reattaches"] >= 1
+        and faulted["takeovers"] == 1
+        and faulted["adopted_by_standby"] >= 1
+        and clean["zero_page_leak"] and faulted["zero_page_leak"]
+        and auto["primary_killed"]
+        and auto["epoch_state_at_kill"] != "idle"
+        and auto["takeovers"] == 1
+        and auto["spawned"] >= 2
+        and auto["tasks_exactly_once"]
+        and auto["epoch_settled"]
+    )
+    return {
+        "metric": "ha_token_fidelity",
+        "value": round(fidelity, 3),
+        "unit": "fraction of requests bitwise-identical across a router "
+                "takeover vs the unfaulted run",
+        "platform": backend,
+        "all_gates_pass": bool(ok),
+        "gates": {
+            "zero_client_errors": not clean["errors"]
+            and not faulted["errors"],
+            "token_bitwise_vs_unfaulted": not mismatches,
+            "greedy_streams_checked": greedy_checked,
+            "sampled_streams_checked": sampled_checked,
+            "stream_exactly_once": fault_stream == clean_stream
+            and len(fault_stream) > 0,
+            "stream_cursor_reattached": faulted["stream_reattaches"] >= 1,
+            "router_takeover_once": faulted["takeovers"] == 1,
+            "sweep_adopted": faulted["adopted_by_standby"] >= 1,
+            "zero_page_leak": clean["zero_page_leak"]
+            and faulted["zero_page_leak"],
+            "autoscaler_killed_mid_epoch": auto["primary_killed"]
+            and auto["epoch_state_at_kill"] != "idle",
+            "autoscaler_takeover_once": auto["takeovers"] == 1,
+            "standby_completed_scale_up": auto["spawned"] >= 2,
+            "train_tasks_exactly_once": auto["tasks_exactly_once"],
+            "resize_epoch_settled": auto["epoch_settled"],
+        },
+        "router_clean": {**clean, "stream_tokens_list": clean_stream},
+        "router_faulted": {**faulted, "stream_tokens_list": fault_stream},
+        "autoscaler": auto,
+        "seed": args.seed,
+    }
+
+
 def run_serving(args) -> dict:
     """Serving resilience drill (see module docstring)."""
     import jax
@@ -1626,7 +2036,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="local",
                     choices=["local", "cluster", "resize", "serving",
-                             "router", "autoscale"],
+                             "router", "autoscale", "ha"],
                     help="local: in-process throughput-under-faults; "
                          "cluster: multi-process master-failover drill; "
                          "resize: live elastic grow/shrink mid-pass drill; "
@@ -1635,7 +2045,11 @@ def main():
                          "(exactly-once, page-leak, goodput + bitwise "
                          "gates); autoscale: goodput-driven controller "
                          "vs idle/burst/idle load, killed+restarted "
-                         "mid-resize-epoch")
+                         "mid-resize-epoch; ha: control-plane takeover "
+                         "drill — router killed mid-decode under a "
+                         "standby (bitwise + stream-reattach gates) and "
+                         "autoscaler killed mid-resize-epoch under a "
+                         "standby (exactly-once gate)")
     ap.add_argument("--faults", default=DEFAULT_FAULTS,
                     help="input-side fault mix for the chaos mode")
     ap.add_argument("--seed", type=int, default=0)
@@ -1760,7 +2174,16 @@ def main():
                     help="autoscale mode: per-record consumer work (keeps "
                          "the training pass alive across the whole load "
                          "schedule so resizes land mid-pass)")
+    ap.add_argument("--ha_requests", type=int, default=6,
+                    help="ha mode: wedged in-flight requests per router leg "
+                         "(half greedy, half seeded-sampled; plus one "
+                         "push-stream)")
     args = ap.parse_args()
+
+    if args.mode == "ha":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(run_ha(args)))
+        return
 
     if args.mode == "autoscale":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

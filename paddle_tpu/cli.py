@@ -934,10 +934,13 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--router_endpoints", default=None,
         help="join a serving-router fleet (ISSUE 15) as a replica: register "
-             "this server's endpoint with the router at host:port (failover "
-             "list allowed) and renew the lease with load-snapshot "
-             "heartbeats; a wedged engine self-fences so the router fails "
-             "in-flight work over to a survivor",
+             "this server's endpoint with the router at host:port and renew "
+             "the lease with load-snapshot heartbeats; a wedged engine "
+             "self-fences so the router fails in-flight work over to a "
+             "survivor. Pass a comma-separated primary,standby list "
+             "(ISSUE 18): after consecutive heartbeat connection failures "
+             "the agent rotates to the standby router and re-registers, "
+             "whose takeover sweep re-adopts this replica's in-flight work",
     )
     p.add_argument(
         "--advertise_host", default=None,

@@ -298,6 +298,17 @@ def observe_pages_recycled(n: int) -> None:
 # -- router tier (ISSUE 15 multi-replica serving) -----------------------------
 
 
+def observe_takeover(plane: str) -> None:
+    """A warm standby took over a dead control plane (runtime/election.py);
+    plane is 'master', 'router' or 'autoscaler'. Paired with the
+    `<plane>_takeover` FT_EVENTS key — this is the labeled cross-plane
+    counter the HA chaos drill gates on."""
+    REGISTRY.counter(
+        "paddle_tpu_takeovers_total",
+        "control-plane standby takeovers, by plane",
+    ).inc(plane=plane)
+
+
 def observe_replica_evicted(cause: str) -> None:
     """The router evicted a replica lease; cause is 'lease' (heartbeats
     stopped — death or a self-fenced wedge), 'conn' (dispatch/pump

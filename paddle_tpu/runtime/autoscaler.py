@@ -69,6 +69,7 @@ from paddle_tpu.core import faults
 from paddle_tpu.core import stats as core_stats
 from paddle_tpu.obs import metrics as obs_metrics
 from paddle_tpu.obs import trace
+from paddle_tpu.runtime.election import mint_instance_token, watch_primary
 from paddle_tpu.runtime.master import EndpointsLike, MasterClient
 
 import logging
@@ -404,6 +405,8 @@ class AutoscalerController:
         client_kw: Optional[dict] = None,
         router_client: Optional[Any] = None,
         master_client: Optional[Any] = None,
+        liveness_port: Optional[int] = None,
+        liveness_host: str = "127.0.0.1",
     ):
         kw = client_kw or {"timeout": 5.0, "retries": 2}
         self.cfg = config or ScaleConfig()
@@ -434,6 +437,58 @@ class AutoscalerController:
         self.dead = False
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # incarnation identity (ISSUE 18): a standby that takes this
+        # controller's place overwrites it with its election token
+        self.instance = mint_instance_token()
+        # liveness port (ISSUE 18): the controller has no RPC surface of
+        # its own, so an AutoscalerStandby needs SOMETHING to probe. This
+        # bare accept-and-close listener is held open exactly as long as
+        # the reconcile loop is healthy — closed when the loop exits for
+        # ANY reason, including the controller_kill chaos site — so a TCP
+        # probe against it answers "is the primary controller alive".
+        self.liveness_address: Optional[Tuple[str, int]] = None
+        self._liveness_sock = None
+        if liveness_port is not None:
+            import socket
+
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((liveness_host, int(liveness_port)))
+            s.listen(8)
+            self._liveness_sock = s
+            self.liveness_address = s.getsockname()
+            threading.Thread(
+                target=self._liveness_accept, name="autoscaler-liveness",
+                daemon=True,
+            ).start()
+
+    def _liveness_accept(self) -> None:
+        """Accept-and-close loop for the liveness port; exits when the
+        socket is closed (loop death or stop())."""
+        sock = self._liveness_sock  # _close_liveness nulls the attr
+        while True:
+            try:
+                conn, _ = sock.accept()
+                conn.close()
+            except OSError:
+                return
+
+    def _close_liveness(self) -> None:
+        import socket
+
+        s, self._liveness_sock = self._liveness_sock, None
+        if s is not None:
+            try:
+                # shutdown() first: close() alone does not wake a thread
+                # blocked in accept() — the in-flight syscall pins the
+                # socket open and the port would accept one more probe
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     # -- observation (cold path: one stats poll per endpoint per tick) ------
     def _observe(self, now: float) -> Optional[Signals]:
@@ -605,22 +660,28 @@ class AutoscalerController:
         return actions
 
     def _loop(self) -> None:
-        while not self._stop_evt.wait(self.tick_s):
-            try:
-                self.tick()
-            except faults.InjectedFault:
-                # the controller_kill drill: this controller is dead; the
-                # fleet it was steering keeps running statically
-                self.dead = True
-                core_stats.FT_EVENTS.incr("autoscaler_controller_killed")
-                log.warning("autoscaler controller killed (chaos site); "
-                            "fleet degrades to static")
-                return
-            except Exception:
-                # an unexpected tick failure must not take the loop down —
-                # the next tick re-observes from scratch (stateless)
-                self.observe_failures += 1
-                log.exception("autoscaler tick failed; continuing")
+        try:
+            while not self._stop_evt.wait(self.tick_s):
+                try:
+                    self.tick()
+                except faults.InjectedFault:
+                    # the controller_kill drill: this controller is dead;
+                    # the fleet it was steering keeps running statically
+                    self.dead = True
+                    core_stats.FT_EVENTS.incr("autoscaler_controller_killed")
+                    log.warning("autoscaler controller killed (chaos "
+                                "site); fleet degrades to static")
+                    return
+                except Exception:
+                    # an unexpected tick failure must not take the loop
+                    # down — the next tick re-observes from scratch
+                    self.observe_failures += 1
+                    log.exception("autoscaler tick failed; continuing")
+        finally:
+            # liveness port tracks the LOOP, not the process: any exit —
+            # stop(), controller_kill, an escape we didn't foresee — drops
+            # it so a watching standby (ISSUE 18) sees the death
+            self._close_liveness()
 
     def start(self) -> "AutoscalerController":
         if self._thread is None:
@@ -639,6 +700,7 @@ class AutoscalerController:
         self._stop_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+        self._close_liveness()
         for c in (self._router, self._master):
             if c is not None:
                 try:
@@ -656,7 +718,51 @@ class AutoscalerController:
             "actions": list(self.actions),
             "alive": self.alive,
             "dead": self.dead,
+            "instance": self.instance,
         }
+
+
+class AutoscalerStandby:
+    """Warm standby for the autoscaler (ISSUE 18), on the shared election
+    primitive — and the degenerate, zero-extra-state consumer of it: the
+    controller is ALREADY stateless-reconciling (desired state re-derived
+    every tick from observed router/master stats; an in-flight resize epoch
+    adopted from `stats()["resize"]`), so takeover is just "watch the
+    primary's liveness port, then build a fresh controller". No sweep, no
+    books, nothing to rebuild.
+
+    `factory` is a zero-arg callable returning an UNSTARTED
+    AutoscalerController — the standby cannot hold live clients/spawners
+    for a controller that may never exist."""
+
+    def __init__(self, primary: EndpointsLike,
+                 factory: Callable[[], "AutoscalerController"],
+                 poll_s: float = 0.2, confirm_failures: int = 2,
+                 max_wait_s: Optional[float] = None,
+                 stop_evt: Optional[threading.Event] = None):
+        self.primary = primary
+        self.factory = factory
+        self.poll_s = float(poll_s)
+        self.confirm_failures = int(confirm_failures)
+        self.max_wait_s = max_wait_s
+        self.stop_evt = stop_evt
+
+    def run(self) -> Optional["AutoscalerController"]:
+        """Block watching the primary's liveness port; on confirmed death
+        return a STARTED controller whose `instance` is the election token.
+        None when stopped or timed out with the primary still alive."""
+        token = watch_primary(
+            self.primary, plane="autoscaler", poll_s=self.poll_s,
+            confirm_failures=self.confirm_failures,
+            max_wait_s=self.max_wait_s, stop_evt=self.stop_evt,
+        )
+        if token is None:
+            return None
+        ctl = self.factory()
+        ctl.instance = token
+        log.warning("autoscaler standby (incarnation %s) taking over",
+                    token)
+        return ctl.start()
 
 
 def _parse_endpoint(s: str) -> Tuple[str, int]:
@@ -677,28 +783,46 @@ def _main(argv: Optional[List[str]] = None) -> int:
         description="goodput-driven autoscaler controller",
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
-    sv = sub.add_parser("serve", help="run the reconcile loop")
-    sv.add_argument("--router", default=None,
-                    help="router host:port (serving spawn/drain lever)")
-    sv.add_argument("--master", default=None,
-                    help="master host:port (training resize lever)")
-    sv.add_argument("--tick_s", type=float, default=1.0)
-    sv.add_argument("--chips", type=int, default=8,
-                    help="total chip budget arbitrated across both fleets")
-    sv.add_argument("--chips_per_replica", type=int, default=1)
-    sv.add_argument("--min_replicas", type=int, default=1)
-    sv.add_argument("--max_replicas", type=int, default=8)
-    sv.add_argument("--train_min_world", type=int, default=0)
-    sv.add_argument("--train_max_world", type=int, default=8)
-    sv.add_argument("--high_wait_s", type=float, default=0.5)
-    sv.add_argument("--low_wait_s", type=float, default=0.05)
-    sv.add_argument("--serving_cooldown_s", type=float, default=8.0)
-    sv.add_argument("--train_cooldown_s", type=float, default=10.0)
-    sv.add_argument("--flap_window_s", type=float, default=20.0)
-    sv.add_argument("--drain_deadline_s", type=float, default=30.0)
-    sv.add_argument("--spawn_arg", action="append", default=None,
-                    help="repeatable: extra argv for spawned replicas "
-                         "(default: --demo)")
+    # the controller flags, shared by `serve` (the primary) and `standby`
+    # (which builds an IDENTICAL controller if and when it takes over)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--router", default=None,
+                        help="router host:port (serving spawn/drain lever)")
+    common.add_argument("--master", default=None,
+                        help="master host:port (training resize lever)")
+    common.add_argument("--tick_s", type=float, default=1.0)
+    common.add_argument("--chips", type=int, default=8,
+                        help="total chip budget arbitrated across both "
+                             "fleets")
+    common.add_argument("--chips_per_replica", type=int, default=1)
+    common.add_argument("--min_replicas", type=int, default=1)
+    common.add_argument("--max_replicas", type=int, default=8)
+    common.add_argument("--train_min_world", type=int, default=0)
+    common.add_argument("--train_max_world", type=int, default=8)
+    common.add_argument("--high_wait_s", type=float, default=0.5)
+    common.add_argument("--low_wait_s", type=float, default=0.05)
+    common.add_argument("--serving_cooldown_s", type=float, default=8.0)
+    common.add_argument("--train_cooldown_s", type=float, default=10.0)
+    common.add_argument("--flap_window_s", type=float, default=20.0)
+    common.add_argument("--drain_deadline_s", type=float, default=30.0)
+    common.add_argument("--spawn_arg", action="append", default=None,
+                        help="repeatable: extra argv for spawned replicas "
+                             "(default: --demo)")
+    common.add_argument("--liveness_port", type=int, default=None,
+                        help="bind a liveness port a standby can watch "
+                             "(closed when the reconcile loop dies)")
+    sv = sub.add_parser("serve", parents=[common],
+                        help="run the reconcile loop")
+    sb = sub.add_parser(
+        "standby", parents=[common],
+        help="watch a primary controller's liveness port; run an identical "
+             "controller when it dies (ISSUE 18)",
+    )
+    sb.add_argument("--primary", required=True,
+                    help="primary controller's liveness host:port")
+    sb.add_argument("--poll_s", type=float, default=0.2)
+    sb.add_argument("--max_wait_s", type=float, default=None,
+                    help="give up after this long with the primary healthy")
     args = ap.parse_args(argv)
 
     if args.router is None and args.master is None:
@@ -715,29 +839,53 @@ def _main(argv: Optional[List[str]] = None) -> int:
         flap_window_s=args.flap_window_s,
         drain_deadline_s=args.drain_deadline_s,
     )
-    spawner = (
-        ReplicaSpawner(
-            router_ep,
-            extra_args=(args.spawn_arg
-                        if args.spawn_arg is not None else ["--demo"]),
+
+    def _build() -> AutoscalerController:
+        spawner = (
+            ReplicaSpawner(
+                router_ep,
+                extra_args=(args.spawn_arg
+                            if args.spawn_arg is not None else ["--demo"]),
+            )
+            if router_ep is not None else None
         )
-        if router_ep is not None else None
-    )
-    ctl = AutoscalerController(
-        router_endpoints=router_ep,
-        master_endpoints=(
-            _parse_endpoint(args.master) if args.master else None
-        ),
-        config=cfg, spawner=spawner, tick_s=args.tick_s,
-    ).start()
+        return AutoscalerController(
+            router_endpoints=router_ep,
+            master_endpoints=(
+                _parse_endpoint(args.master) if args.master else None
+            ),
+            config=cfg, spawner=spawner, tick_s=args.tick_s,
+            liveness_port=args.liveness_port,
+        )
+
+    if args.cmd == "standby":
+        stop_evt = threading.Event()
+        _signal.signal(_signal.SIGTERM, lambda *_: stop_evt.set())
+        _signal.signal(_signal.SIGINT, lambda *_: stop_evt.set())
+        ctl = AutoscalerStandby(
+            args.primary, _build, poll_s=args.poll_s,
+            max_wait_s=args.max_wait_s, stop_evt=stop_evt,
+        ).run()
+        if ctl is None:
+            print(json.dumps({"role": "autoscaler_standby",
+                              "takeover": False}), flush=True)
+            return 3
+        print(json.dumps({"role": "autoscaler_standby", "takeover": True,
+                          "instance": ctl.instance}), flush=True)
+    else:
+        ctl = _build().start()
     _signal.signal(_signal.SIGTERM, lambda *_: ctl.stop())
     _signal.signal(_signal.SIGINT, lambda *_: ctl.stop())
-    print(json.dumps({"role": "autoscaler", "tick_s": args.tick_s}),
-          flush=True)
+    if args.cmd == "serve":
+        print(json.dumps({
+            "role": "autoscaler", "tick_s": args.tick_s,
+            "liveness": (list(ctl.liveness_address)
+                         if ctl.liveness_address else None),
+        }), flush=True)
     while ctl._thread is not None and ctl._thread.is_alive():
         time.sleep(0.05)
-    if spawner is not None:
-        spawner.stop_all()
+    if ctl.spawner is not None:
+        ctl.spawner.stop_all()
     print(json.dumps({"role": "autoscaler", "final": ctl.stats()}),
           flush=True)
     return 0

@@ -1083,35 +1083,24 @@ def standby_master(
     before binding — a briefly-overloaded primary is not usurped. Without a
     consensus backend this is still a heuristic: a primary alive on the far
     side of a real network partition can double-serve; production
-    deployments should fence via the shared snapshot storage."""
-    (phost, pport) = parse_endpoints(primary)[0]
-    misses = 0.0
-    deadline = time.monotonic() + max_wait_s if max_wait_s is not None else None
-    while True:
-        if stop_evt is not None and stop_evt.is_set():
-            return None
-        if deadline is not None and time.monotonic() > deadline:
-            return None
-        try:
-            socket.create_connection((phost, pport), timeout=1.0).close()
-            misses = 0.0
-        except TimeoutError:
-            misses += 0.5  # slow ≠ dead: timeouts need twice the evidence
-        except OSError:
-            misses += 1.0
-        if misses >= confirm_failures:
-            try:  # final confirmation, patient timeout: live beats standby
-                socket.create_connection((phost, pport), timeout=3.0).close()
-                misses = 0.0
-            except OSError:
-                break
-        time.sleep(poll_s)
-    log.warning(
-        "standby: primary %s:%d unreachable %d times — taking over on "
-        "%s:%d from snapshot %s", phost, pport, misses, host, port,
-        snapshot_path,
+    deployments should fence via the shared snapshot storage.
+
+    The watch/confirm loop itself is `runtime/election.py` (ISSUE 18) —
+    this is the master-plane consumer of the same primitive `RouterStandby`
+    and `AutoscalerStandby` stand on."""
+    from paddle_tpu.runtime.election import watch_primary
+
+    token = watch_primary(
+        primary, plane="master", poll_s=poll_s,
+        confirm_failures=confirm_failures, max_wait_s=max_wait_s,
+        stop_evt=stop_evt,
     )
-    stats.FT_EVENTS.incr("master_takeover")
+    if token is None:
+        return None
+    log.warning(
+        "standby master (incarnation %s) taking over on %s:%d from "
+        "snapshot %s", token, host, port, snapshot_path,
+    )
     return MasterServer(
         host=host, port=port, snapshot_path=snapshot_path, **server_kw
     ).start()

@@ -36,7 +36,11 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from paddle_tpu.core import stats
-from paddle_tpu.runtime.master import EndpointsLike, MasterClient
+from paddle_tpu.runtime.master import (
+    EndpointsLike,
+    MasterClient,
+    parse_endpoints,
+)
 
 log = logging.getLogger("paddle_tpu.serving.fleet")
 
@@ -231,7 +235,25 @@ class ReplicaAgent:
     supervisor recovered it, or the stall simply passed) heartbeats resume;
     an evicted-then-healed replica is told to RE-REGISTER and rejoins under
     a fresh lease, while its old pump connection lets any late results it
-    still produces reach the router's dedup map (dropped + counted)."""
+    still produces reach the router's dedup map (dropped + counted).
+
+    Router HA (ISSUE 18): `router_endpoints` may list a primary AND a warm
+    standby. The agent manages rotation ITSELF (one single-endpoint client
+    at a time, not MasterClient's internal list rotation) so that every
+    control hint in a reply — `reregister`, `drain` — is provably from the
+    endpoint the agent just spoke to and is honored against THAT endpoint;
+    the old arrangement could race a reregister hint into a registration
+    against the dead primary. Replies carry the router's per-incarnation
+    `instance` token; a hint from a FOREIGN incarnation is obeyed only when
+    this agent's registered incarnation is provably gone (its endpoint
+    re-bound by the new incarnation, or unreachable past ROTATE_AFTER
+    consecutive failures) — otherwise it is a stale reply from a
+    partitioned old primary, counted and dropped (instance-token fencing,
+    the double-takeover guard)."""
+
+    # consecutive connection failures against the REGISTERED endpoint
+    # before the agent concludes its router is gone and rotates
+    ROTATE_AFTER = 2
 
     def __init__(
         self,
@@ -242,10 +264,16 @@ class ReplicaAgent:
         stall_fence_s: float = 5.0,
         on_drained: Optional[Callable[[], None]] = None,
     ):
-        self._endpoints = router_endpoints
-        self._client = MasterClient(
-            router_endpoints, **(client_kw or {"timeout": 5.0, "retries": 3})
-        )
+        self._eps = parse_endpoints(router_endpoints)
+        self._cur = 0
+        self._client_kw = dict(client_kw or {"timeout": 5.0, "retries": 3})
+        self._client = MasterClient(self._eps[self._cur], **self._client_kw)
+        # which router incarnation + endpoint index holds our registration
+        self.router_instance: Optional[str] = None
+        self._reg_ep: Optional[int] = None
+        self._conn_failures = 0
+        self.rotations = 0
+        self.stale_replies = 0
         self.session = session
         self.advertise = (str(advertise[0]), int(advertise[1]))
         self.stall_fence_s = float(stall_fence_s)
@@ -290,6 +318,29 @@ class ReplicaAgent:
         self._thread.start()
         return self
 
+    def _rotate(self) -> None:
+        """Move to the next router endpoint (no-op for a single-endpoint
+        list): close the current single-endpoint client and open the next."""
+        if len(self._eps) <= 1:
+            return
+        self._client.close()
+        self._cur = (self._cur + 1) % len(self._eps)
+        self._client = MasterClient(self._eps[self._cur], **self._client_kw)
+        self.rotations += 1
+        stats.FT_EVENTS.incr("replica_router_rotate")
+        log.warning("replica agent rotating to router endpoint %s:%d",
+                    *self._eps[self._cur])
+
+    def _note_conn_failure(self) -> None:
+        self._conn_failures += 1
+        # unregistered, any live router will do — rotate on the first
+        # failure; registered, stay pinned to our router until its death is
+        # confirmed (ROTATE_AFTER strikes), so one transient hiccup cannot
+        # hand control hints to a different incarnation
+        threshold = 1 if self.replica_id is None else self.ROTATE_AFTER
+        if self._conn_failures >= threshold:
+            self._rotate()
+
     def _register(self) -> bool:
         try:
             resp = self._client.call(
@@ -302,14 +353,74 @@ class ReplicaAgent:
             # serving direct traffic and the heartbeat loop keeps trying
             log.warning("replica register with router failed (%s); retrying "
                         "from the heartbeat loop", e)
+            self._note_conn_failure()
             return False
         if "replica_id" not in resp:
             log.warning("router refused replica registration: %r", resp)
             return False
         self.replica_id = resp["replica_id"]
         self.lease_s = float(resp.get("lease_s", 5.0))
+        self.router_instance = resp.get("instance")
+        self._reg_ep = self._cur
+        self._conn_failures = 0
         stats.FT_EVENTS.incr("replica_registered")
         return True
+
+    def _handle_reply(self, resp: dict) -> Optional[str]:
+        """Fold one heartbeat reply into agent state. Returns 'drained' when
+        the agent should stop renewing, else None. Split out of the loop so
+        the fencing decisions are drivable by tests without sockets."""
+        inst = resp.get("instance")
+        foreign = (
+            inst is not None and self.router_instance is not None
+            and inst != self.router_instance
+        )
+        if foreign:
+            at_home = self._reg_ep is not None and self._cur == self._reg_ep
+            lost_home = self._conn_failures >= self.ROTATE_AFTER
+            if not (at_home or lost_home):
+                # instance-token fencing (the double-takeover guard): a
+                # DIFFERENT router incarnation answered while our own was
+                # last known reachable — a stale/partitioned old primary.
+                # Ignore its hints and go home; only our incarnation's
+                # death (port re-bound, or unreachable past the threshold)
+                # makes a foreign hint actionable.
+                self.stale_replies += 1
+                stats.FT_EVENTS.incr("replica_stale_router_reply")
+                if self._reg_ep is not None and self._cur != self._reg_ep:
+                    self._client.close()
+                    self._cur = self._reg_ep
+                    self._client = MasterClient(
+                        self._eps[self._cur], **self._client_kw
+                    )
+                return None
+            # our incarnation is gone: whatever this reply says, a fresh
+            # registration against the endpoint that ANSWERED is the move
+            self.replica_id = None
+            stats.FT_EVENTS.incr("replica_reregister")
+            self._register()
+            return None
+        self._conn_failures = 0
+        if resp.get("drained"):
+            # planned drain completed router-side: deregistered; tell
+            # the operator hook and stop renewing
+            if self.on_drained is not None:
+                try:
+                    self.on_drained()
+                except Exception:
+                    log.exception("on_drained callback failed")
+            return "drained"
+        if resp.get("reregister"):
+            # the router evicted this lease (we were wedged/partitioned
+            # past it) and we outlived the verdict: rejoin fresh — the
+            # old id stays dead so late results stay distinguishable.
+            # The registration goes through self._client, i.e. against
+            # the endpoint that ISSUED this hint — a concurrent failover
+            # can no longer race it onto a dead primary.
+            self.replica_id = None
+            stats.FT_EVENTS.incr("replica_reregister")
+            self._register()
+        return None
 
     def _loop(self) -> None:
         while True:
@@ -335,23 +446,10 @@ class ReplicaAgent:
                 )
             except ConnectionError:
                 stats.FT_EVENTS.incr("replica_heartbeat_lost")
+                self._note_conn_failure()
                 continue
-            if resp.get("drained"):
-                # planned drain completed router-side: deregistered; tell
-                # the operator hook and stop renewing
-                if self.on_drained is not None:
-                    try:
-                        self.on_drained()
-                    except Exception:
-                        log.exception("on_drained callback failed")
+            if self._handle_reply(resp) == "drained":
                 return
-            if resp.get("reregister"):
-                # the router evicted this lease (we were wedged/partitioned
-                # past it) and we outlived the verdict: rejoin fresh — the
-                # old id stays dead so late results stay distinguishable
-                self.replica_id = None
-                stats.FT_EVENTS.incr("replica_reregister")
-                self._register()
 
     def stop(self) -> None:
         """Clean leave: deregister so the router drops the lease now."""

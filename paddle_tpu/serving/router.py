@@ -53,7 +53,8 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 from paddle_tpu.core import stats
 from paddle_tpu.obs import metrics as obs_metrics
 from paddle_tpu.obs import trace
-from paddle_tpu.runtime.master import MasterClient, _Membership
+from paddle_tpu.runtime.election import mint_instance_token, watch_primary
+from paddle_tpu.runtime.master import EndpointsLike, MasterClient, _Membership
 from paddle_tpu.serving.fleet import FleetView, Replica, ReplicaState
 from paddle_tpu.serving.quota import QuotaExceeded
 from paddle_tpu.serving.scheduler import FinishReason
@@ -202,6 +203,12 @@ class Router:
             else max(2.0 * lease_s, 5.0)
         )
         self.handle_ttl_s = float(handle_ttl_s)
+        # per-incarnation identity (ISSUE 18): minted fresh for every Router
+        # object and echoed on replica register/heartbeat replies, so agents
+        # can fence control hints by WHICH router incarnation issued them —
+        # a healed old primary's stale replies are recognizably not ours.
+        # A RouterStandby overwrites this with its election token.
+        self.instance = mint_instance_token()
         self._replica_client_kw = dict(
             replica_client_kw or {"timeout": 5.0, "retries": 2}
         )
@@ -231,6 +238,9 @@ class Router:
         self.shed = 0
         self.replica_evictions = 0
         self.drains_completed = 0
+        # requests this incarnation ADOPTED from replica state via the
+        # takeover sweep (it never saw their submit — a dead predecessor did)
+        self.adopted = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "Router":
@@ -259,6 +269,13 @@ class Router:
         rep = self.fleet.register((endpoint[0], int(endpoint[1])))
         if load:
             rep.load = dict(load)
+        # takeover sweep (ISSUE 18) — BEFORE the pump starts, so the first
+        # pump cycle already polls every adopted request. For a fresh
+        # replica this is one cheap empty-reply RPC; for a replica
+        # re-registering after a router takeover (or an eviction it
+        # outlived) it rebuilds this incarnation's in-flight/dedup books
+        # from the data plane. Cold path: once per registration EVENT.
+        self._sweep_replica(rep)
         pump = threading.Thread(
             target=self._pump_loop, args=(rep,),
             name=f"router-pump-{rep.replica_id}", daemon=True,
@@ -267,22 +284,118 @@ class Router:
         pump.start()
         stats.FT_EVENTS.incr("router_replica_joined")
         log.info("replica %s joined at %s:%d", rep.replica_id, *rep.endpoint)
-        return {"replica_id": rep.replica_id, "lease_s": self.fleet.lease_s}
+        return {"replica_id": rep.replica_id, "lease_s": self.fleet.lease_s,
+                "instance": self.instance}
 
     def replica_heartbeat(self, replica_id: Optional[str],
                           load: Optional[dict] = None) -> dict:
+        # every reply names this incarnation: the agent's fencing compares
+        # it against the incarnation it registered with (ISSUE 18)
         rep = self.fleet.heartbeat(replica_id, load)
         if rep is None:
-            return {"ok": False, "reregister": True}
+            return {"ok": False, "reregister": True,
+                    "instance": self.instance}
         if rep.drained:
-            return {"ok": True, "drained": True}
+            return {"ok": True, "drained": True, "instance": self.instance}
         if rep.state == ReplicaState.DRAINING:
-            return {"ok": True, "drain": True}
+            return {"ok": True, "drain": True, "instance": self.instance}
         if rep.state not in (ReplicaState.LIVE,):
             # evicted lease the replica outlived (wedge healed, partition
             # closed): rejoin fresh; the old pump still catches late results
-            return {"ok": False, "reregister": True}
-        return {"ok": True}
+            return {"ok": False, "reregister": True,
+                    "instance": self.instance}
+        return {"ok": True, "instance": self.instance}
+
+    # -- takeover sweep (ISSUE 18) -------------------------------------------
+    def _sweep_replica(self, rep: Replica) -> None:
+        """Stateless-reconciling takeover: ask a just-registered replica for
+        every keyed request it still holds (in flight AND server-held
+        results) and rebuild the fleet books — handles, the (tenant, key)
+        dedup map, rid mappings, seeds. After a router death the data plane
+        is the only copy of this state; one sweep per registration event
+        recovers it without a journal. Connection/err failures degrade to
+        an empty sweep: the replica is simply treated as fresh."""
+        lock, client = self._submit_client(rep)
+        try:
+            with lock:
+                # rpc-ok: ONE sweep call per replica registration event
+                # (cold path — never in the pump/dispatch/reap loops)
+                resp = client.call("outstanding")
+        except (ConnectionError, OSError):
+            return
+        items = resp.get("requests") or []
+        if not items:
+            return
+        # clock-ok: one admission stamp for the whole adopted batch
+        now = time.monotonic()
+        adopted = 0
+        with self._lock:
+            for item in items:
+                try:
+                    adopted += self._adopt_locked(rep, item, now)
+                except (KeyError, TypeError, ValueError):
+                    continue  # one malformed item must not void the sweep
+        if adopted:
+            stats.FT_EVENTS.incr("router_requests_adopted", adopted)
+            log.warning(
+                "takeover sweep: adopted %d request(s) from replica %s",
+                adopted, rep.replica_id,
+            )
+        self._notify_streams()
+
+    def _adopt_locked(self, rep: Replica, item: dict, now: float) -> int:
+        """Fold one `outstanding` item into the books (caller holds the
+        lock). Returns 1 when a NEW handle was minted (this incarnation
+        never saw the request), 0 for a key we already track — in which
+        case the replica's copy is mapped as an additional assignment and
+        the dedup latch arbitrates: first terminal answer wins, the other
+        is dropped-and-counted exactly like a hedge loser or late winner."""
+        tenant = str(item.get("tenant_id") or "default")
+        key = str(item["client_req_id"])
+        rrid = int(item["request_id"])
+        rid = self._by_key.get((tenant, key))
+        h = self._handles.get(rid) if rid is not None else None
+        if h is None:
+            rid = next(self._ids)
+            h = RouterHandle(
+                rid, tenant, [int(t) for t in item.get("prompt") or []],
+                item.get("max_new_tokens"), key,
+                # re-pin the seed from replica state: a later failover of
+                # this request re-submits under the SAME sampling identity,
+                # so re-execution is token-identical, greedy AND sampled
+                seed=int(item.get("seed") or 0) & 0xFFFFFFFF,
+                now=now,
+                temperature=item.get("temperature"),
+                top_k=item.get("top_k"),
+            )
+            h._router = self
+            h.status = RouterHandle.RUNNING
+            self._handles[rid] = h
+            self._by_key[(tenant, key)] = rid
+            self.adopted += 1
+            fresh = 1
+        else:
+            fresh = 0
+        if h._finished:
+            # already delivered by a survivor: map the replica's copy for
+            # polling only, so its eventual answer lands in the dedup latch
+            # (dropped + counted), never re-delivered
+            rep.rids[h.request_id] = rrid
+            return fresh
+        rep.rids[h.request_id] = rrid
+        rep.outstanding.add(h.request_id)
+        h.assignments[rep.replica_id] = rrid
+        self._unassigned.discard(h.request_id)
+        h.t_parked = None
+        return fresh
+
+    def get_by_key(self, tenant: str, key: str) -> Optional[RouterHandle]:
+        """Resolve a request by its (tenant, client_req_id) identity — what
+        a client reattaching across a takeover presents when its request_id
+        names a dead incarnation's books."""
+        with self._lock:
+            rid = self._by_key.get((str(tenant), str(key)))
+            return self._handles.get(rid) if rid is not None else None
 
     def deregister_replica(self, replica_id: Optional[str]) -> bool:
         rep = self.fleet.get(replica_id) if replica_id else None
@@ -469,6 +582,8 @@ class Router:
             "shed": self.shed,
             "replica_evictions": self.replica_evictions,
             "drains_completed": self.drains_completed,
+            "adopted_requests": self.adopted,
+            "instance": self.instance,
             # the tightest current queue-wait estimate across live replicas:
             # what a load balancer above THIS tier would piggyback on
             "estimated_queue_wait_s": min(
@@ -1033,6 +1148,7 @@ class RouterServer:
         self._srv.daemon_threads = True
         self._srv.ctx = self  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+        self._killed = False
         self.stream_frames = 0
         self._stream_lock = threading.Lock()
 
@@ -1121,7 +1237,17 @@ class RouterServer:
         if method in ("poll", "cancel", "stream"):
             from paddle_tpu.serving.server import clamp_cursor
 
-            h = r.get_handle(int(req["request_id"]))
+            if req.get("client_req_id"):
+                # identity is the (tenant, client_req_id) key, NOT the rid:
+                # after a takeover this incarnation's rid counter restarted,
+                # so the client's stale rid may name a DIFFERENT request —
+                # resolving by rid would hand it someone else's tokens. The
+                # takeover sweep rebuilt the key map from replica state;
+                # a key miss means the request is not in these books.
+                h = r.get_by_key(tenant_id or "default",
+                                 str(req["client_req_id"]))
+            else:
+                h = r.get_handle(int(req["request_id"]))
             if h is None:
                 return {"err": f"unknown request_id {req['request_id']}"}
             if h.tenant != (tenant_id or "default"):
@@ -1177,16 +1303,98 @@ class RouterServer:
         return self
 
     def stop(self) -> None:
+        if self._killed:
+            return
         if self._thread is not None:
             self._srv.shutdown()
         self._srv.server_close()
         self.router.stop()
 
+    def kill(self) -> None:
+        """Fault injection (chaos drills, HA tests): die abruptly — stop
+        accepting, drop the port, answer nothing. No drain, no goodbye to
+        replicas or clients; the standby's probe loop and the replicas'
+        heartbeat rotation are what must notice. Mirrors ServingServer.kill."""
+        self._killed = True
+        self.router._stop.set()
+
+        def _die():
+            try:
+                self._srv.shutdown()
+                self._srv.server_close()
+            except OSError:
+                pass
+
+        threading.Thread(target=_die, name="router-kill", daemon=True).start()
+
+
+class RouterStandby:
+    """Warm standby for the serving router (ISSUE 18), on the shared
+    election primitive (`runtime/election.py`). Watches the primary's TCP
+    port; after N strikes plus one patient confirmation probe it binds its
+    OWN port and becomes the fleet's router — *stateless-reconciling*
+    takeover, no journal, no replicated log:
+
+      - replicas carry both endpoints; their heartbeat rotation finds the
+        standby, the unknown-id `reregister` hint heals leases, and
+        `register_replica`'s takeover sweep rebuilds the in-flight/dedup
+        books from each replica's `outstanding` reply (prompt, seed,
+        temperature, tokens so far, server-held results);
+      - clients carry both endpoints too; their retry/reattach path
+        presents the (tenant, client_req_id) key, which the rebuilt key
+        map resolves even though request ids restarted;
+      - the election token becomes this incarnation's `Router.instance`,
+        fencing replica agents against a healed old primary.
+
+    The standby binds at TAKEOVER, not at construction: two live routers
+    must never answer the same fleet, and an un-elected standby holding a
+    bound port would look alive to the other standby's probes."""
+
+    def __init__(self, primary: EndpointsLike, host: str = "127.0.0.1",
+                 port: int = 0, poll_s: float = 0.2,
+                 confirm_failures: int = 2,
+                 max_wait_s: Optional[float] = None,
+                 stop_evt: Optional[threading.Event] = None,
+                 lease_s: float = 5.0, tenant_lease_s: float = 30.0,
+                 **router_kw):
+        self.primary = primary
+        self.host, self.port = host, int(port)
+        self.poll_s = float(poll_s)
+        self.confirm_failures = int(confirm_failures)
+        self.max_wait_s = max_wait_s
+        self.stop_evt = stop_evt
+        self.lease_s = float(lease_s)
+        self.tenant_lease_s = float(tenant_lease_s)
+        self.router_kw = router_kw
+
+    def run(self) -> Optional["RouterServer"]:
+        """Block watching the primary; on confirmed death return a STARTED
+        RouterServer whose `Router.instance` is the election token. None
+        when stopped or timed out with the primary still alive."""
+        token = watch_primary(
+            self.primary, plane="router", poll_s=self.poll_s,
+            confirm_failures=self.confirm_failures,
+            max_wait_s=self.max_wait_s, stop_evt=self.stop_evt,
+        )
+        if token is None:
+            return None
+        srv = RouterServer(
+            host=self.host, port=self.port, lease_s=self.lease_s,
+            tenant_lease_s=self.tenant_lease_s, **self.router_kw,
+        )
+        srv.router.instance = token
+        log.warning(
+            "router standby (incarnation %s) taking over on %s:%d",
+            token, *srv.address,
+        )
+        return srv.start()
+
 
 def _main(argv: Optional[List[str]] = None) -> int:
-    """`python -m paddle_tpu.serving.router serve|drain|status` — the router
-    as its own process, plus the ops levers (`drain` is the hook ROADMAP
-    item 2's autoscaling controller pulls)."""
+    """`python -m paddle_tpu.serving.router serve|standby|drain|status` —
+    the router as its own process, plus the ops levers (`drain` is the hook
+    ROADMAP item 2's autoscaling controller pulls) and the warm-standby
+    role (ISSUE 18)."""
     import argparse
     import json
     import signal as _signal
@@ -1223,6 +1431,21 @@ def _main(argv: Optional[List[str]] = None) -> int:
     sv.add_argument("--autoscale_spawn_arg", action="append", default=None,
                     help="repeatable: extra argv for spawned replicas "
                          "(default: --demo)")
+    sb = sub.add_parser(
+        "standby",
+        help="watch a primary router; take over its fleet when it dies "
+             "(replicas and clients must carry this standby's endpoint in "
+             "their --router_endpoints list)",
+    )
+    sb.add_argument("--primary", required=True, help="primary host:port")
+    sb.add_argument("--host", default="127.0.0.1")
+    sb.add_argument("--port", type=int, default=0)
+    sb.add_argument("--lease_s", type=float, default=5.0)
+    sb.add_argument("--hedge_ttft_s", type=float, default=0.0)
+    sb.add_argument("--drain_deadline_s", type=float, default=30.0)
+    sb.add_argument("--poll_s", type=float, default=0.2)
+    sb.add_argument("--max_wait_s", type=float, default=None,
+                    help="give up after this long with the primary healthy")
     for name in ("drain", "status"):
         p = sub.add_parser(name)
         p.add_argument("--endpoint", required=True, help="router host:port")
@@ -1277,6 +1500,27 @@ def _main(argv: Optional[List[str]] = None) -> int:
             ctl.stop()
             if ctl.spawner is not None:
                 ctl.spawner.stop_all()
+        return 0
+    if args.cmd == "standby":
+        stop_evt = threading.Event()
+        _signal.signal(_signal.SIGTERM, lambda *_: stop_evt.set())
+        _signal.signal(_signal.SIGINT, lambda *_: stop_evt.set())
+        srv = RouterStandby(
+            args.primary, host=args.host, port=args.port,
+            poll_s=args.poll_s, max_wait_s=args.max_wait_s,
+            stop_evt=stop_evt, lease_s=args.lease_s,
+            hedge_ttft_s=args.hedge_ttft_s or None,
+            drain_deadline_s=args.drain_deadline_s,
+        ).run()
+        if srv is None:
+            print(json.dumps({"role": "router_standby", "takeover": False}),
+                  flush=True)
+            return 3
+        print(json.dumps({"role": "router_standby", "takeover": True,
+                          "address": list(srv.address)}), flush=True)
+        while srv._thread is not None and srv._thread.is_alive():
+            time.sleep(0.05)
+        srv.stop()
         return 0
     client = MasterClient(args.endpoint)
     try:

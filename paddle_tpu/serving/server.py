@@ -73,6 +73,12 @@ class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         srv: ServingServer = self.server.ctx  # type: ignore[attr-defined]
         for line in self.rfile:
+            if getattr(srv, "_killed", False):
+                # crash semantics: server_close() only shuts the listener —
+                # a killed process must also stop answering on established
+                # connections, or a standby's clients would never notice
+                # the primary died (they'd keep heartbeating a ghost)
+                break
             try:
                 req = json.loads(line)
             except json.JSONDecodeError:
@@ -333,7 +339,23 @@ class ServingServer:
                         request_id=handle.request_id)
         if method in ("poll", "cancel", "stream"):
             with self._handles_lock:
-                handle = self._handles.get(int(req["request_id"]))
+                if req.get("client_req_id"):
+                    # identity is the (tenant, client_req_id) key, NOT the
+                    # rid (ISSUE 18): across a server restart or router
+                    # takeover the rid counter restarted, so a stale rid may
+                    # name a DIFFERENT request — never fall back to it when
+                    # the caller supplied its key. Keys are GC'd together
+                    # with their handles, so a key miss means the request
+                    # is not in these books.
+                    rid = self._by_client_id.get(
+                        (self._tenant_for(tenant_id),
+                         str(req["client_req_id"]))
+                    )
+                    handle = (
+                        self._handles.get(rid) if rid is not None else None
+                    )
+                else:
+                    handle = self._handles.get(int(req["request_id"]))
             if handle is None:
                 return {"err": f"unknown request_id {req['request_id']}"}
             # request ids are sequential — poll/cancel/stream must enforce
@@ -403,6 +425,41 @@ class ServingServer:
                         "tokens_so_far": len(toks),
                     })
             return {"results": out}
+        if method == "outstanding":
+            # the takeover sweep (ISSUE 18): a freshly-elected router asks
+            # each re-registering replica for every keyed request it still
+            # holds — in flight AND finished-but-unpolled (server-held
+            # results the dead router never delivered). The reply carries
+            # the full re-submission identity (prompt, pinned seed,
+            # sampling knobs), so the new router can rebuild its dedup/
+            # in-flight books from the data plane and fail a request over
+            # token-identically if THIS replica dies too. Cold path: one
+            # call per replica registration event, never per poll cycle.
+            out = []
+            with self._handles_lock:
+                keyed = [
+                    (tenant, key, rid)
+                    for (tenant, key), rid in self._by_client_id.items()
+                ]
+            for tenant, key, rid in keyed:
+                with self._handles_lock:
+                    handle = self._handles.get(rid)
+                if handle is None:
+                    continue
+                out.append({
+                    "request_id": rid,
+                    "tenant_id": tenant,
+                    "client_req_id": key,
+                    "prompt": [int(t) for t in
+                               getattr(handle, "prompt_tokens", None) or []],
+                    "max_new_tokens": handle.max_new_tokens,
+                    "seed": handle.seed,
+                    "temperature": handle.temperature,
+                    "top_k": handle.top_k,
+                    "done": handle.done,
+                    "tokens_so_far": len(handle.tokens),
+                })
+            return {"requests": out}
         if method == "generate_config":
             return self._generate_config(req)
         return {"err": f"unknown method {method!r}"}
@@ -672,12 +729,21 @@ class ServingClient:
     the request."""
 
     def __init__(self, address: EndpointsLike, **client_kw):
+        # `address` may be a LIST ("primary:p1,standby:p2" or a sequence of
+        # endpoints — ISSUE 18): MasterClient rotates on connection failure,
+        # so a router primary + warm standby is one constructor argument and
+        # every path below (generate/submit/poll/cancel/stream) fails over
         self._client = MasterClient(address, **client_kw)
         self.tenant_id: Optional[str] = None
         self.lease_s: float = 30.0
         self.hedges = 0  # hedged retries issued (TTFT-deadline misses)
         self.shed_retries = 0  # submits retried after a shed's retry_after_ms
         self.stream_reattaches = 0  # dropped push-streams resumed by cursor
+        # submits re-issued under the same key after the server forgot the
+        # request id (router takeover, failover to a peer): dedup reattaches
+        # when the request still runs anywhere, so this is recovery, not
+        # duplication
+        self.reattach_resubmits = 0
 
     def register(self) -> str:
         resp = self._client.call("register")
@@ -706,6 +772,12 @@ class ServingClient:
         import time as _time
 
         key = uuid.uuid4().hex
+        if seed is None:
+            # pin the sampling identity CLIENT-side (ISSUE 18): if every
+            # server-side holder of this request dies in one window (replica
+            # + router), the re-submit under the same key below must re-draw
+            # the same tokens — a server-minted seed dies with the server
+            seed = int.from_bytes(uuid.uuid4().bytes[:4], "little")
         # sampling identity rides the idempotency envelope: a hedged retry
         # re-submits the SAME (seed, temperature, top_k), so even when the
         # original was lost and the hedge IS the request, tokens match what
@@ -737,19 +809,43 @@ class ServingClient:
                     max(0.0, deadline - now),
                 ))
         hedged = False
+        resubmits = 0
         while True:
-            resp = self.poll(rid)
+            resp = self.poll(rid, client_req_id=key)
             if "err" in resp:
+                # the server no longer knows rid (failover to a peer, router
+                # takeover, handle GC): re-issue the submit under the SAME
+                # idempotency key — dedup reattaches when the request still
+                # runs anywhere; only a genuinely lost request becomes a
+                # fresh one (and the client-pinned seed keeps even THAT
+                # token-identical). Bounded: a persistent error surfaces.
+                if resubmits >= max(1, max_retries):
+                    raise RuntimeError(f"generate failed: {resp['err']}")
+                try:
+                    rid = self.submit(prompt, max_new_tokens, **kw)
+                except Rejected as e:
+                    now = _time.monotonic()
+                    if e.retry_after_ms is not None and now < deadline:
+                        # a SHED, not a verdict: a just-took-over router is
+                        # alive before its replicas have re-registered —
+                        # honor the hint and retry without burning the
+                        # resubmit budget (bounded by the caller's timeout)
+                        self.shed_retries += 1
+                        _time.sleep(min(e.retry_after_ms / 1e3,
+                                        retry_sleep_cap_s,
+                                        max(0.0, deadline - now)))
+                        continue
+                    raise RuntimeError(
+                        f"generate failed: {resp['err']} (re-submit under "
+                        f"the same key was then rejected: {e})"
+                    )
+                resubmits += 1
                 if hedge_ttft_s is not None and not hedged:
-                    # the lost-request case hedging exists for: the server no
-                    # longer knows rid (failover to a peer, handle GC) — the
-                    # hedge IS the request, re-issued under the same
-                    # idempotency key, instead of a client-visible failure
                     hedged = True
                     self.hedges += 1
-                    rid = self.submit(prompt, max_new_tokens, **kw)
-                    continue
-                raise RuntimeError(f"generate failed: {resp['err']}")
+                else:
+                    self.reattach_resubmits += 1
+                continue
             if resp.get("done"):
                 return resp
             now = _time.monotonic()
@@ -800,13 +896,19 @@ class ServingClient:
             )
         return int(resp["request_id"])
 
-    def poll(self, request_id: int, from_: Optional[int] = None) -> dict:
+    def poll(self, request_id: int, from_: Optional[int] = None,
+             client_req_id: Optional[str] = None) -> dict:
         """Poll a request; with `from_` set, the not-done reply carries only
         tokens[from_:] (delta poll — `tokens_so_far` still counts them all,
-        and `from` echoes the clamped cursor the suffix starts at)."""
+        and `from` echoes the clamped cursor the suffix starts at). With
+        `client_req_id` set the server falls back to resolving the request
+        by its (tenant, key) identity when the id is unknown — the identity
+        that survives a router takeover."""
         kw: Dict[str, Any] = {"request_id": request_id, **self._id_kw()}
         if from_ is not None:
             kw["from"] = int(from_)
+        if client_req_id is not None:
+            kw["client_req_id"] = str(client_req_id)
         return self._client.call("poll", **kw)
 
     def stream(
@@ -832,11 +934,25 @@ class ServingClient:
         `reattach_retries` times via the `stream` RPC with the token cursor,
         so delivered tokens are never re-sent and never lost; the submit
         leg rides the usual idempotency key, so a retried attach after a
-        lost ack reattaches to the original request."""
+        lost ack reattaches to the original request.
+
+        Self-healing across a ROUTER death (ISSUE 18): the dedicated
+        connection rotates the endpoint list, the reattach names the
+        idempotency key (so the new incarnation resolves the request even
+        though its ids restarted), and when the new router doesn't know the
+        request at all (its replica died too) the reattach degrades to a
+        re-submit under the same key + client-pinned seed. Frames are
+        trimmed against the tokens already YIELDED — a takeover target
+        whose mirror is still behind may re-send a prefix, and the consumer
+        must see every token exactly once."""
         if (prompt is None) == (request_id is None):
             raise ValueError("stream() needs exactly one of prompt/request_id")
         key = client_req_id or uuid.uuid4().hex
-        cursor = 0
+        if prompt is not None and seed is None:
+            # client-pinned sampling identity (see generate()): survives the
+            # every-server-side-holder-died window token-identically
+            seed = int.from_bytes(uuid.uuid4().bytes[:4], "little")
+        delivered = 0  # tokens this generator has yielded — the one cursor
         failures = 0
         conn = MasterClient(
             self._client.endpoints, timeout=self._client.timeout, retries=2,
@@ -854,12 +970,38 @@ class ServingClient:
                     )
                 else:
                     frames = conn.call_stream(
-                        "stream", **{"from": cursor},
-                        request_id=request_id, **self._id_kw(),
+                        "stream", **{"from": delivered},
+                        request_id=request_id, client_req_id=key,
+                        **self._id_kw(),
                     )
                 try:
                     ack = next(frames)
                     if "err" in ack:
+                        if (prompt is not None
+                                and ack.get("retry_after_ms") is not None):
+                            # a shed, not a verdict (e.g. a just-took-over
+                            # router whose replicas are still re-joining):
+                            # honor the hint within the reattach budget
+                            failures += 1
+                            if failures > max(0, int(reattach_retries)):
+                                raise Rejected(
+                                    f"stream rejected: {ack['err']}",
+                                    reason=ack.get("rejected"),
+                                    retry_after_ms=ack.get("retry_after_ms"),
+                                )
+                            import time as _time
+                            _time.sleep(
+                                min(ack["retry_after_ms"] / 1e3, 2.0)
+                            )
+                            continue
+                        if request_id is not None and prompt is not None:
+                            # the (possibly new) router knows neither the id
+                            # nor the key: the request died with its holders
+                            # — re-issue it under the same key; dedup makes
+                            # this attach-or-execute, never a duplicate
+                            request_id = None
+                            self.reattach_resubmits += 1
+                            continue
                         raise Rejected(
                             f"stream rejected: {ack['err']}",
                             reason=ack.get("rejected"),
@@ -867,16 +1009,33 @@ class ServingClient:
                         )
                     request_id = int(ack["request_id"])
                     for frame in frames:
-                        cursor = int(frame.get("tokens_so_far", cursor))
-                        yield frame
-                        if frame.get("done"):
-                            return
-                except ConnectionError:
+                        toks = list(frame.get("tokens") or [])
+                        base = int(frame.get("from", delivered))
+                        # trim what this generator already yielded: a frame
+                        # from a reattached (or takeover) stream may overlap
+                        # the delivered prefix — exactly-once to the consumer
+                        unseen = toks[max(0, delivered - base):]
+                        if unseen or frame.get("done"):
+                            out = dict(frame)
+                            out["tokens"] = unseen
+                            out["from"] = delivered
+                            delivered += len(unseen)
+                            out["tokens_so_far"] = max(
+                                int(frame.get("tokens_so_far", delivered)),
+                                delivered,
+                            )
+                            yield out
+                            if out.get("done"):
+                                return
+                except OSError:
+                    # ConnectionError AND recv timeouts: a killed-in-place
+                    # router leaves the push socket open but silent — the
+                    # cursor makes a spurious-timeout reattach harmless
                     failures += 1
                     if failures > max(0, int(reattach_retries)):
                         raise
                     self.stream_reattaches += 1
-                    conn.close()  # reattach from `cursor` on a fresh socket
+                    conn.close()  # reattach from `delivered` on a fresh socket
         finally:
             conn.close()
 

@@ -331,6 +331,13 @@ class ServingSession:
             ),
             top_k=self.default_top_k if top_k is None else int(top_k),
         )
+        # the full prompt rides the handle (ISSUE 18): a router takeover
+        # sweep reads it back via the `outstanding` RPC so a request whose
+        # OWNING replica also dies can be re-submitted to a survivor
+        # token-identically — prompt + pinned seed are the whole sampling
+        # identity, and after a router death the replica is the only
+        # surviving holder of both
+        handle.prompt_tokens = prompt
         SERVING_EVENTS.incr("serving_submitted")
         with self._work:
             self._work.notify()

@@ -1,0 +1,723 @@
+"""Control-plane HA (ISSUE 18): lease-elected standbys + reconciling takeover.
+
+The load-bearing claims:
+
+  * election — `runtime/election.py` is ONE watch/strike/confirm loop for
+    every control plane: a dead primary is confirmed (weighted strikes +
+    patient final probe) before takeover, a live one is never usurped, and
+    stop()/max_wait_s end a watch cleanly with no takeover;
+  * takeover sweep — a freshly-elected router rebuilds its in-flight/dedup
+    books from the data plane: each re-registering replica's `outstanding`
+    reply re-creates handles under their original (tenant, client_req_id)
+    keys with the original pinned seeds, so polls resolve by key, results
+    deliver exactly once, and a second failure (the adopted request's
+    replica also dying) re-executes token-identically or fails NAMED
+    (`replica_lost`) — never silently;
+  * agent fencing — a replica agent honors control hints only from the
+    router incarnation it registered with, unless that incarnation is
+    provably gone (endpoint re-bound or unreachable past the rotation
+    threshold): a healed old primary's stale replies are counted and
+    dropped, closing the double-takeover window;
+  * client self-healing — ServingClient carries an endpoint LIST end to
+    end; generate() re-submits under the same key + client-pinned seed when
+    the (new) router forgot its request id, and stream() reattaches at the
+    delivered-token cursor so the consumer sees every token exactly once
+    across a router death;
+  * autoscaler — the standby rides the same election primitive with ZERO
+    extra state (the controller is already stateless-reconciling); its
+    liveness port drops exactly when the reconcile loop dies, including
+    the controller_kill chaos site.
+
+Timing-sensitive tests use short leases + the deterministic wedge (parking
+the engine on the session's generation lock) rather than sleeps-and-hope;
+every socket test carries the SIGALRM timeout marker."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.core import faults
+from paddle_tpu.core.stats import FT_EVENTS
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos, pytest.mark.ha]
+
+VOCAB = 96
+PROMPT = [1, 5, 9, 11]
+
+
+def _wait(cond, timeout_s=15.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def _free_port() -> int:
+    """Reserve a port for a standby that will bind it only at takeover."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    import jax
+
+    from paddle_tpu.serving.model import LMConfig, ServableLM
+
+    model = ServableLM(
+        LMConfig(vocab=VOCAB, n_layers=2, d_model=32, n_heads=2, max_len=96)
+    )
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def make_session(model_and_params, **kw):
+    from paddle_tpu.serving.session import ServingSession
+
+    model, params = model_and_params
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_buckets", (8, 16, 32))
+    kw.setdefault("max_new_limit", 16)
+    return ServingSession(model, params, **kw)
+
+
+@pytest.fixture(scope="module")
+def reference(model_and_params):
+    """Oracle tokens from a direct single session: greedy and sampled."""
+    s = make_session(model_and_params)
+    greedy = s.submit(PROMPT, 8)
+    sampled = s.submit(PROMPT, 8, seed=77, temperature=0.8, top_k=8)
+    s.run_until_idle()
+    return {"greedy": greedy.tokens, "sampled": sampled.tokens}
+
+
+def warm_session(sess):
+    """Compile before holding a lease (see test_router.warm_session)."""
+    sess.submit(PROMPT, 4)
+    sess.run_until_idle()
+    sess.scheduler.reset_load_estimate()
+    return sess
+
+
+# -- election primitive -------------------------------------------------------
+
+
+@pytest.mark.timeout(60)
+def test_watcher_takes_over_only_when_primary_dies():
+    from paddle_tpu.runtime.election import StandbyWatcher
+
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    ep = lst.getsockname()
+    w = StandbyWatcher(ep, plane="router", poll_s=0.05)
+    before = FT_EVENTS.get("router_takeover")
+    box = {}
+
+    def run():
+        box["token"] = w.wait_for_takeover()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    assert t.is_alive(), "a live primary must never be usurped"
+    assert w.misses == 0.0 and w.probes >= 3
+    lst.close()
+    t.join(timeout=20.0)
+    assert not t.is_alive()
+    token = box["token"]
+    assert isinstance(token, str) and len(token) == 8
+    assert FT_EVENTS.get("router_takeover") == before + 1
+
+
+@pytest.mark.timeout(30)
+def test_watcher_stop_and_max_wait_end_without_takeover():
+    from paddle_tpu.runtime.election import StandbyWatcher, watch_primary
+
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    ep = lst.getsockname()
+    try:
+        # max_wait_s expiry: healthy primary, bounded watch -> None
+        assert watch_primary(ep, plane="router", poll_s=0.05,
+                             max_wait_s=0.3) is None
+        # stop(): even with a DEAD primary a stopped watcher yields nothing
+        w = StandbyWatcher(("127.0.0.1", _free_port()), plane="router",
+                           poll_s=0.05)
+        w.stop()
+        assert w.wait_for_takeover() is None
+    finally:
+        lst.close()
+
+
+def test_instance_tokens_are_per_incarnation():
+    from paddle_tpu.runtime.election import mint_instance_token
+
+    a, b = mint_instance_token(), mint_instance_token()
+    assert a != b and len(a) == len(b) == 8
+
+
+# -- replica agent: rotation + instance-token fencing (no sockets) ------------
+
+
+def _bare_agent(n_eps=2):
+    from paddle_tpu.serving.fleet import ReplicaAgent
+
+    agent = ReplicaAgent(
+        [("127.0.0.1", 1), ("127.0.0.1", 2)][:n_eps], session=None,
+        advertise=("127.0.0.1", 9),
+    )
+    calls = []
+    agent._register = lambda: calls.append("register") or True
+    agent.replica_id = "r-0"
+    agent.router_instance = "aaaa0000"
+    agent._reg_ep = 0
+    return agent, calls
+
+
+def test_agent_honors_hint_from_own_incarnation():
+    agent, calls = _bare_agent()
+    out = agent._handle_reply(
+        {"ok": False, "reregister": True, "instance": "aaaa0000"}
+    )
+    assert out is None and calls == ["register"]
+    assert agent.replica_id is None and agent.stale_replies == 0
+
+
+def test_agent_fences_stale_foreign_reply_and_goes_home():
+    # a DIFFERENT incarnation answered from a non-home endpoint while our
+    # own router was last known reachable: stale old primary — ignore the
+    # hint, count it, rotate back home
+    agent, calls = _bare_agent()
+    agent._cur = 1
+    agent._conn_failures = 0
+    before = FT_EVENTS.get("replica_stale_router_reply")
+    out = agent._handle_reply(
+        {"ok": False, "reregister": True, "instance": "bbbb1111"}
+    )
+    assert out is None and calls == []
+    assert agent.replica_id == "r-0", "stale hint must not drop the lease"
+    assert agent.stale_replies == 1
+    assert FT_EVENTS.get("replica_stale_router_reply") == before + 1
+    assert agent._cur == agent._reg_ep, "fenced agent rotates back home"
+
+
+def test_agent_honors_foreign_reply_when_home_rebound():
+    # home endpoint answered with a NEW incarnation: the old one is provably
+    # gone (its port re-bound) — re-register with the answerer
+    agent, calls = _bare_agent()
+    agent._cur = agent._reg_ep = 0
+    agent._handle_reply({"ok": False, "reregister": True,
+                         "instance": "cccc2222"})
+    assert calls == ["register"] and agent.replica_id is None
+
+
+def test_agent_honors_foreign_reply_when_home_unreachable():
+    agent, calls = _bare_agent()
+    agent._cur = 1
+    agent._conn_failures = agent.ROTATE_AFTER
+    agent._handle_reply({"ok": False, "reregister": True,
+                         "instance": "dddd3333"})
+    assert calls == ["register"] and agent.replica_id is None
+
+
+def test_agent_rotates_after_threshold_only_when_registered():
+    agent, _ = _bare_agent()
+    # registered: one failure stays pinned to the home endpoint...
+    agent._note_conn_failure()
+    assert agent.rotations == 0 and agent._cur == 0
+    # ...the ROTATE_AFTER'th concludes the router is gone and rotates
+    agent._note_conn_failure()
+    assert agent.rotations == 1 and agent._cur == 1
+    # unregistered: any live router will do — first failure rotates
+    fresh, _ = _bare_agent()
+    fresh.replica_id = None
+    fresh._note_conn_failure()
+    assert fresh.rotations == 1
+
+
+def test_agent_single_endpoint_rotation_is_a_noop():
+    agent, _ = _bare_agent(n_eps=1)
+    agent._note_conn_failure()
+    agent._note_conn_failure()
+    assert agent.rotations == 0 and agent._cur == 0
+
+
+# -- the sweep source: the replica's `outstanding` reply ----------------------
+
+
+@pytest.mark.timeout(120)
+def test_outstanding_reports_resubmission_identity(model_and_params):
+    from paddle_tpu.runtime.master import MasterClient
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    sess = warm_session(make_session(model_and_params))
+    srv = ServingServer(session=sess).start()
+    client = ServingClient(srv.address)
+    probe = MasterClient(srv.address)
+    try:
+        with sess._gen_lock:  # wedge: the request stays in flight
+            rid = client.submit(PROMPT, 6, client_req_id="k-ha-1", seed=77,
+                                temperature=0.8, top_k=8)
+            items = probe.call("outstanding")["requests"]
+            mine = [i for i in items if i["client_req_id"] == "k-ha-1"]
+            assert len(mine) == 1
+            (item,) = mine
+            assert item["request_id"] == rid
+            assert item["prompt"] == PROMPT, "sweep needs the prompt back"
+            assert item["seed"] == 77 and item["max_new_tokens"] == 6
+            assert item["temperature"] == 0.8 and item["top_k"] == 8
+            assert not item["done"]
+        assert _wait(lambda: client.poll(rid).get("done"), 30.0)
+        # finished-but-unpolled results are still reported (server-held):
+        # the new router must learn about them to deliver, not re-run
+        done = [i for i in probe.call("outstanding")["requests"]
+                if i["client_req_id"] == "k-ha-1"]
+        assert done and done[0]["done"] and done[0]["tokens_so_far"] == 6
+    finally:
+        probe.close()
+        client.close()
+        srv.stop()
+
+
+# -- takeover sweep: a fresh router adopts replica state ----------------------
+
+
+@pytest.mark.timeout(120)
+def test_fresh_router_sweep_adopts_and_delivers(model_and_params, reference):
+    """The reconciling-takeover core, isolated: a router that has NEVER
+    seen a submit registers a replica already holding keyed requests, and
+    the sweep rebuilds handles (key map, pinned seed, RUNNING status) that
+    then finish with oracle tokens — pollable BY KEY by a client whose
+    request ids died with the old incarnation."""
+    from paddle_tpu.serving.router import RouterServer
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    sess = warm_session(make_session(model_and_params))
+    srv = ServingServer(session=sess).start()
+    direct = ServingClient(srv.address)
+    router = RouterServer(lease_s=3.0).start()
+    try:
+        with sess._gen_lock:
+            direct.submit(PROMPT, 8, client_req_id="k-greedy")
+            direct.submit(PROMPT, 8, client_req_id="k-sampled", seed=77,
+                          temperature=0.8, top_k=8)
+            router.router.register_replica(list(srv.address))
+            assert router.router.adopted == 2
+            hg = router.router.get_by_key("default", "k-greedy")
+            hs = router.router.get_by_key("default", "k-sampled")
+            assert hg is not None and hs is not None
+            assert hs.seed == 77 and hs.temperature == 0.8 and hs.top_k == 8
+            assert not hg.done
+        assert hg.result(timeout=30.0) == reference["greedy"]
+        assert hs.result(timeout=30.0) == reference["sampled"]
+        # a client holding a dead incarnation's request id reattaches by key
+        via = ServingClient(router.address)
+        resp = via.poll(999_999, client_req_id="k-sampled")
+        assert resp["done"] and resp["tokens"] == reference["sampled"]
+        via.close()
+        assert router.router.stats()["adopted_requests"] == 2
+    finally:
+        direct.close()
+        srv.stop()
+        router.stop()
+
+
+@pytest.mark.timeout(120)
+def test_adopted_request_fails_named_when_its_replica_dies(model_and_params):
+    """Second-failure edge: the ONLY replica holding an adopted request dies
+    before ever finishing and nobody else can take it — the request must
+    fail with the NAMED reason `replica_lost` after park_give_up_s, never
+    hang or vanish."""
+    from paddle_tpu.serving.router import RouterServer
+    from paddle_tpu.serving.scheduler import FinishReason
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    sess = warm_session(make_session(model_and_params))
+    srv = ServingServer(session=sess).start()
+    direct = ServingClient(srv.address)
+    router = RouterServer(
+        lease_s=1.0, park_give_up_s=1.0, poll_interval_s=0.02,
+        replica_client_kw={"timeout": 2.0, "retries": 1},
+    ).start()
+    gate = sess._gen_lock
+    gate.acquire()
+    try:
+        direct.submit(PROMPT, 8, client_req_id="k-doomed")
+        router.router.register_replica(list(srv.address))
+        h = router.router.get_by_key("default", "k-doomed")
+        assert h is not None and not h.done
+        srv.kill()  # the only holder dies, still wedged: nothing to adopt
+        assert _wait(lambda: h.done, 30.0), "parked request must expire"
+        assert h.finish_reason == FinishReason.REPLICA_LOST
+        with pytest.raises(RuntimeError, match="replica_lost"):
+            h.result(timeout=1.0)
+    finally:
+        gate.release()
+        direct.close()
+        router.stop()
+
+
+# -- end-to-end: router killed mid-flight, standby takes over -----------------
+
+
+def _ha_fleet(model_and_params, n, lease_s=2.0, standby_kw=None, **router_kw):
+    """Primary RouterServer + armed RouterStandby (watching it from a
+    reserved port) + n replicas carrying BOTH endpoints."""
+    from paddle_tpu.serving.router import RouterServer, RouterStandby
+    from paddle_tpu.serving.server import ServingServer
+
+    router_kw.setdefault("poll_interval_s", 0.02)
+    primary = RouterServer(lease_s=lease_s, **router_kw).start()
+    sb_port = _free_port()
+    box = {}
+    standby = RouterStandby(
+        primary.address, port=sb_port, poll_s=0.1, lease_s=lease_s,
+        **(standby_kw or {}), **router_kw,
+    )
+
+    def run():
+        box["srv"] = standby.run()
+
+    threading.Thread(target=run, daemon=True).start()
+    endpoints = [list(primary.address), ["127.0.0.1", sb_port]]
+    servers = []
+    for _ in range(n):
+        sess = warm_session(make_session(model_and_params))
+        srv = ServingServer(
+            session=sess, router_endpoints=endpoints, stall_fence_s=30.0,
+        ).start()
+        servers.append((srv, sess))
+    assert _wait(lambda: len(primary.fleet.live()) == n), "replicas must join"
+    return primary, standby, box, endpoints, servers
+
+
+@pytest.mark.timeout(240)
+def test_router_takeover_reconciles_inflight(model_and_params, reference):
+    """Kill the primary router with wedged in-flight requests (greedy AND
+    seeded-sampled): the standby takes over, replicas rotate + re-register,
+    the sweep adopts, clients' key-based reattach delivers oracle tokens
+    exactly once."""
+    from paddle_tpu.serving.server import ServingClient
+
+    primary, standby, box, endpoints, servers = _ha_fleet(model_and_params, 2)
+    gates = [sess._gen_lock for _, sess in servers]
+    for g in gates:
+        g.acquire()
+    released = False
+    results = {}
+
+    def gen(name, **kw):
+        # one client per thread: a MasterClient connection is a strict
+        # request/reply stream, so concurrent callers would desync replies
+        c = ServingClient(endpoints, timeout=3.0)
+        try:
+            results[name] = c.generate(PROMPT, 8, timeout_s=120.0, **kw)
+        finally:
+            c.close()
+
+    threads = [
+        threading.Thread(target=gen, args=("greedy",), daemon=True),
+        threading.Thread(
+            target=gen, args=("sampled",),
+            kwargs=dict(seed=77, temperature=0.8, top_k=8), daemon=True,
+        ),
+    ]
+    try:
+        before = FT_EVENTS.get("router_takeover")
+        for t in threads:
+            t.start()
+        # both requests registered on replicas (wedged: none can finish)
+        assert _wait(lambda: sum(
+            len(srv.dispatch("outstanding", {}, None)["requests"])
+            for srv, _ in servers) >= 2, 30.0)
+        primary.kill()
+        assert _wait(lambda: box.get("srv") is not None, 30.0), \
+            "standby must take over"
+        new = box["srv"]
+        assert FT_EVENTS.get("router_takeover") == before + 1
+        # replicas rotate to the standby and the sweep adopts their books
+        assert _wait(lambda: len(new.fleet.live()) == 2, 60.0)
+        assert _wait(lambda: new.router.adopted >= 1, 30.0)
+        for g in gates:
+            g.release()
+        released = True
+        for t in threads:
+            t.join(timeout=120.0)
+            assert not t.is_alive()
+        assert results["greedy"]["tokens"] == reference["greedy"]
+        assert results["sampled"]["tokens"] == reference["sampled"]
+        assert new.router.completed >= 1
+    finally:
+        if not released:
+            for g in gates:
+                g.release()
+        for srv, _ in servers:
+            srv.stop()
+        if box.get("srv") is not None:
+            box["srv"].stop()
+
+
+@pytest.mark.timeout(240)
+def test_exactly_once_across_router_and_replica_death(model_and_params,
+                                                      reference):
+    """Both control failures in one window: the router dies, the standby
+    adopts, and THEN a replica holding adopted work dies too — its requests
+    fail over to the survivor under the same key + pinned seed, so tokens
+    stay oracle-identical and each request is delivered exactly once."""
+    from paddle_tpu.serving.server import ServingClient
+
+    primary, standby, box, endpoints, servers = _ha_fleet(model_and_params, 2)
+    gates = {id(sess): sess._gen_lock for _, sess in servers}
+    for g in gates.values():
+        g.acquire()
+    released = set()
+    results = {}
+
+    def gen(name, **kw):
+        # per-thread client: MasterClient connections are not thread-safe
+        c = ServingClient(endpoints, timeout=3.0)
+        try:
+            results[name] = c.generate(PROMPT, 8, timeout_s=150.0, **kw)
+        finally:
+            c.close()
+
+    threads = [
+        threading.Thread(target=gen, args=("greedy",), daemon=True),
+        threading.Thread(
+            target=gen, args=("sampled",),
+            kwargs=dict(seed=77, temperature=0.8, top_k=8), daemon=True,
+        ),
+    ]
+    try:
+        for t in threads:
+            t.start()
+        assert _wait(lambda: sum(
+            len(srv.dispatch("outstanding", {}, None)["requests"])
+            for srv, _ in servers) >= 2, 30.0)
+        primary.kill()
+        assert _wait(lambda: box.get("srv") is not None, 30.0)
+        new = box["srv"]
+        assert _wait(lambda: len(new.fleet.live()) == 2, 60.0)
+        assert _wait(lambda: new.router.adopted >= 1, 30.0)
+        # kill whichever replica holds adopted work, still wedged — the new
+        # incarnation must fail it over to the survivor
+        with new.router._lock:
+            held = {
+                rep_id
+                for h in new.router._handles.values() if not h.done
+                for rep_id in h.assignments
+            }
+        victim_idx = next(
+            i for i, (srv, _) in enumerate(servers)
+            for r in new.fleet.replicas()
+            if r.replica_id in held
+            and tuple(r.endpoint) == tuple(srv.address)
+        )
+        victim_srv, victim_sess = servers[victim_idx]
+        victim_srv.kill()
+        # release only the SURVIVOR's wedge; the victim dies wedged
+        for i, (_, sess) in enumerate(servers):
+            if i != victim_idx:
+                gates[id(sess)].release()
+                released.add(id(sess))
+        for t in threads:
+            t.join(timeout=150.0)
+            assert not t.is_alive()
+        assert results["greedy"]["tokens"] == reference["greedy"]
+        assert results["sampled"]["tokens"] == reference["sampled"]
+    finally:
+        for _, sess in servers:
+            if id(sess) not in released:
+                gates[id(sess)].release()
+        for srv, _ in servers:
+            srv.stop()
+        if box.get("srv") is not None:
+            box["srv"].stop()
+
+
+@pytest.mark.timeout(240)
+def test_stream_reattaches_by_cursor_across_takeover(model_and_params,
+                                                     reference):
+    """A live push-stream survives its router's death: the client reattaches
+    through the standby at its delivered-token cursor (falling back to a
+    same-key re-submit if the new incarnation hasn't swept yet), and the
+    consumer sees the oracle token sequence exactly once."""
+    from paddle_tpu.serving.server import ServingClient
+
+    primary, standby, box, endpoints, servers = _ha_fleet(model_and_params, 1)
+    client = ServingClient(endpoints, timeout=3.0)
+    srv0, sess0 = servers[0]
+    gate = sess0._gen_lock
+    gate.acquire()  # wedge: the stream must still be mid-flight at the kill
+    released = False
+    got = []
+    err = []
+    done_evt = threading.Event()
+
+    def consume():
+        try:
+            for frame in client.stream(PROMPT, 8, reattach_retries=30):
+                got.extend(frame["tokens"])
+                if frame.get("done"):
+                    break
+        except Exception as e:  # surfaced by the main thread's assert
+            err.append(e)
+        finally:
+            done_evt.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    try:
+        t.start()
+        assert _wait(lambda: len(
+            srv0.dispatch("outstanding", {}, None)["requests"]) >= 1, 30.0)
+        primary.kill()
+        assert _wait(lambda: box.get("srv") is not None, 30.0)
+        new = box["srv"]
+        assert _wait(lambda: len(new.fleet.live()) == 1, 60.0)
+        gate.release()
+        released = True
+        assert done_evt.wait(120.0), "stream consumer must finish"
+        assert not err, f"stream consumer raised: {err!r}"
+        assert got == reference["greedy"], \
+            "reattached stream must deliver every token exactly once"
+        assert client.stream_reattaches >= 1
+    finally:
+        if not released:
+            gate.release()
+        client.close()
+        for srv, _ in servers:
+            srv.stop()
+        if box.get("srv") is not None:
+            box["srv"].stop()
+
+
+# -- client endpoint lists ----------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_client_endpoint_list_fails_over(model_and_params, reference):
+    from paddle_tpu.serving.server import ServingClient, ServingServer
+
+    sess = warm_session(make_session(model_and_params))
+    srv = ServingServer(session=sess).start()
+    client = ServingClient(
+        [("127.0.0.1", _free_port()), tuple(srv.address)], timeout=2.0,
+    )
+    try:
+        out = client.generate(PROMPT, 8)
+        assert out["tokens"] == reference["greedy"]
+    finally:
+        client.close()
+        srv.stop()
+
+
+# -- autoscaler standby -------------------------------------------------------
+
+
+class _StubStats:
+    """Minimal .call/.close endpoint stand-in for controller observation."""
+
+    def call(self, method, **kw):
+        return {"replicas": [], "estimated_queue_wait_s": 0.0, "shed": 0}
+
+    def close(self):
+        pass
+
+
+@pytest.mark.timeout(60)
+def test_autoscaler_standby_takes_over_on_controller_kill():
+    from paddle_tpu.runtime.autoscaler import (
+        AutoscalerController, AutoscalerStandby,
+    )
+
+    before = FT_EVENTS.get("autoscaler_takeover")
+    with faults.inject("controller_kill:step=3"):
+        ctl = AutoscalerController(
+            router_client=_StubStats(), tick_s=0.05, liveness_port=0,
+        ).start()
+        assert ctl.liveness_address is not None
+        box = {}
+        standby = AutoscalerStandby(
+            ctl.liveness_address,
+            lambda: AutoscalerController(router_client=_StubStats(),
+                                         tick_s=0.05),
+            poll_s=0.05,
+        )
+
+        def run():
+            box["ctl"] = standby.run()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        assert t.is_alive(), "standby must not usurp a live controller"
+        # the seeded chaos site kills the reconcile loop; the liveness port
+        # drops with it and the standby takes over with zero extra state
+        assert _wait(lambda: ctl.dead, 15.0)
+        t.join(timeout=20.0)
+        assert not t.is_alive() and box.get("ctl") is not None
+    new = box["ctl"]
+    try:
+        assert new.alive and len(new.instance) == 8
+        assert new.instance != ctl.instance, "per-incarnation identity"
+        assert FT_EVENTS.get("autoscaler_takeover") == before + 1
+        assert _wait(lambda: new.ticks >= 2, 15.0), "new controller ticks"
+    finally:
+        new.stop()
+        ctl.stop()
+
+
+@pytest.mark.timeout(60)
+def test_autoscaler_stop_drops_liveness_port():
+    from paddle_tpu.runtime.autoscaler import AutoscalerController
+
+    ctl = AutoscalerController(
+        router_client=_StubStats(), tick_s=0.05, liveness_port=0,
+    ).start()
+    addr = ctl.liveness_address
+    socket.create_connection(addr, timeout=2.0).close()  # probe-able while up
+    ctl.stop()
+    with pytest.raises(OSError):
+        socket.create_connection(addr, timeout=2.0).close()
+
+
+# -- CLI standby roles (subprocess; nightly tier) -----------------------------
+
+
+@pytest.mark.nightly
+@pytest.mark.timeout(180)
+def test_cli_standby_roles_exit_3_without_takeover():
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    primary = "127.0.0.1:%d" % lst.getsockname()[1]
+    try:
+        for mod, extra in (
+            ("paddle_tpu.serving.router", []),
+            ("paddle_tpu.runtime.autoscaler", ["--router", primary]),
+        ):
+            proc = subprocess.run(
+                [sys.executable, "-m", mod, "standby", "--primary", primary,
+                 "--max_wait_s", "1.0", "--poll_s", "0.2", *extra],
+                capture_output=True, text=True, timeout=120, env=env,
+            )
+            assert proc.returncode == 3, proc.stderr
+            out = json.loads(proc.stdout.strip().splitlines()[-1])
+            assert out["takeover"] is False
+    finally:
+        lst.close()

@@ -266,11 +266,14 @@ CLOCK_HOT_LOOPS = [
     # stamp deadlines/hedge/park all derive from), one per pump cycle, one
     # per reaper tick, and the per-EVENT stamps (eviction, failover batch,
     # cancel, drain order, the evicted pump's grace check) — never one per
-    # request per cycle
+    # request per cycle. ISSUE 18 adds the takeover sweep (register_replica
+    # / _sweep_replica): one stamp per REGISTRATION EVENT covering the
+    # whole adopted batch.
     (ROUTER_PY, "Router",
      ("submit", "cancel", "drain", "_evict", "_failover_requests",
       "_try_assign", "_choose_replica", "_forward", "_on_result",
-      "_pump_loop", "_pump_once", "_reap_once"), 8),
+      "_pump_loop", "_pump_once", "_reap_once", "register_replica",
+      "_sweep_replica"), 9),
 ]
 
 
@@ -452,10 +455,17 @@ def test_updater_reshard_sites_tagged_and_pinned():
 RPC_CALL = re.compile(r"\.call\(")
 RPC_TAG = "rpc-ok"
 # (file, class, dispatch-path methods, max rpc-ok tags)
+#
+# ISSUE 18 adds the takeover sweep to the pinned surface: register_replica /
+# _sweep_replica make exactly ONE `outstanding` call per replica
+# REGISTRATION EVENT (rebuilding the in-flight books after a router
+# takeover) — pinned here so the sweep can never creep into the pump or
+# dispatch cycles.
 ROUTER_RPC_LOOPS = [
     (ROUTER_PY, "Router",
      ("submit", "_try_assign", "_choose_replica", "_forward", "_pump_once",
-      "_on_result", "_reap_once", "_failover_requests", "_send_cancels"), 3),
+      "_on_result", "_reap_once", "_failover_requests", "_send_cancels",
+      "register_replica", "_sweep_replica"), 4),
 ]
 
 
@@ -652,6 +662,80 @@ def test_scale_decider_is_pure():
                 "effect to the controller's observe/actuate phases:\n  "
                 + "\n  ".join(v)
             )
+
+
+# -- election loop + takeover sweep (ISSUE 18 control-plane HA) ---------------
+#
+# The standby watcher (runtime/election.py) is deliberately dumb: raw TCP
+# connect probes, NO RPC protocol — so a standby can watch anything that
+# listens and a wedged primary's RPC layer can't wedge its own watcher. Its
+# entire clock footprint is the max_wait_s deadline (one stamp per watch,
+# one expiry check per poll_s-paced cycle). An untagged `.call(` appearing
+# in the watcher would mean election grew a protocol dependency; a new
+# clock read would mean a second pacing source.
+
+ELECTION_PY = os.path.join(_REPO, "paddle_tpu", "runtime", "election.py")
+ELECTION_RPC_LOOPS = [
+    (ELECTION_PY, "StandbyWatcher", ("wait_for_takeover", "_probe_once"), 0),
+]
+ELECTION_CLOCK_LOOPS = [
+    (ELECTION_PY, "StandbyWatcher", ("wait_for_takeover", "_probe_once"), 2),
+]
+
+
+def test_election_watcher_probes_without_rpc():
+    """The election loop holds zero rpc-ok tags: probes are raw socket
+    connects (protocol-free on purpose), never MasterClient calls."""
+    for path, cls, methods, budget in ELECTION_RPC_LOOPS:
+        violations, tagged = _scan(path, cls, methods, RPC_CALL, tag=RPC_TAG)
+        assert not violations and len(tagged) <= budget, (
+            "RPC call inside the election watcher — the probe loop must "
+            "stay protocol-free (a raw TCP connect) so it can watch any "
+            "listener and can't be wedged by a wedged RPC layer:\n  "
+            + "\n  ".join(violations)
+        )
+
+
+def test_election_watcher_clock_sites_pinned():
+    """Two tagged clock sites in the watcher (the max_wait_s stamp and its
+    per-cycle expiry check); pacing itself rides time.sleep(poll_s)."""
+    for path, cls, methods, budget in ELECTION_CLOCK_LOOPS:
+        violations, tagged = _scan(path, cls, methods, CLOCK_CALL,
+                                   tag=CLOCK_TAG)
+        assert not violations, (
+            "untagged wall-clock read in the election watcher:\n  "
+            + "\n  ".join(violations)
+        )
+        assert len(tagged) <= budget, (
+            f"{len(tagged)} clock-ok tags in the {cls} loop (expected <= "
+            f"{budget}): the watcher needs only the deadline stamp + check"
+        )
+
+
+def test_takeover_sweep_stays_out_of_pump_and_dispatch():
+    """The takeover sweep runs once per replica REGISTRATION EVENT — never
+    inside the pump/reap/assignment cycles. Pin the separation textually:
+    the hot cycle bodies must not mention the sweep or its RPC method, so
+    'just re-sweep every cycle' can't land without tripping this."""
+    with open(ROUTER_PY) as f:
+        source = f.read()
+    spans = _hot_spans(
+        ast.parse(source), "Router",
+        ("_pump_once", "_reap_once", "_try_assign", "_forward",
+         "_on_result"),
+    )
+    lines = source.splitlines()
+    offenders = []
+    for name, lo, hi in spans:
+        body = "\n".join(lines[lo - 1:hi])
+        for needle in ("_sweep_replica", '"outstanding"', "'outstanding'"):
+            if needle in body:
+                offenders.append(f"Router.{name}: contains {needle}")
+    assert not offenders, (
+        "takeover sweep reached a hot cycle body — reconciliation is a "
+        "once-per-registration cold path (register_replica), not per-cycle "
+        "work:\n  " + "\n  ".join(offenders)
+    )
 
 
 def test_frame_encoding_only_in_handler_push_loop():
