@@ -39,6 +39,14 @@ The streaming leg pushes the same requests through the router both ways
 (poll loop vs push frames) at 1/16/64 streams: gate is push round trips
 per delivered token strictly below poll at every count.
 
+The prefix-cache leg (ISSUE 19) serves 4 system prompts x many user turns
+that differ only in a short suffix, cache on vs off: gates are prefill
+chunk steps AND warm-request TTFT both >= 3x down with the cache on,
+tokens bitwise identical on vs off (greedy and seeded sampling, chunked
+and whole-prompt prefill), zero page leaks after the index flush, and one
+compiled decode signature in every leg (aliasing is a host-side
+block-table edit — the executables never see the cache).
+
 The --replicas leg (ISSUE 15) serves identical geometry through the ROUTER
 at 1 vs 3 replicas, 64 closed-loop streams: tokens/sec + p99, gate >= 2x
 throughput at 3 replicas — armed only on hosts with >= 3 cores (replica
@@ -313,6 +321,7 @@ def run_speculative(args):
             "verify_shape_signatures": st["verify_shape_signatures"],
             "spec_rounds": st["spec_rounds"],
             "spec_acceptance_rate": st["spec_acceptance_rate"],
+            "spec_effective_k": st["spec_effective_k"],
         })
         return res, tokens
 
@@ -350,7 +359,157 @@ def run_speculative(args):
         f"{spec['tokens_per_sec']} tok/s vs {base['tokens_per_sec']} "
         f"(x{out['single_stream_speedup']}) acceptance="
         f"{spec['spec_acceptance_rate']} rounds={spec['spec_rounds']} "
+        f"k_eff={spec['spec_effective_k']} "
         f"identical={out['spec_tokens_identical']}",
+        file=sys.stderr,
+    )
+    return out
+
+
+def run_prefix(args):
+    """The shared-prefix KV-cache leg (ISSUE 19): `--prefix_prefixes`
+    distinct system prompts, each shared by many user turns that differ only
+    in a short random suffix — the many-users-one-assistant regime where a
+    million users' prompts are mostly the SAME tokens. Five runs over
+    identical geometry and prompts, driven sequentially (one request in
+    flight: TTFT is then pure prefill cost, the number the cache attacks):
+
+      A  cache OFF, chunked prefill  (the steps/TTFT baseline)
+      B  cache ON,  chunked prefill  (the measured leg)
+      C  cache OFF, whole-prompt     (chunk-vs-whole transparency anchor)
+      D  cache OFF, chunked, seeded sampling
+      E  cache ON,  chunked, seeded sampling
+
+    Gates:
+      * prefill chunk steps in B <= 1/Kx of A (warm requests start their one
+        chunk at the first uncached token) and warm-request median TTFT down
+        by the same >= Kx (K = --prefix_gate_x, default 3)
+      * tokens bitwise IDENTICAL: B == A == C (greedy) and E == D (seeded
+        sampling) — aliased pages hold exactly the KV the request would have
+        computed, under chunked AND whole-prompt prefill
+      * zero page leaks: after the run retires every request and the index
+        is flushed, every allocatable page is back on the free list
+      * decode_shape_signatures == 1 in every leg — the cache is a
+        host-side block-table edit, invisible to the compiled programs"""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.serving.session import make_demo_session
+    from paddle_tpu.serving.workload import make_shared_prefix_prompts
+
+    plen = args.prefix_len + args.prefix_suffix
+    prompts = make_shared_prefix_prompts(
+        args.prefix_requests, n_prefixes=args.prefix_prefixes,
+        prefix_len=args.prefix_len, suffix_len=args.prefix_suffix,
+        vocab=args.vocab, bos_id=1, seed=5,
+    )
+    warm_cold = args.prefix_prefixes  # first turn per prefix runs cold
+
+    def leg(prefix_on, temp, chunked=True):
+        session = make_demo_session(
+            vocab=args.vocab, n_layers=args.n_layers, d_model=args.d_model,
+            n_heads=args.n_heads, seed=0,
+            max_slots=4, page_size=args.prefix_page_size,
+            prefill_buckets=(16, plen), max_new_limit=args.prefix_max_new,
+            prefill_chunk=(args.prefix_chunk if chunked else None),
+            prefix_cache=prefix_on,
+        )
+        # warmup compiles the chunk/prefill + decode programs; the flush
+        # below guarantees the measured run still starts with a COLD index
+        wp = [1] + list(range(3, 3 + plen - 1))
+        h = session.submit(wp, args.prefix_max_new)
+        session.run_until_idle()
+        assert h.done
+        if prefix_on:
+            session.cache.flush_prefix()
+        sigs0 = session.decode_shape_signatures()
+        chunks0 = session.stats()["prefill_chunks_committed"]
+        ttfts, toks = [], []
+        for i, p in enumerate(prompts):
+            kw = (
+                dict(temperature=temp, top_k=8, seed=1000 + i)
+                if temp > 0 else {}
+            )
+            h = session.submit(p, args.prefix_max_new, **kw)
+            session.run_until_idle()
+            ttfts.append((h.t_first_token - h.t_submit) * 1e3)
+            toks.append(h.tokens)
+        st = session.stats()
+        leaked = 0
+        if prefix_on:
+            session.cache.flush_prefix()
+        leaked = (session.cache.num_pages - 1) - session.cache.free_pages
+        res = {
+            "platform": jax.devices()[0].platform,
+            "prefix_cache": prefix_on,
+            "chunked": chunked,
+            "temperature": temp,
+            "prefill_chunk_steps": st["prefill_chunks_committed"] - chunks0,
+            "ttft_warm_median_ms": round(
+                float(np.median(ttfts[warm_cold:])), 3),
+            "ttft_cold_median_ms": round(
+                float(np.median(ttfts[:warm_cold])), 3),
+            "decode_recompiles_after_warmup":
+                session.decode_shape_signatures() - sigs0,
+            "decode_shape_signatures": session.decode_shape_signatures(),
+            "pages_leaked": leaked,
+        }
+        if prefix_on:
+            res.update({
+                "prefix_hit_rate": st["prefix_hit_rate"],
+                "prefix_pages_shared": st["prefix_pages_shared"],
+                "prefix_pages_cow": st["prefix_pages_cow"],
+                "prefix_evictions": st["prefix_evictions"],
+            })
+        return res, toks
+
+    base, base_toks = leg(False, 0.0)            # A
+    cached, cached_toks = leg(True, 0.0)         # B
+    whole, whole_toks = leg(False, 0.0, chunked=False)  # C
+    sbase, sbase_toks = leg(False, 0.7)          # D
+    scached, scached_toks = leg(True, 0.7)       # E
+
+    steps_ratio = (
+        base["prefill_chunk_steps"] / cached["prefill_chunk_steps"]
+        if cached["prefill_chunk_steps"] else 0.0
+    )
+    ttft_ratio = (
+        base["ttft_warm_median_ms"] / cached["ttft_warm_median_ms"]
+        if cached["ttft_warm_median_ms"] else 0.0
+    )
+    out = {
+        "baseline": base,
+        "cached": cached,
+        "whole_prompt": whole,
+        "sampled_baseline": sbase,
+        "sampled_cached": scached,
+        "prefill_steps_ratio": round(steps_ratio, 2),
+        "ttft_warm_ratio": round(ttft_ratio, 2),
+        "prefix_steps_ge_gate": bool(steps_ratio >= args.prefix_gate_x),
+        "prefix_ttft_ge_gate": bool(ttft_ratio >= args.prefix_gate_x),
+        "prefix_tokens_identical": bool(
+            cached_toks == base_toks and whole_toks == base_toks
+        ),
+        "prefix_sampled_tokens_identical": bool(scached_toks == sbase_toks),
+        "prefix_zero_page_leak": bool(
+            cached["pages_leaked"] == 0 and scached["pages_leaked"] == 0
+            and base["pages_leaked"] == 0
+        ),
+        "prefix_one_decode_signature": bool(all(
+            r["decode_shape_signatures"] == 1
+            and r["decode_recompiles_after_warmup"] == 0
+            for r in (base, cached, whole, sbase, scached)
+        )),
+    }
+    print(
+        f"[serving_bench] prefix: steps {base['prefill_chunk_steps']} -> "
+        f"{cached['prefill_chunk_steps']} (x{out['prefill_steps_ratio']}) "
+        f"ttft_warm {base['ttft_warm_median_ms']}ms -> "
+        f"{cached['ttft_warm_median_ms']}ms (x{out['ttft_warm_ratio']}) "
+        f"hit_rate={cached['prefix_hit_rate']} "
+        f"identical={out['prefix_tokens_identical']}/"
+        f"{out['prefix_sampled_tokens_identical']} "
+        f"leaked={cached['pages_leaked']}",
         file=sys.stderr,
     )
     return out
@@ -851,6 +1010,26 @@ def main():
                          "compared (filters host noise out of the ratio)")
     ap.add_argument("--skip_spec", action="store_true",
                     help="skip the single-stream speculative-decoding leg")
+    ap.add_argument("--prefix_requests", type=int, default=24,
+                    help="user turns in the shared-prefix leg (ISSUE 19)")
+    ap.add_argument("--prefix_prefixes", type=int, default=4,
+                    help="distinct system prompts the turns cycle over")
+    ap.add_argument("--prefix_len", type=int, default=56,
+                    help="shared system-prompt length in tokens")
+    ap.add_argument("--prefix_suffix", type=int, default=8,
+                    help="per-user unique suffix length in tokens")
+    ap.add_argument("--prefix_chunk", type=int, default=8,
+                    help="prefill chunk for the prefix leg (a warm request "
+                         "pays ONE chunk: its own suffix)")
+    ap.add_argument("--prefix_page_size", type=int, default=8,
+                    help="KV page size for the prefix leg (the aliasing "
+                         "granularity)")
+    ap.add_argument("--prefix_max_new", type=int, default=8)
+    ap.add_argument("--prefix_gate_x", type=float, default=3.0,
+                    help="required prefill-steps AND warm-TTFT reduction "
+                         "factor, cache on vs off")
+    ap.add_argument("--skip_prefix", action="store_true",
+                    help="skip the shared-prefix KV-cache leg")
     ap.add_argument("--stream_counts", default="1,16,64",
                     help="stream counts for the push-vs-poll round-trips "
                          "leg; empty string skips")
@@ -910,6 +1089,7 @@ def main():
         None if (args.skip_spec or args.speculate_k <= 0)
         else run_speculative(args)
     )
+    prefix = None if args.skip_prefix else run_prefix(args)
     streaming = (
         None if (args.skip_streaming or not args.stream_counts.strip())
         else run_streaming(args)
@@ -949,6 +1129,26 @@ def main():
               and spec["spec_tokens_identical"]
               and spec["spec_one_verify_signature"]
               and spec["spec_zero_decode_recompiles"])
+    if prefix is not None:
+        gates["prefix_prefill_steps_ratio"] = prefix["prefill_steps_ratio"]
+        gates["prefix_ttft_warm_ratio"] = prefix["ttft_warm_ratio"]
+        gates["prefix_steps_ge_gate"] = prefix["prefix_steps_ge_gate"]
+        gates["prefix_ttft_ge_gate"] = prefix["prefix_ttft_ge_gate"]
+        gates["prefix_tokens_identical"] = prefix["prefix_tokens_identical"]
+        gates["prefix_sampled_tokens_identical"] = (
+            prefix["prefix_sampled_tokens_identical"]
+        )
+        gates["prefix_zero_page_leak"] = prefix["prefix_zero_page_leak"]
+        gates["prefix_one_decode_signature"] = (
+            prefix["prefix_one_decode_signature"]
+        )
+        gates["prefix_hit_rate"] = prefix["cached"]["prefix_hit_rate"]
+        ok = (ok and prefix["prefix_steps_ge_gate"]
+              and prefix["prefix_ttft_ge_gate"]
+              and prefix["prefix_tokens_identical"]
+              and prefix["prefix_sampled_tokens_identical"]
+              and prefix["prefix_zero_page_leak"]
+              and prefix["prefix_one_decode_signature"])
     if streaming is not None:
         gates["push_round_trips_below_poll_all"] = (
             streaming["push_round_trips_below_poll_all"]
@@ -977,6 +1177,7 @@ def main():
         "results": results,
         "mixed_length": mixed,
         "speculative": spec,
+        "prefix_cache": prefix,
         "streaming": streaming,
         "tensor_parallel": tp,
         "router_replicas": replicas,

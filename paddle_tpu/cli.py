@@ -881,6 +881,19 @@ def _serve_args(p: argparse.ArgumentParser) -> None:
                         "step; tokens are identical to --speculate_k 0 "
                         "(greedy AND seeded sampling: acceptance replays "
                         "through the per-emitted-token key fold)")
+    p.add_argument("--prefix_cache", action="store_true",
+                   help="shared-prefix KV cache (needs --prefill_chunk): "
+                        "committed prompt pages index by tenant-namespaced "
+                        "token hash at page granularity; a new request "
+                        "aliases its cached prefix pages read-only "
+                        "(refcounted, copy-on-write at the first divergent "
+                        "page) and prefills only its own suffix — tokens "
+                        "stay bitwise-identical to cache-off, TTFT drops by "
+                        "the shared fraction")
+    p.add_argument("--prefix_cache_pages", type=int, default=0,
+                   help="cap on cached prefix pages (0 = bounded only by "
+                        "the pool; unreferenced cached pages LRU-evict "
+                        "under pool pressure either way)")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="default sampling temperature for requests that do "
                         "not set one (0 = greedy argmax); sampling is "
@@ -1012,6 +1025,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
             num_pages=args.num_pages or None,
             prefill_buckets=buckets,
             prefill_chunk=args.prefill_chunk or None,
+            prefix_cache=args.prefix_cache,
+            prefix_cache_pages=args.prefix_cache_pages or None,
             speculate_k=args.speculate_k,
             default_temperature=args.temperature,
             default_top_k=args.top_k,

@@ -36,6 +36,9 @@ __all__ = [
     "observe_deadline_miss",
     "observe_engine_restart",
     "observe_pages_recycled",
+    "observe_prefix_cow",
+    "observe_prefix_evictions",
+    "observe_prefix_hit",
     "observe_shed",
     "snapshot",
     "to_prometheus_text",
@@ -292,6 +295,34 @@ def observe_pages_recycled(n: int) -> None:
     REGISTRY.counter(
         "paddle_tpu_serving_pages_recycled_on_cancel_total",
         "KV pages recycled from cancelled (not normally retired) requests",
+    ).inc(n)
+
+
+def observe_prefix_hit(pages: int) -> None:
+    """An admission aliased `pages` cached prefix pages into a new slot's
+    block table (ISSUE 19) — each page is prefill work the request skipped."""
+    REGISTRY.counter(
+        "paddle_tpu_serving_prefix_pages_shared_total",
+        "KV pages aliased from the shared-prefix cache into new slots",
+    ).inc(pages)
+
+
+def observe_prefix_cow(n: int) -> None:
+    """Prefix lookups that stopped at a genuine divergence (the chain had
+    cached continuations, just not this prompt's) — the copy-on-write
+    boundary where the request switches to a private page."""
+    REGISTRY.counter(
+        "paddle_tpu_serving_prefix_cow_total",
+        "prefix-cache lookups ending at a copy-on-write divergence",
+    ).inc(n)
+
+
+def observe_prefix_evictions(n: int) -> None:
+    """Unreferenced cached prefix pages LRU-evicted — under pool pressure at
+    reserve time, or by the --prefix_cache_pages cap at registration."""
+    REGISTRY.counter(
+        "paddle_tpu_serving_prefix_evictions_total",
+        "prefix-cache pages evicted (pool pressure or cache-size cap)",
     ).inc(n)
 
 

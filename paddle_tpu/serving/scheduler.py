@@ -116,6 +116,12 @@ class RequestHandle:
         # TTFT histogram/miss-counter latch: a crash-replayed request gets a
         # fresh t_first_token but must be OBSERVED exactly once (session._admit)
         self.ttft_observed = False
+        # prefix-cache admission-pricing hint (ISSUE 19): leading prompt
+        # tokens the cache held at SUBMIT time (read-only peek). Load
+        # estimates price this request's prefill by its uncached suffix;
+        # the authoritative hit is re-measured at reservation (ActiveSeq
+        # .prefix_hit) — the cache may have warmed or evicted meanwhile.
+        self.prefix_hint = 0
         self._event = threading.Event()
 
     @property
@@ -176,7 +182,7 @@ class ActiveSeq:
     already-decoding slots."""
 
     __slots__ = ("handle", "prompt", "last_token", "next_pos", "generated",
-                 "t_started", "prefill_pos", "engine_steps")
+                 "t_started", "prefill_pos", "engine_steps", "prefix_hit")
 
     def __init__(self, handle: RequestHandle, prompt: List[int]):
         self.handle = handle
@@ -186,6 +192,11 @@ class ActiveSeq:
         self.generated: int = 0
         self.t_started: Optional[float] = None  # set at admission
         self.prefill_pos: int = len(prompt)  # chunked path resets to 0
+        # prompt tokens aliased from the prefix cache at reservation
+        # (ISSUE 19): the session starts this slot's chunked prefill HERE —
+        # the aliased pages' KV is already committed — and the retire-time
+        # EWMA prices the prefill by the remaining suffix only
+        self.prefix_hit: int = 0
         # engine steps this sequence actually consumed (one per decode step
         # it rode, one per verify round): with speculative decoding emitting
         # >1 token per step, `generated` stops being a step count — the
@@ -290,6 +301,11 @@ class Scheduler:
         the context has to be on the handle BEFORE it becomes visible."""
         prompt = [int(t) for t in prompt]
         total = len(prompt) + max_new_tokens
+        # prefix-cache pricing peek (ISSUE 19): how much of this prompt's
+        # prefill is already cached RIGHT NOW. Read-only (no recency bump) —
+        # the ONE sanctioned admission-path hash computation (lint-pinned);
+        # 0 with the cache off, so estimates are bitwise the old ones.
+        cached = self.cache.peek_hit_tokens(tenant, prompt)
         with self.lock:
             if len(self.waiting) >= self.max_queue:
                 self.rejected += 1
@@ -309,7 +325,7 @@ class Scheduler:
                         f"admission", "deadline",
                         retry_after_ms=self._retry_hint_ms(total, len(prompt)),
                     )
-                est = self._estimate_wait_s(total, len(prompt))
+                est = self._estimate_wait_s(total, len(prompt), cached)
                 if est > deadline_s:
                     self.rejected += 1
                     self.shed += 1
@@ -326,7 +342,8 @@ class Scheduler:
             # queue wait + prefill, and the contract is "counted, not fatal"
             # — an already-expired TTFT budget just counts a miss later)
             if ttft_deadline_s is not None and ttft_deadline_s > 0:
-                est_ttft = self._estimate_ttft_wait_s(total, len(prompt))
+                est_ttft = self._estimate_ttft_wait_s(total, len(prompt),
+                                                      cached)
                 if est_ttft > ttft_deadline_s:
                     self.rejected += 1
                     self.shed += 1
@@ -350,28 +367,38 @@ class Scheduler:
             )
             handle.trace_ctx = trace_ctx
             handle._scheduler = self
+            handle.prefix_hint = cached
             self.waiting.append(_Waiting(handle, prompt))
             return handle
 
     # -- load estimate ------------------------------------------------------
-    def _chunk_steps(self, prompt_len: int) -> int:
+    def _chunk_steps(self, prompt_len: int, cached: int = 0) -> int:
         """Chunk-budget engine steps a prompt's prefill costs: ceil(len/C)
         when it routes to the chunked path (longer than one chunk, or longer
         than every bucket — ServingSession._chunked_prompt's rule), else 0
         (whole-prompt prefill rides its admission boundary). The SAME count
         prices a queued prompt and, via remaining-token ceil, one already
-        mid-prefill — so the estimate never jumps across admission."""
+        mid-prefill — so the estimate never jumps across admission.
+
+        `cached` is the prompt's prefix-cache hit (ISSUE 19): a hit routes
+        through the chunked path starting at the first un-cached token, so
+        the request is priced by its SUFFIX — ceil((len - cached)/C) — which
+        is exactly the engine steps its prefill will actually occupy. The
+        floor of one step keeps a fully-page-matched prompt priced at its
+        final (always recomputed) chunk."""
         c = self.prefill_chunk
         if c is None:
             return 0
-        routed_chunked = prompt_len > c or (
+        cached = min(max(0, int(cached)), max(0, prompt_len - 1))
+        routed_chunked = cached > 0 or prompt_len > c or (
             self.largest_bucket is not None and prompt_len > self.largest_bucket
         )
         if not routed_chunked:
             return 0
-        return -(-int(prompt_len) // c)
+        return -(-int(prompt_len - cached) // c)
 
-    def _estimate_wait_s(self, total_len: int, prompt_len: int = 0) -> float:
+    def _estimate_wait_s(self, total_len: int, prompt_len: int = 0,
+                         cached: int = 0) -> float:
         """Expected time for a request of `total_len` tokens to COMPLETE
         (queue wait + its own service), under self.lock — what a deadline
         budget must cover. The queue drains in waves of up to max_slots
@@ -400,9 +427,16 @@ class Scheduler:
             -(-(len(a.prompt) - a.prefill_pos) // c)
             for a in self.slots if a is not None and a.prefilling
         )
+        # queued prompts price by their uncached suffix (the submit-time
+        # peek on the handle); mid-prefill slots auto-correct below — a
+        # prefix hit started prefill_pos at the hit, so the remaining-token
+        # ceil already charges only the suffix
         chunk_cost = step_s * (
-            self._chunk_steps(prompt_len)
-            + sum(self._chunk_steps(w.handle.prompt_len) for w in self.waiting)
+            self._chunk_steps(prompt_len, cached)
+            + sum(
+                self._chunk_steps(w.handle.prompt_len, w.handle.prefix_hint)
+                for w in self.waiting
+            )
             + in_flight_chunks
         )
         if depth == 0 and fits_now:
@@ -412,7 +446,8 @@ class Scheduler:
             waves += 1.0
         return waves * svc + chunk_cost
 
-    def _estimate_ttft_wait_s(self, total_len: int, prompt_len: int = 0) -> float:
+    def _estimate_ttft_wait_s(self, total_len: int, prompt_len: int = 0,
+                              cached: int = 0) -> float:
         """Expected wait until the FIRST token (under self.lock): the
         completion estimate minus the request's own decode wave — the
         queue-drain time ahead of it plus its OWN prefill chunks (a chunked
@@ -421,7 +456,9 @@ class Scheduler:
         svc = self._ewma_service_s
         if svc is None:
             return 0.0
-        return max(0.0, self._estimate_wait_s(total_len, prompt_len) - svc)
+        return max(
+            0.0, self._estimate_wait_s(total_len, prompt_len, cached) - svc
+        )
 
     def _retry_hint_ms(self, total_len: int, prompt_len: int = 0) -> int:
         # under self.lock; the hint is "when could this plausibly fit":
@@ -578,8 +615,15 @@ class Scheduler:
                 if not self.cache.can_reserve(total):
                     break  # FIFO: do not starve the head by skipping it
                 self.waiting.popleft()
-                self.cache.reserve(slot, total)
+                # tenant+prompt let the cache alias this prompt's cached
+                # prefix pages into the slot (no-op with the cache off);
+                # the AUTHORITATIVE hit lands on the ActiveSeq — the session
+                # starts chunked prefill at exactly this offset
+                self.cache.reserve(
+                    slot, total, tenant=w.handle.tenant, prompt=w.prompt
+                )
                 act = ActiveSeq(w.handle, w.prompt)
+                act.prefix_hit = self.cache.hit_tokens(slot)
                 act.t_started = now
                 act.handle.status = RequestHandle.RUNNING
                 self.slots[slot] = act
@@ -613,7 +657,11 @@ class Scheduler:
             occupied = act.engine_steps + 1
         else:
             occupied = act.generated
-        steps = max(1, occupied + self._chunk_steps(act.handle.prompt_len))
+        # suffix pricing (ISSUE 19): the chunks this request ACTUALLY ran —
+        # a prefix hit skipped the cached pages entirely, so the EWMA must
+        # not learn phantom whole-prompt steps off cache-hit retirements
+        steps = max(1, occupied + self._chunk_steps(act.handle.prompt_len,
+                                                    act.prefix_hit))
         with self.lock:
             a = self.SERVICE_EWMA_ALPHA
             self._ewma_service_s = (

@@ -109,6 +109,8 @@ class ServingSession:
         default_temperature: float = 0.0,
         default_top_k: int = 0,
         speculate_k: int = 0,
+        prefix_cache: bool = False,
+        prefix_cache_pages: Optional[int] = None,
     ):
         import jax
 
@@ -141,6 +143,18 @@ class ServingSession:
         # 0 (the default) compiles nothing extra and takes exactly today's
         # code path — `--speculate_k 0` bitwise-recovers PR-15 behavior.
         self.speculate_k = max(0, int(speculate_k))
+        # shared-prefix cache (ISSUE 19): cached prompt pages alias into new
+        # slots read-only and the chunked prefill starts at the first
+        # un-cached token — which is why the cache REQUIRES chunked prefill
+        # (the whole-prompt executables have no notion of a partial start).
+        # Purely host-side block-table state: zero new executables, decode
+        # signature stays 1, and it rides TP's replicated-table dispatch.
+        self.prefix_cache = bool(prefix_cache)
+        if self.prefix_cache and self.prefill_chunk is None:
+            raise ValueError(
+                "prefix_cache requires prefill_chunk: cache hits resume "
+                "prefill mid-prompt, which only the chunked path can do"
+            )
         # per-seq page budget covers the verify chunk's K-token overshoot
         pages_per_seq = -(-(max_ctx + self.speculate_k) // page_size)
         if num_pages is None:
@@ -156,6 +170,8 @@ class ServingSession:
             # kv_heads over the mesh 'model' axis under TP (~1/TP pool bytes
             # per chip); the cache re-applies it on crash-recovery re-init
             pool_sharding=model.pool_sharding(),
+            prefix_cache=self.prefix_cache,
+            prefix_cache_pages=prefix_cache_pages,
         )
         self.scheduler = Scheduler(
             self.cache, max_queue=max_queue, quotas=quotas,
@@ -214,6 +230,9 @@ class ServingSession:
         self.spec_tokens_drafted = 0
         self.spec_tokens_accepted = 0
         self.spec_pages_trimmed = 0
+        # adaptive-K telemetry: sum of the effective draft length actually
+        # used per round — spec_effective_k = sum / rounds
+        self.spec_k_eff_sum = 0
         # per-slot prompt-lookup drafters, keyed (slot -> (request_id,
         # drafter)); lazily built, dropped at retirement / engine recovery
         self._drafters: Dict[int, tuple] = {}
@@ -412,10 +431,15 @@ class ServingSession:
                 trace_id=ctx and ctx.get("t"), parent_id=ctx and ctx.get("s"),
                 attrs={"request_id": h.request_id},
             )
-            if self._chunked_prompt(act.prompt):
-                # chunked path: nothing committed yet; _prefill_chunks
-                # advances this slot one chunk per engine step from here on
-                act.prefill_pos = 0
+            if act.prefix_hit or self._chunked_prompt(act.prompt):
+                # chunked path: _prefill_chunks advances this slot one chunk
+                # per engine step from here on. A prefix-cache hit ALWAYS
+                # routes here, starting at the first un-cached token — the
+                # aliased pages' KV is already committed, so the hit tokens
+                # are prefill work this request simply never does (the page-
+                # alignment cap guarantees >= 1 suffix token remains, so the
+                # final chunk still emits the sampled first token)
+                act.prefill_pos = act.prefix_hit
                 continue
             bucket = _bucket_for(self.buckets, len(act.prompt))
             seeds, temps, top_ks = self._sampling_row(h)
@@ -441,6 +465,10 @@ class ServingSession:
                     # one tiny host fetch per ADMISSION (not per decode step):
                     # the prompt's first token — sampled on device
                     act.append(int(first_tok[0]))
+            # the whole prompt is committed: register its full pages into
+            # the tenant's prefix chain (no-op with the cache off)
+            self.cache.commit_prefix(slot, h.tenant, act.prompt,
+                                     len(act.prompt))
             # time-to-first-token: prefill emits the first sampled token, so
             # TTFT completes here — span under the request trace + histogram
             self._observe_ttft(h, ctx)
@@ -498,6 +526,13 @@ class ServingSession:
                         starts, lengths, rows, seeds, temps, top_ks,
                     )
             act.prefill_pos = min(start + c, len(act.prompt))
+            # incremental registration (ISSUE 19): every full prompt page
+            # this chunk just committed enters the tenant's prefix chain NOW
+            # — a concurrent same-prefix admission aliases it one step later
+            # (only COMMITTED pages ever register, so an alias can never see
+            # half-written KV). No-op with the cache off.
+            self.cache.commit_prefix(slot, h.tenant, act.prompt,
+                                     act.prefill_pos)
             self.prefill_chunks_committed += 1
             SERVING_EVENTS.incr("serving_prefill_chunks")
             if not act.prefilling:
@@ -513,17 +548,20 @@ class ServingSession:
                     self.scheduler.retire(slot, reason)
 
     def _drafter_for(self, slot: int, act):
-        """This slot's prompt-lookup drafter, rebuilt when the slot was
+        """This slot's (drafter, adaptive-K cell), rebuilt when the slot was
         recycled to a different request (stale entries are bounded by
-        max_slots; retirement and engine recovery drop them eagerly)."""
+        max_slots; retirement and engine recovery drop them eagerly). The
+        K cell is derived state exactly like the drafter: a replay regrows
+        the same acceptance history, hence the same K at every round —
+        which keeps crash recovery bitwise with adaptive K on."""
         from paddle_tpu.serving.speculation import PromptLookupDrafter
 
         rid = act.handle.request_id
         ent = self._drafters.get(slot)
         if ent is None or ent[0] != rid:
-            ent = (rid, PromptLookupDrafter())
+            ent = (rid, PromptLookupDrafter(), [self.speculate_k])
             self._drafters[slot] = ent
-        return ent[1]
+        return ent[1], ent[2]
 
     def _speculate(self) -> set:
         """One prompt-lookup draft/verify round for EVERY eligible slot
@@ -541,6 +579,8 @@ class ServingSession:
         same committed prefix, drafts the same tokens, samples through the
         same (seed, emitted-token-index) keys, and accepts the same prefix.
         Returns the slots advanced this round (skipped by _decode_once)."""
+        from paddle_tpu.serving.speculation import next_draft_k
+
         advanced: set = set()
         if not self.speculate_k:
             return advanced
@@ -565,9 +605,13 @@ class ServingSession:
                     slot, h.prompt_len + h.max_new_tokens
                 )
                 continue
-            drafter = self._drafter_for(slot, act)
+            drafter, kcell = self._drafter_for(slot, act)
             drafter.sync(act.prompt, h.tokens)
-            draft = drafter.draft(k)
+            # adaptive K (ROADMAP 1a): draft up to this request's CURRENT
+            # effective K — grown/shrunk from its own acceptance history by
+            # the pure next_draft_k rule — while the verify call below stays
+            # [1, K_max+1] (short drafts zero-pad, signature stays 1)
+            draft = drafter.draft(min(k, kcell[0]))
             if not draft:
                 continue
             toks = np.zeros((1, k + 1), np.int32)
@@ -619,6 +663,10 @@ class ServingSession:
             self.spec_rounds += 1
             self.spec_tokens_drafted += len(draft)
             self.spec_tokens_accepted += max(0, len(emit) - 1)
+            self.spec_k_eff_sum += len(draft)
+            kcell[0] = next_draft_k(
+                kcell[0], k, len(draft), max(0, len(emit) - 1)
+            )
             SERVING_EVENTS.incr("serving_spec_rounds")
             SERVING_EVENTS.incr("serving_spec_accepted", max(0, len(emit) - 1))
             advanced.add(slot)
@@ -1035,7 +1083,16 @@ class ServingSession:
                 self.spec_tokens_accepted / self.spec_tokens_drafted, 4
             ) if self.spec_tokens_drafted else 0.0,
             "spec_pages_trimmed": self.spec_pages_trimmed,
+            # adaptive draft length (ISSUE 19 satellite): mean tokens
+            # actually DRAFTED per verify round — converges up toward K on
+            # accepting streams, down toward 1 when drafts keep missing
+            "spec_effective_k": round(
+                self.spec_k_eff_sum / self.spec_rounds, 4
+            ) if self.spec_rounds else 0.0,
             "verify_shape_signatures": self.verify_shape_signatures(),
+            # shared-prefix cache (ISSUE 19): hit rate + sharing/COW/eviction
+            # counters; stable keys (zeros) with the cache off
+            **self.cache.prefix_stats(),
         }
 
 

@@ -28,6 +28,31 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
+def next_draft_k(k_eff: int, k_max: int, drafted: int, accepted: int) -> int:
+    """Adaptive draft length (ROADMAP item 1a): the effective K for a
+    request's NEXT verify round, given what just happened. A PURE rule —
+    no clocks, no RNG, no engine state — so crash replay / router failover
+    regrow the same K sequence from the same acceptance history and every
+    round stays bitwise.
+
+    Additive-increase / fall-to-observed:
+      * full acceptance (every drafted token matched) -> grow by 1 toward
+        `k_max` — the stream is in a predictable stretch, draft deeper;
+      * partial/zero acceptance -> fall to `accepted + 1` — the draft
+        diverged after `accepted` tokens, so drafting further than one past
+        the observed match depth just burns verify lanes.
+
+    The [1, K_max+1] verify program zero-pads short drafts, so the shape —
+    and therefore the executable — never changes with K (signature stays 1);
+    only HOW MANY lanes carry real draft tokens does."""
+    k_eff = max(1, min(int(k_eff), int(k_max)))
+    if drafted <= 0:
+        return k_eff  # no draft existed: no evidence, keep the current K
+    if accepted >= drafted:
+        return min(int(k_max), k_eff + 1)
+    return max(1, int(accepted) + 1)
+
+
 class PromptLookupDrafter:
     """Incremental n-gram index + drafts for one request.
 

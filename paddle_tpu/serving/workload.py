@@ -58,6 +58,35 @@ def make_repetitive_prompts(
     return out
 
 
+def make_shared_prefix_prompts(
+    n: int,
+    n_prefixes: int,
+    prefix_len: int,
+    suffix_len: int,
+    vocab: int,
+    bos_id: int,
+    seed: int = 0,
+) -> List[List[int]]:
+    """The prefix-cache workload (ISSUE 19): `n_prefixes` distinct system
+    prompts, each shared by `n // n_prefixes`-ish user turns that differ only
+    in a short random suffix — the many-users-one-system-prompt regime the
+    shared-prefix KV cache is built for. Prompts cycle round-robin over the
+    prefixes so consecutive requests hit DIFFERENT chains (the adversarial
+    order for a naive single-tail cache; a radix-over-pages index must not
+    care). Every suffix is unique, so past the shared pages each request
+    still pays its own prefill — the measured win isolates the prefix."""
+    rs = np.random.RandomState(seed)
+    prefixes = [
+        [bos_id] + [int(t) for t in rs.randint(3, vocab, size=prefix_len - 1)]
+        for _ in range(n_prefixes)
+    ]
+    out = []
+    for i in range(n):
+        suffix = [int(t) for t in rs.randint(3, vocab, size=suffix_len)]
+        out.append(prefixes[i % n_prefixes] + suffix)
+    return out
+
+
 def make_mixed_prompts(
     n: int,
     short_lengths: Sequence[int],

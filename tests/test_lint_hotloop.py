@@ -760,3 +760,122 @@ def test_frame_encoding_only_in_handler_push_loop():
         "framing seam so pushed frames and call_stream's parser cannot "
         "drift apart:\n  " + "\n  ".join(offenders)
     )
+
+
+# -- shared-prefix cache index (ISSUE 19 prefix caching) ----------------------
+#
+# The prefix index is pure host bookkeeping: a radix-over-pages dict keyed by
+# (parent node, page token chunk) with a LOGICAL LRU tick. It runs under the
+# scheduler's admission locks — including the submit-thread peek — so it must
+# never read a clock (the logical tick exists precisely so eviction order is
+# deterministic and lock hold times stay bounded), never make an RPC, and
+# never place or touch a device array (aliasing is a block-table edit; the KV
+# pools are neither read nor written). Zero tolerance, no tags.
+#
+# The admission path gets exactly ONE sanctioned per-submit hash computation
+# (Scheduler.submit's peek_hit_tokens call — prices the wait estimate and the
+# chunk count by the UNCACHED suffix) and exactly TWO registration sites
+# (ServingSession._admit for whole-prompt commits, _prefill_chunks for
+# per-chunk commits). The counts are pinned so a second hash walk cannot
+# creep into a per-step body as an innocent-looking freshness check.
+
+PREFIX_PY = os.path.join(_REPO, "paddle_tpu", "serving", "prefix_cache.py")
+PREFIX_INDEX_METHODS = (
+    "__init__", "__len__", "pages", "holds", "_root_for", "max_match_pages",
+    "match", "_root_children", "peek_hit_tokens", "extend", "evictable",
+    "evict_lru", "drop_all", "stats",
+)
+
+
+def test_prefix_index_is_pure():
+    """The cache index never touches a clock, a socket, or a device array,
+    tagged or otherwise — its LRU is a logical counter, its lookups are dict
+    walks, and the one structure it influences (the block table) is edited
+    by PagedKVCache, not by the index."""
+    for pattern, what in (
+        (CLOCK_CALL, "wall-clock read"),
+        (RPC_CALL, "RPC"),
+        (PUT_CALL, "device placement"),
+    ):
+        v, _ = _scan(PREFIX_PY, "PrefixIndex", PREFIX_INDEX_METHODS,
+                     pattern, tag=None)
+        assert not v, (
+            f"{what} inside the prefix cache index — the index is pure host "
+            "bookkeeping that runs under admission locks; move the side "
+            "effect to the session/scheduler cold path:\n  " + "\n  ".join(v)
+        )
+
+
+def _call_sites(path, call: "re.Pattern"):
+    with open(path) as f:
+        source = f.read()
+    sites = []
+    for ln, text in enumerate(source.splitlines(), 1):
+        code = text.split("#", 1)[0]
+        if call.search(code) and not code.lstrip().startswith("def "):
+            sites.append(ln)
+    return source, sites
+
+
+def test_prefix_admission_hash_sites_pinned():
+    """Exactly one `.peek_hit_tokens(` site in the scheduler — inside
+    submit(), the sanctioned per-admission hash computation — and exactly
+    two `.commit_prefix(` sites in the session (whole-prompt commit in
+    _admit, per-chunk commit in _prefill_chunks). Each computation walks the
+    prompt once, so a second site is a second O(prompt) walk on the request
+    path and needs a deliberate re-pin here."""
+    peek = re.compile(r"\.peek_hit_tokens\(")
+    source, sites = _call_sites(SCHEDULER_PY, peek)
+    spans = list(_hot_spans(ast.parse(source), "Scheduler", ("submit",)))
+    assert spans, f"Scheduler.submit moved/renamed — update {__file__}"
+    _, lo, hi = spans[0]
+    assert len(sites) == 1 and lo <= sites[0] <= hi, (
+        f".peek_hit_tokens( call sites in scheduler.py at lines {sites} "
+        "(pinned: exactly 1, inside Scheduler.submit) — the admission-path "
+        "hash computation happens ONCE per submit; route any new consumer "
+        "through handle.prefix_hint instead of re-hashing"
+    )
+
+    commit = re.compile(r"\.commit_prefix\(")
+    source, sites = _call_sites(SERVING_PY, commit)
+    spans = list(_hot_spans(
+        ast.parse(source), "ServingSession", ("_admit", "_prefill_chunks")))
+    assert len(spans) == 2, (
+        f"ServingSession._admit/_prefill_chunks moved/renamed — "
+        f"update {__file__}"
+    )
+    in_span = [ln for ln in sites
+               if any(lo <= ln <= hi for _, lo, hi in spans)]
+    assert len(sites) == 2 and in_span == sites, (
+        f".commit_prefix( call sites in session.py at lines {sites} "
+        "(pinned: exactly 2 — _admit's whole-prompt commit and "
+        "_prefill_chunks' per-chunk commit) — registration covers COMMITTED "
+        "pages only; a third site is either a duplicate registration or an "
+        "uncommitted-page leak into the shared index"
+    )
+
+
+def test_decode_hot_bodies_stay_prefix_free():
+    """The per-step decode/verify bodies never touch the prefix cache: all
+    index work happens at admission (reserve/peek) and at prefill commit.
+    Pin the separation textually so 'just refresh the LRU every step' or a
+    per-step re-hash can't land without tripping this."""
+    with open(SERVING_PY) as f:
+        source = f.read()
+    spans = _hot_spans(
+        ast.parse(source), "ServingSession",
+        ("step", "_decode_once", "_speculate"),
+    )
+    lines = source.splitlines()
+    offenders = []
+    for name, lo, hi in spans:
+        body = "\n".join(lines[lo - 1:hi])
+        for needle in ("commit_prefix", "peek_hit_tokens", ".prefix"):
+            if needle in body:
+                offenders.append(f"ServingSession.{name}: contains {needle}")
+    assert not offenders, (
+        "prefix-cache work reached a per-step body — the index is an "
+        "admission/commit-time structure (reserve aliases, commit_prefix "
+        "registers); decode and verify only ever write pages past the "
+        "prompt:\n  " + "\n  ".join(offenders)
+    )

@@ -346,3 +346,41 @@ def test_shard_update_checkpoint_places_onto_tp_mesh_bitwise(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(placed).view(np.uint32), v.view(np.uint32)
         )
+
+
+# ---------------------------------------------------------------------------
+# shared-prefix cache × TP (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def _prefix_run(tp, prefix):
+    """Sampled + chunked + prefix-cache run: each prompt drains before the
+    next submits, so later prompts genuinely alias the cached prefix."""
+    session = make_demo_session(
+        prefill_buckets=(16,), max_len=96, prefill_chunk=8, tp=tp,
+        prefix_cache=prefix, **_DEMO,
+    )
+    sys_prompt = list(range(2, 26))  # 24 shared tokens = 3 pages of 8
+    handles = []
+    for i in range(4):
+        handles.append(session.submit(
+            sys_prompt + [30 + i, 31 + i], 6,
+            seed=50 + i, temperature=0.6, top_k=12,
+        ))
+        session.run_until_idle()
+    return [h.tokens for h in handles], session.stats()
+
+
+def test_tp_prefix_cache_tokens_identical():
+    """The prefix cache is HOST-side block-table state, so it composes with
+    TP for free: aliased pages are just page ids in the replicated table,
+    and the per-shard paged attention reads them like any other page. TP=2
+    cache-on tokens must be bitwise the single-chip cache-off oracle, with
+    a real hit rate and still ONE decode signature."""
+    ref, _ = _prefix_run(0, False)
+    for tp in (0, 2):
+        out, st = _prefix_run(tp, True)
+        assert out == ref, f"tp={tp} cache-on tokens diverged"
+        assert st["prefix_hit_rate"] > 0.3, (tp, st["prefix_hit_rate"])
+        assert st["prefix_pages_shared"] >= 9, (tp, st["prefix_pages_shared"])
+        assert st["decode_shape_signatures"] == 1
