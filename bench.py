@@ -581,6 +581,78 @@ def run_serving_tp() -> dict:
     }
 
 
+def run_control_plane() -> list:
+    """Binary control-plane legs (ISSUE 20): the framed wire's two headline
+    numbers as cross-round metrics. `control_plane_tasks_per_sec` drains a
+    task ledger through a simulated trainer fleet over the framed wire
+    (bulk leases + piggybacked acks; the line-JSON leg rides along as the
+    round-trip denominator). `stream_bytes_per_token` is the binary push
+    stream's bytes per delivered token at fan-out, with the JSON wire's
+    number and the ratio alongside. Both run the REAL TCP protocol against
+    in-process servers — host-side numbers, so the jax platform tag marks
+    the round, not the transport. The full gated grids live in
+    benchmarks/chaos_bench.py --mode fleet and benchmarks/serving_bench.py
+    streaming."""
+    import argparse
+    import importlib.util
+
+    import jax
+
+    bench_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"
+    )
+
+    def load(name):
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(bench_dir, name + ".py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    platform = jax.devices()[0].platform
+    entries = []
+
+    fleet = load("chaos_bench").run_fleet(argparse.Namespace(
+        fleet_trainers=int(os.environ.get("BENCH_FLEET_TRAINERS", "24")),
+        fleet_tasks=int(os.environ.get("BENCH_FLEET_TASKS", "240")),
+        fleet_lease_batch=8,
+        seed=0,
+    ))
+    entries.append({
+        "metric": "control_plane_tasks_per_sec",
+        "value": fleet["value"],
+        "unit": fleet["unit"],
+        "round_trip_reduction": fleet["round_trip_reduction"],
+        "round_trips_per_task": fleet["framed"]["round_trips_per_task"],
+        "round_trips_per_task_json": fleet["legacy"]["round_trips_per_task"],
+        "bytes_per_task": fleet["framed"]["bytes_per_task"],
+        "trainers": fleet["framed"]["trainers"],
+        "lease_batch": fleet["lease_batch"],
+        "exactly_once": fleet["gates"]["exactly_once_both_legs"],
+        "platform": platform,
+    })
+
+    streaming = load("serving_bench").run_streaming(argparse.Namespace(
+        vocab=96, n_layers=2, d_model=64, n_heads=2,
+        max_slots=8, page_size=16,
+        stream_counts=os.environ.get("BENCH_STREAM_COUNTS", "16"),
+        stream_max_new=16, speculate_k=0,
+    ))
+    leg = streaming["legs"][-1]
+    entries.append({
+        "metric": "stream_bytes_per_token",
+        "value": leg["push_bin"]["bytes_per_token"],
+        "unit": "bytes/token",
+        "bytes_per_token_json": leg["push"]["bytes_per_token"],
+        "bin_bytes_ratio": leg["bin_bytes_ratio"],
+        "streams": leg["streams"],
+        "frames_coalesced": streaming["stream_frames_coalesced"],
+        "platform": platform,
+    })
+    return entries
+
+
 def run_bench(cpu_fallback: bool) -> dict:
     import jax
 
@@ -805,6 +877,11 @@ def run_bench(cpu_fallback: bool) -> dict:
     except Exception as exc:  # noqa: BLE001 — spec leg must not kill the headline
         sys.stderr.write(f"[bench] serving speculative leg failed: {exc!r}\n")
         out["serving_spec_error"] = repr(exc)[-400:]
+    try:
+        out["metrics"].extend(run_control_plane())
+    except Exception as exc:  # noqa: BLE001 — wire legs must not kill the headline
+        sys.stderr.write(f"[bench] control-plane leg failed: {exc!r}\n")
+        out["control_plane_error"] = repr(exc)[-400:]
     # LAST on purpose: this leg detaches the persistent compile cache (it
     # executes multi-device programs — see run_serving_tp docstring)
     try:

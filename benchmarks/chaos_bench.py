@@ -79,9 +79,17 @@ runs, exactly-once task accounting across every triggered resize epoch, no
 epoch left open, the kill landed mid-epoch, and the restarted controller
 went on to make decisions.
 
+--mode fleet (ISSUE 20) drills the binary batched control plane: a
+simulated 100+-trainer fleet (threads, real wire connections, no data
+plane) drains the same task ledger over the legacy line-JSON
+get_task/task_finished pair and over framed bulk get_tasks leases with
+piggybacked done-acks. Reports tasks/sec, time-to-drain, round trips and
+bytes per task; gates exactly-once delivery in both legs and a >= 3x
+round-trip reduction for the framed leg.
+
 Usage:
   JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py
-      [--mode local|cluster|resize|serving|router|autoscale]
+      [--mode local|cluster|resize|serving|router|autoscale|ha|fleet]
       [--faults SPEC] [--seed N]
 """
 
@@ -282,6 +290,132 @@ def run_cluster(args) -> dict:
         if srv is not None:
             srv.stop()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_fleet(args) -> dict:
+    """Control-plane scaling drill (ISSUE 20): a simulated 100+-trainer
+    fleet — every trainer a thread speaking the real wire protocol to ONE
+    in-process master, no data plane — drains the same task ledger twice:
+
+      * legacy leg: line-JSON wire, the classic get_task + task_finished
+        pair (2 round trips per task, plus retry polls at the drain tail);
+      * framed leg: binary frames, bulk `get_tasks` range leases with the
+        previous batch's done-acks piggybacked on the next lease request
+        (~1 round trip per lease_batch tasks).
+
+    Reported per leg: tasks/sec, time-to-drain, round trips and wire bytes
+    per task (client-side counters). Gates: exactly-once delivery in BOTH
+    legs (every task seen once across the whole fleet) and the framed leg
+    >= 3x fewer round trips per task."""
+    import threading
+
+    from paddle_tpu.runtime.master import (
+        MasterClient, MasterServer, TaskMaster,
+    )
+
+    ntasks = args.fleet_tasks
+    shards = [f"shard-{i:05d}" for i in range(ntasks)]
+
+    def leg(wire: str) -> dict:
+        server = MasterServer(
+            TaskMaster(timeout_s=300.0, failure_max=10), lease_s=60.0,
+        ).start()
+        results = [None] * args.fleet_trainers
+        try:
+            boot = MasterClient(server.address)
+            boot.call("set_dataset", shards=shards, chunks_per_task=1)
+            boot.close()
+
+            def worker(i: int) -> None:
+                c = MasterClient(server.address, wire=wire)
+                tid = c.call("register")["trainer_id"]
+                got = []
+                if wire == "frames":
+                    pending = []  # done-acks deferred onto the next lease
+                    while True:
+                        resp = c.call(
+                            "get_tasks", n=args.fleet_lease_batch,
+                            done_ids=pending, trainer_id=tid,
+                        )
+                        pending = []
+                        if resp.get("pass_finished"):
+                            break
+                        tasks = resp.get("tasks", [])
+                        for t in tasks:
+                            got.append(int(t["task_id"]))
+                            pending.append(int(t["task_id"]))
+                        if not tasks:  # drain tail: others still own tasks
+                            time.sleep(0.002)
+                else:
+                    while True:
+                        resp = c.call("get_task", trainer_id=tid)
+                        if resp.get("pass_finished"):
+                            break
+                        if resp.get("retry"):
+                            time.sleep(0.002)
+                            continue
+                        got.append(int(resp["task_id"]))
+                        c.call("task_finished", task_id=resp["task_id"],
+                               trainer_id=tid)
+                results[i] = {
+                    "tasks": got,
+                    "round_trips": c.round_trips,
+                    "bytes": c.bytes_sent + c.bytes_received,
+                }
+                c.close()
+
+            threads = [
+                threading.Thread(target=worker, args=(i,), daemon=True)
+                for i in range(args.fleet_trainers)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            drain_s = time.perf_counter() - t0
+        finally:
+            server.stop()
+
+        delivered = [tid for r in results if r for tid in r["tasks"]]
+        rts = sum(r["round_trips"] for r in results if r)
+        nbytes = sum(r["bytes"] for r in results if r)
+        return {
+            "wire": wire,
+            "trainers": args.fleet_trainers,
+            "tasks": ntasks,
+            "tasks_per_sec": round(ntasks / drain_s, 1),
+            "time_to_drain_s": round(drain_s, 3),
+            "round_trips_per_task": round(rts / ntasks, 3),
+            "bytes_per_task": round(nbytes / ntasks, 1),
+            "exactly_once": (
+                len(delivered) == ntasks
+                and len(set(delivered)) == ntasks
+            ),
+        }
+
+    legacy = leg("json")
+    framed = leg("frames")
+    reduction = (
+        legacy["round_trips_per_task"] / framed["round_trips_per_task"]
+    )
+    return {
+        "metric": "control_plane_tasks_per_sec",
+        "value": framed["tasks_per_sec"],
+        "unit": "tasks/s",
+        "platform": "cpu-threads",
+        "legacy": legacy,
+        "framed": framed,
+        "round_trip_reduction": round(reduction, 2),
+        "gates": {
+            "exactly_once_both_legs": (
+                legacy["exactly_once"] and framed["exactly_once"]
+            ),
+            "round_trip_reduction_3x": reduction >= 3.0,
+        },
+        "lease_batch": args.fleet_lease_batch,
+        "seed": args.seed,
+    }
 
 
 def _build_resize_trainer(args, world, shard):
@@ -2036,7 +2170,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="local",
                     choices=["local", "cluster", "resize", "serving",
-                             "router", "autoscale", "ha"],
+                             "router", "autoscale", "ha", "fleet"],
                     help="local: in-process throughput-under-faults; "
                          "cluster: multi-process master-failover drill; "
                          "resize: live elastic grow/shrink mid-pass drill; "
@@ -2049,7 +2183,11 @@ def main():
                          "drill — router killed mid-decode under a "
                          "standby (bitwise + stream-reattach gates) and "
                          "autoscaler killed mid-resize-epoch under a "
-                         "standby (exactly-once gate)")
+                         "standby (exactly-once gate); fleet: simulated "
+                         "100+-trainer control-plane drill — framed bulk "
+                         "leases + piggybacked acks vs the legacy line-JSON "
+                         "get_task/task_finished pair (tasks/sec, "
+                         "time-to-drain, >= 3x round-trip reduction gate)")
     ap.add_argument("--faults", default=DEFAULT_FAULTS,
                     help="input-side fault mix for the chaos mode")
     ap.add_argument("--seed", type=int, default=0)
@@ -2174,6 +2312,15 @@ def main():
                     help="autoscale mode: per-record consumer work (keeps "
                          "the training pass alive across the whole load "
                          "schedule so resizes land mid-pass)")
+    ap.add_argument("--fleet_trainers", type=int, default=100,
+                    help="fleet mode: simulated trainer count (threads, "
+                         "each with its own wire connection)")
+    ap.add_argument("--fleet_tasks", type=int, default=800,
+                    help="fleet mode: task ledger size drained by each leg")
+    ap.add_argument("--fleet_lease_batch", type=int, default=8,
+                    help="fleet mode: tasks per bulk get_tasks lease in the "
+                         "framed leg (acks for the batch ride the next "
+                         "lease request)")
     ap.add_argument("--ha_requests", type=int, default=6,
                     help="ha mode: wedged in-flight requests per router leg "
                          "(half greedy, half seeded-sampled; plus one "
@@ -2213,6 +2360,10 @@ def main():
 
     if args.mode == "cluster":
         print(json.dumps(run_cluster(args)))
+        return
+
+    if args.mode == "fleet":
+        print(json.dumps(run_fleet(args)))
         return
 
     import jax

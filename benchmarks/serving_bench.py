@@ -526,7 +526,15 @@ def run_streaming(args):
     Gate: push round-trips-per-token strictly below poll at every stream
     count. Tokens/sec is reported for color but not gated — on a one-box
     CPU run both sides are engine-bound; the wire economics are the
-    structural claim."""
+    structural claim.
+
+    ISSUE 20 adds the wire dimension: the push leg runs twice, once over
+    the legacy line-JSON wire and once over the framed binary wire
+    (compact stream deltas, token payloads packed as int32), and every leg
+    reports stream bytes per delivered token off the client's own byte
+    counters. Gate: at the LARGEST stream count the binary push spends
+    <= half the bytes per token of the JSON push (coalescing under fan-out
+    plus the frame encoding carry the 2x)."""
     import threading
     import time
 
@@ -556,12 +564,12 @@ def run_streaming(args):
     while time.time() < deadline and not router.fleet.live():
         time.sleep(0.02)
 
-    def drive(n_streams, mode):
+    def drive(n_streams, mode, wire="json"):
         prompts = make_prompts(
             n_streams, lengths=(5, 11, 16, 23, 32), vocab=args.vocab,
             bos_id=1, seed=100 + n_streams,
         )
-        rpcs, tokens_out, errors = [0], [0], [0]
+        rpcs, tokens_out, errors, nbytes = [0], [0], [0], [0]
         lock = threading.Lock()
 
         def poll_stream(p):
@@ -589,7 +597,7 @@ def run_streaming(args):
                 c.close()
 
         def push_stream(p):
-            c = ServingClient(router.address)
+            c = ServingClient(router.address, wire=wire)
             try:
                 n = 0
                 for frame in c.stream(p, args.stream_max_new):
@@ -599,6 +607,7 @@ def run_streaming(args):
                 with lock:
                     rpcs[0] += 1 + c.stream_reattaches
                     tokens_out[0] += n
+                    nbytes[0] += c.stream_bytes_in
             except Exception:
                 with lock:
                     errors[0] += 1
@@ -618,12 +627,17 @@ def run_streaming(args):
         wall = time.monotonic() - t0
         return {
             "mode": mode,
+            "wire": wire,
             "streams": n_streams,
             "tokens": tokens_out[0],
             "errors": errors[0],
             "round_trips": rpcs[0],
             "round_trips_per_token": round(
                 rpcs[0] / tokens_out[0], 3
+            ) if tokens_out[0] else 0.0,
+            "stream_bytes": nbytes[0],
+            "bytes_per_token": round(
+                nbytes[0] / tokens_out[0], 1
             ) if tokens_out[0] else 0.0,
             "tokens_per_sec": round(tokens_out[0] / wall, 1) if wall else 0.0,
         }
@@ -632,22 +646,30 @@ def run_streaming(args):
     try:
         for n in [int(x) for x in args.stream_counts.split(",") if x.strip()]:
             poll = drive(n, "poll")
-            push = drive(n, "push")
+            push = drive(n, "push", wire="json")
+            push_bin = drive(n, "push", wire="frames")
             legs.append({
                 "streams": n,
                 "poll": poll,
                 "push": push,
+                "push_bin": push_bin,
                 "push_fewer_round_trips_per_token": bool(
                     push["errors"] == 0 and poll["errors"] == 0
                     and push["round_trips_per_token"]
                     < poll["round_trips_per_token"]
                 ),
+                "bin_bytes_ratio": round(
+                    push["bytes_per_token"] / push_bin["bytes_per_token"], 2
+                ) if push_bin["bytes_per_token"] else 0.0,
             })
             print(
                 f"[serving_bench] streaming streams={n}: push "
                 f"{push['round_trips_per_token']} rt/token vs poll "
-                f"{poll['round_trips_per_token']} "
-                f"(frames pushed so far: {router.stream_frames})",
+                f"{poll['round_trips_per_token']}; bytes/token json "
+                f"{push['bytes_per_token']} vs binary "
+                f"{push_bin['bytes_per_token']} "
+                f"(frames pushed so far: {router.stream_frames}, "
+                f"coalesced: {router.stream_coalesced})",
                 file=sys.stderr,
             )
     finally:
@@ -659,6 +681,14 @@ def run_streaming(args):
         "push_round_trips_below_poll_all": bool(legs) and all(
             l["push_fewer_round_trips_per_token"] for l in legs
         ),
+        # ISSUE 20 gate: at the largest fan-out the binary push wire moves
+        # <= half the bytes per delivered token of the JSON push wire
+        "binary_stream_bytes_2x_at_max_fanout": bool(legs) and (
+            legs[-1]["bin_bytes_ratio"] >= 2.0
+        ),
+        "stream_frames_pushed": router.stream_frames,
+        "stream_bytes_pushed": router.stream_bytes,
+        "stream_frames_coalesced": router.stream_coalesced,
     }
 
 

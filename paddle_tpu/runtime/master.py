@@ -38,6 +38,7 @@ Cluster-level failure is a first-class code path here:
 
 from __future__ import annotations
 
+import base64
 import ctypes as C
 import json
 import logging
@@ -53,6 +54,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Set,
 from paddle_tpu.core import faults, stats
 from paddle_tpu.obs import metrics as obs_metrics
 from paddle_tpu.obs import trace as obs_trace
+from paddle_tpu.runtime import frames
 from paddle_tpu.runtime import native
 from paddle_tpu.runtime import recordio
 
@@ -614,6 +616,18 @@ class _Handler(socketserver.StreamRequestHandler):
             except json.JSONDecodeError:
                 self._reply({"err": "bad json"})
                 continue
+            if req.get("method") == "_hello":
+                # wire negotiation (ISSUE 20): the probe and its answer ride
+                # line JSON, so a legacy peer — which never probes — is
+                # served bit-for-bit by this unchanged loop, while a
+                # frames-capable client switches THIS connection to the
+                # binary frame layer for the rest of its life
+                if req.get("frames") == 1:
+                    self._reply({"frames": 1})
+                    self._serve_frames(ms)
+                    return
+                self._reply({"frames": 0})
+                continue
             # span per RPC, adopting the caller's piggybacked trace context
             # (`_trace` on the line-JSON frame) so a task's or request's
             # spans stitch client → master under one trace id
@@ -621,13 +635,76 @@ class _Handler(socketserver.StreamRequestHandler):
                 "rpc." + str(req.get("method")), req.get("_trace"),
                 side="server",
             ):
-                keep = self._handle_one(ms, req)
+                keep, resp = self._handle_one(ms, req)
+            if resp is not None:
+                if "_bin" in resp:
+                    # line JSON cannot carry raw bytes: base64 downgrade
+                    resp = dict(resp)
+                    resp["bin_b64"] = base64.b64encode(
+                        resp.pop("_bin")
+                    ).decode("ascii")
+                self._reply(resp)
             if not keep:
                 return
 
-    def _handle_one(self, ms: "MasterServer", req: dict) -> bool:
-        """Process one request line; False severs the connection (chaos
-        sites, master killed under us)."""
+    def _serve_frames(self, ms: "MasterServer") -> None:
+        """The framed connection loop: request frames are processed in
+        arrival order and answered on the same socket, so a pipelining
+        client (`MasterClient.call_many`) gets its replies back in request
+        order, matched by req_id. A malformed frame severs with a NAMED
+        error reply (frames.FrameError subclasses) instead of wedging this
+        handler thread on a blocking read."""
+        while True:
+            try:
+                got = frames.read_frame(self.rfile)
+            except frames.FrameError as e:
+                self._reply_frame({"err": f"{type(e).__name__}: {e}"}, 0, 0, b"")
+                return
+            if got is None:
+                return
+            req, req_id, _, _ = got
+            with obs_trace.server_span(
+                "rpc." + str(req.get("method")), req.get("_trace"),
+                side="server",
+            ):
+                keep, resp = self._handle_one(ms, req)
+            if resp is not None:
+                flags = 0
+                blob = b""
+                if "_bin" in resp:
+                    resp = dict(resp)
+                    blob = resp.pop("_bin")
+                    flags |= frames.FLAG_BIN_BLOB
+                # piggyback discipline (ISSUE 20): while a resize epoch is
+                # active the drain signal rides EVERY framed data reply to
+                # a lease holder, not just heartbeat replies — a busy
+                # reader hears it one data round trip sooner and its
+                # heartbeat thread stands down (_Heartbeater's
+                # data-fresh skip)
+                if req.get("trainer_id") and "resize" not in resp:
+                    rz = ms.resize.heartbeat_payload()
+                    if rz is not None:
+                        resp["_rz"] = rz
+                        flags |= frames.FLAG_PIGGY
+                self._reply_frame(resp, req_id, flags, blob)
+            if not keep:
+                return
+
+    def _reply_frame(self, obj: dict, req_id: int, flags: int,
+                     blob: bytes) -> None:
+        try:
+            frames.write_frame(
+                self.wfile, obj, req_id=req_id, flags=flags, bin_payload=blob
+            )
+        except (OSError, ValueError):
+            pass  # peer vanished mid-reply; its retry path handles it
+
+    def _handle_one(
+        self, ms: "MasterServer", req: dict
+    ) -> Tuple[bool, Optional[dict]]:
+        """Process one request -> (keep_connection, reply | None). The
+        caller owns the wire (line vs frame encode); keep=False severs the
+        connection (chaos sites, master killed under us)."""
         master = ms.master
         lock = ms.master_lock
         method = req.get("method")
@@ -635,14 +712,14 @@ class _Handler(socketserver.StreamRequestHandler):
             # chaos hook: the RPC vanishes in transit — drop the
             # connection without processing or replying; the client's
             # reconnect/backoff path has to absorb it
-            return False
+            return False, None
         if faults.get().fire("master_kill"):
             # chaos hook: the master process dies mid-RPC — no reply, no
             # final snapshot, every open connection severed; only a
             # standby restoring the last on-disk snapshot saves the pass
             log.warning("chaos: master_kill fired — dying without reply")
             ms.kill()
-            return False
+            return False, None
         trainer_id = req.get("trainer_id")
         ms.membership.note_seen(trainer_id, req.get("role"))
         # (expired leases are swept by the reaper thread every lease_s/4 —
@@ -663,11 +740,10 @@ class _Handler(socketserver.StreamRequestHandler):
                 # announce parks and the reaper re-fires it on completion);
                 # a reader lease joining changes no world size
                 ms.announce_membership_resize()
-            self._reply({
+            return True, {
                 "trainer_id": tid,
                 "lease_s": ms.membership.lease_s,
-            })
-            return True
+            }
         if method == "heartbeat":
             # note_seen above already renewed (or adopted) the lease; a
             # piggybacked metrics snapshot joins the fleet aggregate
@@ -680,11 +756,9 @@ class _Handler(socketserver.StreamRequestHandler):
                 # active epoch reaches every live trainer within one
                 # heartbeat period, with zero extra control-plane RPCs
                 resp["resize"] = rz
-            self._reply(resp)
-            return True
+            return True, resp
         if method == "deregister":
-            self._reply({"ok": ms.drop_trainer(trainer_id, evict=False)})
-            return True
+            return True, {"ok": ms.drop_trainer(trainer_id, evict=False)}
         if method == "resize":
             # explicit fleet re-shape order (ops tooling / chaos bench);
             # join/evict-triggered epochs go through the same announce. A
@@ -702,39 +776,50 @@ class _Handler(socketserver.StreamRequestHandler):
                 ):
                     raise ValueError(world)
             except (KeyError, TypeError, ValueError):
-                self._reply({
+                return True, {
                     "err": f"resize needs a positive integer world, got "
                            f"{req.get('world')!r}"
-                })
-                return True
-            self._reply(ms.resize.announce(world, ms.membership.ids()))
-            return True
+                }
+            return True, ms.resize.announce(world, ms.membership.ids())
         if method in ("resize_drained", "resize_status"):
             try:
                 epoch = int(req.get("epoch", 0))
             except (TypeError, ValueError):
                 epoch = -1  # malformed: matches no epoch, replies status-only
             # in `go`, a member's status poll doubles as its resumed ack
-            self._reply(
+            return True, (
                 ms.resize.ack_drained(trainer_id, epoch)
                 if method == "resize_drained"
                 else ms.resize.mark_resumed(trainer_id, epoch)
             )
-            return True
         if method == "metrics":
             fleet = ms.fleet.aggregate()
-            self._reply({
+            return True, {
                 "text": obs_metrics.to_prometheus_text(fleet=fleet),
                 "fleet": fleet,
-            })
-            return True
+            }
         if method == "trace_export":
-            self._reply({"chrome_trace": obs_trace.export_chrome()})
-            return True
+            return True, {"chrome_trace": obs_trace.export_chrome()}
+        if method == "snapshot_fetch":
+            # bulk body (ISSUE 20): the snapshot blob rides the frame's RAW
+            # binary payload (base64 over a line-JSON connection) — a
+            # standby can warm itself over the wire instead of requiring
+            # shared snapshot storage. The on-disk file is always a
+            # complete snapshot (temp + rename writes), so a plain read
+            # outside master_lock is consistent.
+            path = ms.snapshot_path
+            if not path or not os.path.exists(path):
+                return True, {"err": "no snapshot available"}
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError as e:
+                return True, {"err": f"snapshot read failed: {e}"}
+            return True, {"_bin": blob, "bytes": len(blob)}
         snapshot_due = False
         with lock:
             if master.closed:  # killed under us — sever like a crash
-                return False
+                return False, None
             if method == "get_task":
                 got = master.get_task()
                 if got is None:
@@ -743,6 +828,36 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = {"pass_finished": True}
                 else:
                     resp = {"task_id": got[0], "shards": got[1]}
+                    ms.membership.own(trainer_id, got[0])
+            elif method == "get_tasks":
+                # bulk range lease + piggybacked acks (ISSUE 20): done /
+                # failed acks from the PREVIOUS batch land first — so the
+                # final ack of a pass rides the very request that discovers
+                # pass_finished — then up to n tasks are leased. One round
+                # trip does what the single-task surface took 2n for.
+                acked = 0
+                for t in req.get("done_ids") or []:
+                    if master.task_finished(int(t)):
+                        acked += 1
+                        if ms.snap is not None and ms.snap.note_ack():
+                            snapshot_due = True
+                    ms.membership.release(int(t))
+                for t in req.get("failed_ids") or []:
+                    master.task_failed(int(t))
+                    ms.membership.release(int(t))
+                tasks: List[dict] = []
+                resp = {"tasks": tasks, "acked": acked}
+                for _ in range(max(0, int(req.get("n", 1) or 0))):
+                    got = master.get_task()
+                    if got is None:
+                        if not tasks:
+                            resp["retry"] = True
+                        break
+                    if got[0] == TaskMaster.PASS_FINISHED:
+                        if not tasks:
+                            resp["pass_finished"] = True
+                        break
+                    tasks.append({"task_id": got[0], "shards": got[1]})
                     ms.membership.own(trainer_id, got[0])
             elif method == "task_finished":
                 tid = int(req["task_id"])
@@ -788,8 +903,7 @@ class _Handler(socketserver.StreamRequestHandler):
             # getting tasks while this thread does file I/O (the native
             # snapshot takes its own internal mutex for a consistent view)
             ms.snap.write(master)
-        self._reply(resp)
-        return True
+        return True, resp
 
     def _reply(self, obj: Any) -> None:
         try:
@@ -1194,9 +1308,43 @@ def _main(argv: Optional[List[str]] = None) -> int:
     return KILLED_EXIT if server._killed else 0
 
 
+class _CountingReader:
+    """Buffered-reader wrapper that counts received bytes into its owning
+    MasterClient — the wire-economics observability (bytes per delivered
+    token, bytes per task) the benches report rides on these counters."""
+
+    __slots__ = ("_f", "_owner")
+
+    def __init__(self, f, owner: "MasterClient"):
+        self._f = f
+        self._owner = owner
+
+    def read(self, n: int = -1) -> bytes:
+        b = self._f.read(n)
+        self._owner.bytes_received += len(b)
+        return b
+
+    def readline(self) -> bytes:
+        b = self._f.readline()
+        self._owner.bytes_received += len(b)
+        return b
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        b = self.readline()
+        if not b:
+            raise StopIteration
+        return b
+
+    def close(self) -> None:
+        self._f.close()
+
+
 class MasterClient:
-    """Blocking line-JSON client with reconnect + endpoint failover
-    (go/master/client.go parity).
+    """Blocking RPC client with reconnect + endpoint failover
+    (go/master/client.go parity), speaking either wire.
 
     `address` may be one endpoint or a failover list ((h, p), "h:p",
     "a:p1,b:p2", or a sequence of those — the CLI's --master_endpoints form).
@@ -1207,7 +1355,17 @@ class MasterClient:
     standby is found inside the same loop. After `retries` attempts
     (default: enough for several full rotations) the terminal ConnectionError
     names the method, the endpoints, the attempt count and the last
-    underlying error."""
+    underlying error.
+
+    Wire (ISSUE 20): each connection opens with the line-JSON `_hello`
+    probe; a frames-capable server upgrades the connection to the binary
+    frame layer (runtime/frames.py — pipelining via `call_many`, binary
+    token payloads, header trace context, piggybacked control signals), a
+    legacy server refuses and the client stays line-JSON (memoized per
+    endpoint so later reconnects skip the probe). `wire` /
+    `PADDLE_TPU_WIRE` selects: "auto" (default), "json" (never probe),
+    "frames" (downgrade is an error). All traffic rides ONE socket per
+    endpoint; `close()` releases the buffered reader and writer with it."""
 
     def __init__(
         self,
@@ -1216,6 +1374,8 @@ class MasterClient:
         retries: Optional[int] = None,
         backoff_base: float = 0.05,
         backoff_max: float = 2.0,
+        wire: Optional[str] = None,
+        on_piggyback: Optional[Callable[[Dict[str, Any]], None]] = None,
     ):
         self.endpoints = parse_endpoints(address)
         self.timeout = timeout
@@ -1226,19 +1386,67 @@ class MasterClient:
         )
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
+        self.wire = (wire or os.environ.get("PADDLE_TPU_WIRE", "auto")).lower()
+        # piggybacked control signals stripped off data replies (`_rz`, the
+        # resize drain signal) land here instead of surprising callers
+        self.on_piggyback = on_piggyback
         self._i = 0
         self._sock: Optional[socket.socket] = None
-        self._rfile = None
+        self._rfile: Optional[_CountingReader] = None
+        self._wfile = None
+        self._framed = False
+        self._req_seq = 0
+        # endpoints that refused the hello probe: line-JSON forever (well,
+        # until this client object dies) — no re-probe per reconnect
+        self._legacy: Set[Endpoint] = set()
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.round_trips = 0
+        # monotonic stamp of the last successful RPC: the heartbeat
+        # suppression signal (_Heartbeater skips while data-plane traffic
+        # bearing the trainer_id is fresher than a heartbeat would be)
+        self.last_rpc = 0.0
 
     @property
     def address(self) -> Endpoint:
         """The endpoint currently in use (compat with the single-address API)."""
         return self.endpoints[self._i]
 
+    @property
+    def wire_framed(self) -> bool:
+        """True when the CURRENT connection negotiated the frame layer."""
+        return self._framed
+
     def _connect(self):
-        if self._sock is None:
-            self._sock = socket.create_connection(self.address, timeout=self.timeout)
-            self._rfile = self._sock.makefile("rb")
+        if self._sock is not None:
+            return
+        self._sock = socket.create_connection(self.address, timeout=self.timeout)
+        self._rfile = _CountingReader(self._sock.makefile("rb"), self)
+        self._wfile = self._sock.makefile("wb")
+        self._framed = False
+        if self.wire != "json" and self.address not in self._legacy:
+            self._hello()
+
+    def _hello(self) -> None:
+        """Wire negotiation: one line-JSON probe per fresh connection. A
+        legacy server answers unknown-method (memoized: later reconnects to
+        that endpoint skip the probe), a frames-capable one answers
+        {"frames": 1} and this connection switches to the frame layer."""
+        probe = json.dumps({"method": "_hello", "frames": 1}).encode() + b"\n"
+        self._sock.sendall(probe)
+        self.bytes_sent += len(probe)
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("master closed connection during hello")
+        if json.loads(line).get("frames") == 1:
+            self._framed = True
+            return
+        if self.wire == "frames":
+            raise ConnectionError(
+                f"endpoint {self.address} refused the frame layer and "
+                f"wire='frames' forbids the line-JSON downgrade"
+            )
+        self._legacy.add(self.address)
 
     def _rotate(self) -> None:
         if len(self.endpoints) > 1:
@@ -1246,15 +1454,108 @@ class MasterClient:
             stats.FT_EVENTS.incr("master_failover")
             log.warning("master failover: trying endpoint %s:%d", *self.address)
 
+    def _send(self, req: dict) -> int:
+        """Write one request on the current wire; returns its req_id (0 on
+        line JSON). frames.write_frame is THE frame-encode site — no
+        json.dumps on the framed path (hot-loop lint)."""
+        if self._framed:
+            self._req_seq = ((self._req_seq + 1) & 0xFFFFFFFF) or 1
+            self.bytes_sent += frames.write_frame(
+                self._wfile, req, req_id=self._req_seq
+            )
+            return self._req_seq
+        msg = json.dumps(req).encode() + b"\n"
+        self._sock.sendall(msg)
+        self.bytes_sent += len(msg)
+        return 0
+
+    def _recv(self, want_rid: int) -> dict:
+        if self._framed:
+            got = frames.read_frame(self._rfile)
+            if got is None:
+                raise ConnectionError("master closed connection")
+            resp, rid, flags, blob = got
+            if rid != want_rid:
+                raise frames.FrameError(
+                    f"reply id {rid} does not match request {want_rid}"
+                )
+            return frames.decode_payload(resp, rid, flags, blob)
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("master closed connection")
+        return json.loads(line)
+
+    def _absorb(self, resp: dict) -> dict:
+        """Per-reply bookkeeping: stamp data-plane freshness (heartbeat
+        suppression) and strip piggybacked control signals to the
+        on_piggyback hook."""
+        self.last_rpc = time.monotonic()
+        if isinstance(resp, dict) and "_rz" in resp:
+            rz = resp.pop("_rz")
+            if self.on_piggyback is not None:
+                try:
+                    self.on_piggyback(rz)
+                except Exception:
+                    log.exception("piggyback callback failed")
+        return resp
+
     def call(self, method: str, **kw) -> dict:
         """One RPC (with reconnect/failover/backoff). With tracing enabled
         the call runs inside a client span and piggybacks its context on the
-        frame (`_trace`), so the server's handler span joins this trace."""
+        frame (`_trace` — moved into the binary header on a framed
+        connection), so the server's handler span joins this trace."""
         if obs_trace.TRACER.enabled:
             with obs_trace.span("rpc." + method, side="client") as sp:
                 kw["_trace"] = {"t": sp.trace_id, "s": sp.span_id}
                 return self._call(method, kw)
         return self._call(method, kw)
+
+    def call_many(self, calls: Sequence[Tuple[str, dict]]) -> List[dict]:
+        """Pipelined batch (ISSUE 20): write every request back-to-back on
+        the ONE socket, then collect the replies in order, matched by
+        request id — N calls for one round trip of latency (the server
+        processes a connection's frames sequentially and answers in
+        arrival order). On a line-JSON connection this degrades to serial
+        `call`s. A connection failure retries the WHOLE batch through the
+        same reconnect/failover/backoff path as `call`, so callers pass
+        retry-exact requests (idempotency keys) — the discipline every RPC
+        here already follows."""
+        if not calls:
+            return []
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries):
+            try:
+                self._connect()
+                if not self._framed:
+                    return [self._call(m, dict(kw)) for m, kw in calls]
+                if faults.get().fire("conn_reset"):
+                    # chaos hook: the socket resets with the batch in
+                    # flight — the retry must re-send ALL of it
+                    raise ConnectionResetError("injected conn_reset (chaos)")
+                rids = [self._send({"method": m, **kw}) for m, kw in calls]
+                out = [self._absorb(self._recv(rid)) for rid in rids]
+                self.round_trips += 1
+                return out
+            except (OSError, ConnectionError, json.JSONDecodeError) as e:
+                last_err = e
+                self.close()
+                stats.FT_EVENTS.incr("master_reconnect")
+                self._rotate()
+                if attempt + 1 < self.retries:
+                    delay = min(self.backoff_max, self.backoff_base * 2 ** attempt)
+                    delay *= 0.5 + random.random() / 2
+                    log.warning(
+                        "pipelined batch of %d failed (%s: %s); reconnecting "
+                        "in %.0fms (attempt %d/%d)", len(calls),
+                        type(e).__name__, e, delay * 1e3, attempt + 1,
+                        self.retries,
+                    )
+                    time.sleep(delay)
+        raise ConnectionError(
+            f"pipelined batch of {len(calls)} to {self.endpoints} failed "
+            f"after {self.retries} attempts; giving up (last error: "
+            f"{type(last_err).__name__}: {last_err})"
+        ) from last_err
 
     def _call(self, method: str, kw: dict) -> dict:
         last_err: Optional[Exception] = None
@@ -1265,12 +1566,10 @@ class MasterClient:
                     # chaos hook: network partition/RST between trainer and
                     # master — the reconnect/failover path must absorb it
                     raise ConnectionResetError("injected conn_reset (chaos)")
-                msg = json.dumps({"method": method, **kw}).encode() + b"\n"
-                self._sock.sendall(msg)
-                line = self._rfile.readline()
-                if not line:
-                    raise ConnectionError("master closed connection")
-                return json.loads(line)
+                rid = self._send({"method": method, **kw})
+                resp = self._recv(rid)
+                self.round_trips += 1
+                return self._absorb(resp)
             except (OSError, ConnectionError, json.JSONDecodeError) as e:
                 last_err = e
                 self.close()
@@ -1301,13 +1600,32 @@ class MasterClient:
         ConnectionError and resumable callers reattach with their token
         cursor (the serving `from` cursor), on a FRESH call. The
         connection is reusable after a clean `done`; an abandoned or
-        broken stream drops it (frames may still be buffered)."""
+        broken stream drops it (frames may still be buffered). On a framed
+        connection the pushed frames are BINARY (compact token deltas,
+        runtime/frames.py) — decoded here back to the exact dicts a
+        line-JSON peer would see."""
         first = self._call(method, kw)
         yield first
         if "err" in first:
             return
         clean = False
         try:
+            if self._framed:
+                while True:
+                    got = frames.read_frame(self._rfile)
+                    if got is None:
+                        raise ConnectionError(
+                            "stream closed before its final frame"
+                        )
+                    obj, rid, flags, blob = got
+                    frame = self._absorb(
+                        frames.decode_payload(obj, rid, flags, blob)
+                    )
+                    if frame.get("done"):
+                        clean = True
+                        yield frame
+                        return
+                    yield frame
             for line in self._rfile:
                 try:
                     frame = json.loads(line)
@@ -1326,13 +1644,25 @@ class MasterClient:
                 self.close()
 
     def close(self) -> None:
+        # hygiene (ISSUE 20): the buffered reader/writer makefile objects
+        # are closed WITH the socket — the old path nulled the reader
+        # without closing it, leaking the buffer until GC on every
+        # reconnect of a long-lived client
+        for f in (self._rfile, self._wfile):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+        self._rfile = None
+        self._wfile = None
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
-            self._rfile = None
+        self._framed = False
 
 
 class _Heartbeater:
@@ -1354,6 +1684,8 @@ class _Heartbeater:
         self._ident = ident
         self._client = MasterClient(address, **(client_kw or {}))
         self._on_resize = on_resize
+        self.skipped = 0
+        self._skip_streak = 0
         self._evt = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="master-heartbeat", daemon=True
@@ -1371,6 +1703,24 @@ class _Heartbeater:
             tid = self._ident.get("trainer_id")
             if tid is None:
                 continue
+            last = self._ident.get("last_rpc")
+            if (
+                last is not None
+                and time.monotonic() - last < period
+                and self._skip_streak < 2
+            ):
+                # piggyback discipline (ISSUE 20): fresh data-plane traffic
+                # bearing this trainer_id already renewed the lease
+                # (note_seen fires on every RPC) and carried any resize
+                # signal on its framed reply (`_rz`) — an explicit
+                # heartbeat would be a pure extra round trip. Capped at 2
+                # consecutive skips so the metrics snapshot still reaches
+                # the fleet aggregate at a third of the usual cadence.
+                self.skipped += 1
+                self._skip_streak += 1
+                stats.FT_EVENTS.incr("heartbeat_piggybacked")
+                continue
+            self._skip_streak = 0
             try:
                 # metrics snapshot piggybacks on the lease renewal — the
                 # master aggregates these into its fleet-wide stats() view
@@ -1618,6 +1968,7 @@ def cluster_reader(
     poll_interval: float = 0.5,
     register: bool = True,
     client_kw: Optional[dict] = None,
+    lease_batch: int = 1,
 ) -> Callable[[], Iterator[Any]]:
     """v2 cluster reader (master/client.py:15): pull tasks from the master,
     stream their recordio shards, ack on completion, report failures. One
@@ -1628,6 +1979,19 @@ def cluster_reader(
     from a background heartbeat thread, so a trainer that dies mid-task is
     evicted and its tasks re-queued eagerly rather than after the per-task
     timeout; the lease is released (`deregister`) on a clean pass end.
+
+    Wire economics (ISSUE 20): tasks are leased through the bulk
+    `get_tasks` form — up to `lease_batch` tasks per round trip, with the
+    PREVIOUS batch's done acks piggybacked on the same request, so the
+    steady-state cost is 1/lease_batch round trips per task where the
+    single-task surface paid 2 (lease + ack). Failure acks flush eagerly.
+    Deferred done acks are flushed before joining a resize drain barrier
+    (the lease must hold no half-acked task across an epoch) and on every
+    exit path; an ack lost to a crash replays its task — exactly the
+    at-least-once delivery the single-task ack-loss path already had. On a
+    framed connection the resize drain signal also piggybacks on data
+    replies and the heartbeat thread stands down while data-plane traffic
+    is fresh (_Heartbeater), cutting the idle control chatter too.
 
     Elastic resize: a registered reader is a drain-barrier MEMBER. When the
     heartbeat thread sees an announced epoch it stashes the signal on the
@@ -1704,6 +2068,33 @@ def cluster_reader(
         ident: Dict[str, Any] = {
             "trainer_id": None, "lease_s": 10.0, "role": "reader",
         }
+        # a resize drain signal piggybacked on a framed data reply lands in
+        # the same slot the heartbeat thread uses — one consumption path
+        client.on_piggyback = lambda rz: ident.__setitem__("resize", rz)
+        # done acks deferred onto the next get_tasks request (failed acks
+        # flush eagerly); lists, drained atomically after a successful call
+        pending_done: List[int] = []
+        pending_failed: List[int] = []
+
+        def _id_kw() -> Dict[str, Any]:
+            return (
+                {"trainer_id": ident["trainer_id"]}
+                if ident["trainer_id"] is not None
+                else {}
+            )
+
+        def _flush_acks() -> None:
+            """Push deferred acks NOW (drain barrier / failure / pass exit
+            paths) — an acks-only get_tasks (n=0) leases nothing."""
+            if not (pending_done or pending_failed):
+                return
+            client.call(
+                "get_tasks", n=0, done_ids=list(pending_done),
+                failed_ids=list(pending_failed), **_id_kw(),
+            )
+            pending_done.clear()
+            pending_failed.clear()
+
         hb: Optional[_Heartbeater] = None
         try:
             if register:
@@ -1719,51 +2110,60 @@ def cluster_reader(
                     # (see _service_reader_drains)
                     with _READER_IDENTS_LOCK:
                         _READER_IDENTS.append(ident)
-            id_kw = (
-                {"trainer_id": ident["trainer_id"]}
-                if ident["trainer_id"] is not None
-                else {}
-            )
             while True:
                 # between-task boundary: no task leased to us right now, so
                 # joining a resize drain barrier here keeps the master's
-                # todo/pending/done books untouched
+                # todo/pending/done books untouched. Flush deferred acks
+                # FIRST when an epoch is announced — the lease must hold no
+                # half-acked task across the barrier.
+                if ident.get("resize") is not None:
+                    _flush_acks()
                 _maybe_drain(client, ident)
-                resp = client.call("get_task", **id_kw)
+                resp = client.call(
+                    "get_tasks", n=max(1, int(lease_batch)),
+                    done_ids=list(pending_done),
+                    failed_ids=list(pending_failed), **_id_kw(),
+                )
+                pending_done.clear()
+                pending_failed.clear()
+                if client.wire_framed:
+                    # signal the heartbeat thread that data-plane traffic is
+                    # carrying the lease (note_seen) + the resize piggyback
+                    ident["last_rpc"] = client.last_rpc
                 if resp.get("pass_finished"):
                     return
-                if resp.get("retry"):
+                tasks = resp.get("tasks") or []
+                if not tasks:
                     time.sleep(poll_interval)
                     continue
-                task_id, shards = resp["task_id"], resp["shards"]
-                try:
-                    yield from recordio.read_shards(shards, deserialize)
-                except BaseException:
-                    # the failure ack itself can fail (master died too) — it
-                    # must never mask the original shard-read error; the lease
-                    # timeout replays the task either way
+                for t in tasks:
+                    task_id, shards = t["task_id"], t["shards"]
                     try:
-                        client.call("task_failed", task_id=task_id, **id_kw)
-                    except ConnectionError as ack_err:
-                        stats.FT_EVENTS.incr("task_ack_failed")
-                        log.warning(
-                            "task_failed ack for task %d lost (%s); the task "
-                            "replays after its lease times out", task_id, ack_err,
-                        )
-                    raise
-                try:
-                    client.call("task_finished", task_id=task_id, **id_kw)
-                except ConnectionError as ack_err:
-                    # terminal (retries + failover exhausted): progress was
-                    # made but not recorded — the task WILL be re-dispatched
-                    # after its lease expires, so downstream consumers see its
-                    # records twice; count it and say so
-                    stats.FT_EVENTS.incr("task_ack_failed")
-                    log.warning(
-                        "task_finished ack for task %d failed terminally (%s); "
-                        "the task will replay after its lease times out — "
-                        "records from it will be delivered again", task_id, ack_err,
-                    )
+                        yield from recordio.read_shards(shards, deserialize)
+                    except BaseException:
+                        # the failure ack itself can fail (master died too) —
+                        # it must never mask the original shard-read error;
+                        # the lease timeout replays the task either way.
+                        # Unconsumed tasks from this batch replay the same
+                        # way (crash semantics).
+                        pending_failed.append(task_id)
+                        try:
+                            _flush_acks()
+                        except ConnectionError as ack_err:
+                            stats.FT_EVENTS.incr("task_ack_failed")
+                            log.warning(
+                                "failure ack for task %d lost (%s); the task "
+                                "replays after its lease times out",
+                                task_id, ack_err,
+                            )
+                            # drop them: the finally-flush would only repeat
+                            # the terminal retry loop against a dead master
+                            pending_done.clear()
+                            pending_failed.clear()
+                        raise
+                    # the done ack rides the NEXT get_tasks request — one
+                    # round trip per lease_batch tasks, not one per ack
+                    pending_done.append(task_id)
         finally:
             if hb is not None:
                 hb.stop()
@@ -1771,6 +2171,15 @@ def cluster_reader(
                 _READER_IDENTS[:] = [
                     d for d in _READER_IDENTS if d is not ident
                 ]
+            try:
+                _flush_acks()
+            except ConnectionError as ack_err:
+                stats.FT_EVENTS.incr("task_ack_failed")
+                log.warning(
+                    "final ack flush of %d task(s) failed (%s); they replay "
+                    "after their leases time out — records from them will be "
+                    "delivered again", len(pending_done), ack_err,
+                )
             if ident["trainer_id"] is not None:
                 try:
                     client.call("deregister", trainer_id=ident["trainer_id"])

@@ -209,9 +209,24 @@ class FleetView:
                 and now - r.last_seen > self.lease_s
             ]
 
-    def choose(self, exclude: Set[str] = frozenset()) -> Optional[Replica]:
+    # occupancy slack the affinity preference may cost: the affine replica
+    # wins while its occupancy-per-slot is within this much of the
+    # least-loaded choice, so warm-prefix placement never piles a hot
+    # prompt onto an already-saturated replica
+    AFFINITY_SLACK = 0.25
+
+    def choose(self, exclude: Set[str] = frozenset(),
+               prefer: Optional[str] = None) -> Optional[Replica]:
         """The least-loaded LIVE replica (None when none) — pure piggybacked
-        state, deterministic tie-breaks; see _score."""
+        state, deterministic tie-breaks; see _score.
+
+        Prefix affinity (ISSUE 20 / ROADMAP 2a): with `prefer` naming a
+        replica, that replica wins while it is LIVE, not excluded, and its
+        occupancy is within AFFINITY_SLACK of the least-loaded candidate —
+        multi-turn traffic sharing a prompt head lands on the replica whose
+        prefix cache is already warm. A dead/evicted/overloaded preferred
+        replica degrades to the plain least-loaded choice (failover keeps
+        working because the preference is a hint, never a constraint)."""
         with self._lock:
             candidates = [
                 r for r in self._replicas.values()
@@ -220,7 +235,14 @@ class FleetView:
             ]
         if not candidates:
             return None
-        return min(candidates, key=_score)
+        best = min(candidates, key=_score)
+        if prefer is not None and prefer != best.replica_id:
+            for r in candidates:
+                if (r.replica_id == prefer
+                        and _score(r)[0] <= _score(best)[0]
+                        + self.AFFINITY_SLACK):
+                    return r
+        return best
 
 
 class ReplicaAgent:

@@ -44,6 +44,7 @@ Gate: `benchmarks/chaos_bench.py --mode router`."""
 
 from __future__ import annotations
 
+import collections
 import itertools
 import logging
 import threading
@@ -66,6 +67,24 @@ class _BadRequest(RuntimeError):
     """A replica refused the forward for a non-load reason (bad prompt,
     over max_len, ...): the client's problem, not the fleet's — never
     retried on another replica."""
+
+
+# prompt tokens hashed into the affinity key: long enough to distinguish
+# system prompts, short enough that appending user turns to a shared head
+# still lands on the same replica (ROADMAP 2a's multi-turn shape)
+AFFINITY_HEAD = 16
+
+
+def affinity_key(prompt: Sequence[int]) -> Optional[int]:
+    """Prefix-affinity key (ISSUE 20 / ROADMAP 2a): a hash of the prompt
+    HEAD, so requests sharing a system prompt / conversation prefix map to
+    the same key and the dispatch score can prefer the replica whose
+    prefix cache (runtime/kv_share.py shapes) is already warm for it.
+    Int-tuple hashing is deterministic within a process — this key never
+    crosses the wire."""
+    if not prompt:
+        return None
+    return hash(tuple(prompt[:AFFINITY_HEAD]))
 
 
 class RouterHandle:
@@ -91,6 +110,7 @@ class RouterHandle:
         self.tenant = tenant
         self.prompt = prompt
         self.prompt_len = len(prompt)
+        self.affinity = affinity_key(prompt)
         self.max_new_tokens = max_new_tokens
         self.key = key  # the fleet-wide idempotency key (client_req_id)
         # the pinned sampling identity: forwarded EXPLICITLY on every
@@ -229,6 +249,17 @@ class Router:
         # pump threads never touch a client socket
         self._stream_cv = threading.Condition()
         self._stream_seq = 0
+        # prefix-affinity books (ISSUE 20 / ROADMAP 2a): affinity key ->
+        # replica_id of the LAST successful assignment with that prompt
+        # head, bounded LRU (guarded by self._lock). Dispatch prefers the
+        # mapped replica within FleetView.AFFINITY_SLACK; a failover simply
+        # re-points the key at the surviving replica it lands on.
+        self._affinity: "collections.OrderedDict[int, str]" = (
+            collections.OrderedDict()
+        )
+        self.affinity_cap = 4096
+        self.affinity_hits = 0     # assignments landed on the affine replica
+        self.affinity_misses = 0   # keyed assignments that landed elsewhere
         # fleet counters (also exported via obs metrics)
         self.submitted = 0
         self.completed = 0
@@ -583,6 +614,8 @@ class Router:
             "replica_evictions": self.replica_evictions,
             "drains_completed": self.drains_completed,
             "adopted_requests": self.adopted,
+            "affinity_hits": self.affinity_hits,
+            "affinity_misses": self.affinity_misses,
             "instance": self.instance,
             # the tightest current queue-wait estimate across live replicas:
             # what a load balancer above THIS tier would piggyback on
@@ -643,9 +676,17 @@ class Router:
                 self._submit_clients[rep.replica_id] = got
             return got
 
-    def _choose_replica(self, exclude: Set[str]) -> Optional[Replica]:
-        """Pure piggybacked-state choice — no RPC lives here (lint-pinned)."""
-        return self.fleet.choose(exclude=exclude)
+    def _choose_replica(self, exclude: Set[str],
+                        affinity: Optional[int] = None) -> Optional[Replica]:
+        """Pure piggybacked-state choice — no RPC lives here (lint-pinned).
+        With an affinity key, the replica that last served this prompt head
+        is preferred (within the fleet's load slack); a dead or excluded
+        affine replica degrades to plain least-loaded."""
+        prefer = None
+        if affinity is not None:
+            with self._lock:
+                prefer = self._affinity.get(affinity)
+        return self.fleet.choose(exclude=exclude, prefer=prefer)
 
     def _try_assign(self, h: RouterHandle, now: float,
                     exclude: Optional[Set[str]] = None,
@@ -656,7 +697,7 @@ class Router:
         tried: Set[str] = set(exclude or ())
         hints: List[Optional[int]] = []
         while not h._finished:
-            rep = self._choose_replica(tried)
+            rep = self._choose_replica(tried, affinity=h.affinity)
             if rep is None:
                 break
             try:
@@ -709,6 +750,19 @@ class Router:
             raise _BadRequest(str(resp["err"]))
         rrid = int(resp["request_id"])
         with self._lock:
+            if h.affinity is not None:
+                # record (and LRU-refresh) the prompt-head -> replica map;
+                # a failover landing elsewhere re-points the key so the
+                # NEXT request with this head follows the warm cache
+                if self._affinity.get(h.affinity) == rep.replica_id:
+                    self.affinity_hits += 1
+                else:
+                    if h.affinity in self._affinity:
+                        self.affinity_misses += 1
+                    self._affinity[h.affinity] = rep.replica_id
+                self._affinity.move_to_end(h.affinity)
+                while len(self._affinity) > self.affinity_cap:
+                    self._affinity.popitem(last=False)
             rep.rids[h.request_id] = rrid
             rep.outstanding.add(h.request_id)
             rep.assigned_total += 1
@@ -751,7 +805,13 @@ class Router:
         return cancels
 
     def _send_cancels(self, cancels: List[Tuple[str, int, str]]) -> None:
+        # pipelined (ISSUE 20): group per replica and ship each group as
+        # ONE batch on the shared socket — a drain-timeout or multi-hedge
+        # teardown stops paying a round trip per cancelled request
+        by_rep: Dict[str, List[Tuple[int, str]]] = {}
         for rep_id, rrid, tenant in cancels:
+            by_rep.setdefault(rep_id, []).append((rrid, tenant))
+        for rep_id, batch in by_rep.items():
             rep = self.fleet.get(rep_id)
             if rep is None:
                 continue
@@ -759,7 +819,10 @@ class Router:
             try:
                 with lock:
                     # rpc-ok: per cancel/hedge-loser order, never per step
-                    client.call("cancel", request_id=rrid, tenant_id=tenant)
+                    client.call_many([
+                        ("cancel", {"request_id": rrid, "tenant_id": tenant})
+                        for rrid, tenant in batch
+                    ])
             except (ConnectionError, OSError):
                 pass  # dead replica: nothing to cancel anymore
 
@@ -1150,6 +1213,10 @@ class RouterServer:
         self._thread: Optional[threading.Thread] = None
         self._killed = False
         self.stream_frames = 0
+        self.stream_bytes = 0
+        self.stream_tokens = 0
+        self.stream_coalesced = 0
+        self.stream_active = 0  # pushers currently attached (fan-out gauge)
         self._stream_lock = threading.Lock()
 
     @property
@@ -1190,6 +1257,9 @@ class RouterServer:
             out = r.stats()
             out["live_tenants"] = self.membership.live
             out["stream_frames_pushed"] = self.stream_frames
+            out["stream_bytes_pushed"] = self.stream_bytes
+            out["stream_tokens_pushed"] = self.stream_tokens
+            out["stream_frames_coalesced"] = self.stream_coalesced
             return out
         if method == "metrics":
             return {"text": obs_metrics.to_prometheus_text()}
@@ -1289,10 +1359,18 @@ class RouterServer:
             "cancelled": h.status == RouterHandle.CANCELLED,
         }
 
-    def note_frames(self, n: int) -> None:
+    def note_frames(self, n: int, nbytes: int = 0, ntokens: int = 0,
+                    coalesced: int = 0) -> None:
         with self._stream_lock:
             self.stream_frames += n
+            self.stream_bytes += nbytes
+            self.stream_tokens += ntokens
+            self.stream_coalesced += coalesced
         stats.FT_EVENTS.incr("router_stream_frames", n)
+
+    def note_stream(self, delta: int) -> None:
+        with self._stream_lock:
+            self.stream_active += delta
 
     def start(self) -> "RouterServer":
         self.router.start()

@@ -38,6 +38,7 @@ import numpy as np
 from paddle_tpu.core import stats
 from paddle_tpu.obs import metrics as obs_metrics
 from paddle_tpu.obs import trace as obs_trace
+from paddle_tpu.runtime import frames
 from paddle_tpu.runtime.master import (
     EndpointsLike,
     MasterClient,
@@ -50,12 +51,28 @@ from paddle_tpu.serving.session import ServingSession
 log = logging.getLogger("paddle_tpu.serving")
 
 
-def encode_frame(obj: Any) -> bytes:
-    """Wire encoding for ONE push-stream frame (ISSUE 16). Line-JSON today
-    — the same framing the request/reply plane already speaks, so every
-    client, router pump and chaos site handles frames for free; this
-    function is the single seam a binary framing would replace."""
+def encode_frame(obj: Any, framed: bool = False) -> bytes:
+    """Wire encoding for ONE push-stream frame — the single stream-encode
+    seam (ISSUE 16 named it; ISSUE 20 filled in the binary branch). On a
+    legacy connection it is the line-JSON framing the request/reply plane
+    already speaks; on a negotiated framed connection it delegates to
+    `frames.encode_stream`, whose compact delta form costs 4 bytes per
+    token plus a 20-byte header instead of a JSON object per frame."""
+    if framed:
+        return frames.encode_stream(obj)
     return json.dumps(obj).encode() + b"\n"
+
+
+# Coalescing rules for the FRAMED push wire (ISSUE 20): under fan-out the
+# per-stream header cost dominates, so a pusher holding a small delta waits
+# a few engine steps for more tokens before emitting — one frame, one
+# header, many tokens. Below the fan-out threshold latency wins and every
+# delta flushes immediately; `done` frames ALWAYS flush; the legacy
+# line-JSON wire is never held (its cadence must stay bit-for-bit what
+# pre-frames clients observed).
+COALESCE_FANOUT = 8      # active pushers at/above which coalescing arms
+COALESCE_MIN_TOKENS = 8  # target tokens per binary delta under fan-out
+COALESCE_MAX_HOLDS = 7   # engine steps a partial delta may be held
 
 
 def clamp_cursor(val: Any, n: int) -> int:
@@ -84,35 +101,89 @@ class _Handler(socketserver.StreamRequestHandler):
             except json.JSONDecodeError:
                 self._reply({"err": "bad json"})
                 continue
-            tenant_id = req.get("tenant_id")
-            srv.membership.note_seen(tenant_id)
-            try:
-                # handler span adopts the client's piggybacked trace context
-                # (ServingClient rides on MasterClient, which injects
-                # `_trace`) — and is itself the parent the session's
-                # queue-wait/prefill/ttft spans stitch under
-                with obs_trace.server_span(
-                    "rpc." + str(req.get("method")), req.get("_trace"),
-                    side="server",
-                ):
-                    resp = srv.dispatch(req.get("method"), req, tenant_id)
-            except QuotaExceeded as e:
-                resp = {"err": str(e), "rejected": e.reason}
-                if getattr(e, "retry_after_ms", None) is not None:
-                    # load-shed hint: when retrying could plausibly succeed,
-                    # derived from queue wait + free-page pressure
-                    resp["retry_after_ms"] = e.retry_after_ms
-            except Exception as e:  # a bad request must not kill the server
-                log.warning("serving RPC failed: %r", e)
-                resp = {"err": f"{type(e).__name__}: {e}"}
-            stream = (
-                resp.pop("_stream", None) if isinstance(resp, dict) else None
-            )
+            if req.get("method") == "_hello":
+                # wire negotiation (ISSUE 20) — deliberately line-JSON: a
+                # frame-capable client probes, this connection upgrades to
+                # the framed loop; a legacy client never sends the probe
+                # and is served bit-for-bit by this unchanged line path
+                if req.get("frames") == 1:
+                    self._reply({"frames": 1})
+                    self._serve_frames(srv)
+                    return
+                self._reply({"frames": 0})
+                continue
+            resp, stream = self._dispatch(srv, req)
             self._reply(resp)
             if stream is not None:
                 # push mode: this connection becomes a frame stream for one
                 # request (until its final frame, then the read loop resumes)
                 self._push_frames(srv, *stream)
+
+    def _dispatch(self, srv: Any, req: dict) -> tuple:
+        tenant_id = req.get("tenant_id")
+        srv.membership.note_seen(tenant_id)
+        try:
+            # handler span adopts the client's piggybacked trace context
+            # (ServingClient rides on MasterClient, which injects
+            # `_trace`) — and is itself the parent the session's
+            # queue-wait/prefill/ttft spans stitch under
+            with obs_trace.server_span(
+                "rpc." + str(req.get("method")), req.get("_trace"),
+                side="server",
+            ):
+                resp = srv.dispatch(req.get("method"), req, tenant_id)
+        except QuotaExceeded as e:
+            resp = {"err": str(e), "rejected": e.reason}
+            if getattr(e, "retry_after_ms", None) is not None:
+                # load-shed hint: when retrying could plausibly succeed,
+                # derived from queue wait + free-page pressure
+                resp["retry_after_ms"] = e.retry_after_ms
+        except Exception as e:  # a bad request must not kill the server
+            log.warning("serving RPC failed: %r", e)
+            resp = {"err": f"{type(e).__name__}: {e}"}
+        stream = (
+            resp.pop("_stream", None) if isinstance(resp, dict) else None
+        )
+        return resp, stream
+
+    def _serve_frames(self, srv: Any) -> None:
+        """Framed loop for one negotiated connection: same dispatch, but
+        replies are frames with token runs packed binary, and push streams
+        cut compact binary deltas instead of JSON lines."""
+        while not getattr(srv, "_killed", False):
+            try:
+                got = frames.read_frame(self.rfile)
+            except frames.FrameError as e:
+                # a malformed frame severs THIS connection with a named
+                # error instead of wedging the handler thread mid-read
+                self._reply_frame({"err": f"{type(e).__name__}: {e}"}, 0, 0)
+                return
+            except OSError:
+                return
+            if got is None:
+                return
+            obj, rid, flags, blob = got
+            req = frames.decode_payload(obj, rid, flags, blob)
+            resp, stream = self._dispatch(srv, req)
+            rflags = 0
+            bin_out = b""
+            if isinstance(resp, dict):
+                resp, bin_out = frames.pack_tokens(resp)
+                if bin_out:
+                    rflags |= frames.FLAG_BIN_TOKENS
+            self._reply_frame(resp, rid, rflags, bin_out)
+            if stream is not None:
+                self._push_frames(srv, *stream, framed=True)
+
+    def _reply_frame(self, obj: Any, req_id: int, flags: int,
+                     bin_payload: bytes = b"") -> None:
+        try:
+            frames.write_frame(
+                self.wfile, obj, req_id=req_id, flags=flags,
+                bin_payload=bin_payload,
+            )
+        except (OSError, ValueError):
+            pass  # peer vanished; its retry path handles it
 
     def _reply(self, obj: Any) -> None:
         try:
@@ -121,7 +192,8 @@ class _Handler(socketserver.StreamRequestHandler):
         except (OSError, ValueError):
             pass  # peer vanished; its retry path handles it
 
-    def _push_frames(self, srv: Any, handle: Any, cursor: int) -> None:
+    def _push_frames(self, srv: Any, handle: Any, cursor: int,
+                     framed: bool = False) -> None:
         """Push token frames for one request until it finishes or the peer
         vanishes. Frames are DELTAS from `cursor` (the same cursor contract
         delta-poll uses, so a reattach after a dropped connection resumes
@@ -131,33 +203,59 @@ class _Handler(socketserver.StreamRequestHandler):
         a decode step. Polling the same request stays authoritative: a
         stream is a fast path, not the source of truth."""
         seq = 0
-        while True:
-            next_seq = srv.stream_wait(seq)
-            # done BEFORE tokens: completion is latched after the final
-            # append, so a True here guarantees the token read is complete
-            # (the reverse order could stamp `done` on a truncated frame)
-            done = handle.done
-            toks = list(handle.tokens)
-            n = len(toks)
-            if n > cursor or done:
-                frame = {
-                    "request_id": handle.request_id,
-                    "from": cursor,
-                    "tokens": toks[cursor:],
-                    "tokens_so_far": n,
-                }
-                cursor = n
-                if done:
-                    frame.update(srv._stream_final(handle))
-                try:
-                    self.wfile.write(encode_frame(frame))
-                    self.wfile.flush()
-                except (OSError, ValueError):
-                    return  # peer went away; poll/reattach picks it back up
-                srv.note_frames(1)
-                if done:
-                    return
-            seq = next_seq
+        held = 0
+        grown = cursor  # high-water mark: counts THIS stream's decode steps,
+        # not global wakes (every pusher shares one notify sequence)
+        srv.note_stream(1)
+        try:
+            while True:
+                next_seq = srv.stream_wait(seq)
+                # done BEFORE tokens: completion is latched after the final
+                # append, so a True here guarantees the token read is complete
+                # (the reverse order could stamp `done` on a truncated frame)
+                done = handle.done
+                toks = list(handle.tokens)
+                n = len(toks)
+                if n > cursor or done:
+                    delta = n - cursor
+                    if (framed and not done
+                            and delta < COALESCE_MIN_TOKENS
+                            and held < COALESCE_MAX_HOLDS
+                            and srv.stream_active >= COALESCE_FANOUT):
+                        if n > grown:
+                            held += 1
+                            grown = n
+                        seq = next_seq
+                        continue
+                    held = 0
+                    grown = n
+                    frame = {
+                        "request_id": handle.request_id,
+                        "from": cursor,
+                        "tokens": toks[cursor:],
+                        "tokens_so_far": n,
+                    }
+                    cursor = n
+                    if done:
+                        frame.update(srv._stream_final(handle))
+                    buf = encode_frame(frame, framed)
+                    try:
+                        self.wfile.write(buf)
+                        self.wfile.flush()
+                    except (OSError, ValueError):
+                        # peer went away; poll/reattach picks it back up
+                        return
+                    # coalescing observability (ISSUE 20): a multi-token
+                    # delta IS the coalesced frame — a subscriber that fell
+                    # behind (or was held under fan-out) gets the whole
+                    # backlog in one frame, one encode
+                    srv.note_frames(1, nbytes=len(buf), ntokens=delta,
+                                    coalesced=1 if delta > 1 else 0)
+                    if done:
+                        return
+                seq = next_seq
+        finally:
+            srv.note_stream(-1)
 
 
 class ServingServer:
@@ -243,8 +341,14 @@ class ServingServer:
         self._agent = None
         self._killed = False
         # push-streaming observability: frames written by pusher threads
-        # (exported via stats + the obs counter; the engine never writes)
+        # (exported via stats + the obs counter; the engine never writes).
+        # bytes/tokens/coalesced feed the bench's bytes-per-delivered-token
+        # and coalescing-rate views (ISSUE 20)
         self.stream_frames = 0
+        self.stream_bytes = 0
+        self.stream_tokens = 0
+        self.stream_coalesced = 0
+        self.stream_active = 0  # pushers currently attached (fan-out gauge)
         self._stream_lock = threading.Lock()
 
     @property
@@ -269,6 +373,9 @@ class ServingServer:
             out["live_tenants"] = self.membership.live
             out["evicted_tenants"] = self.membership.evicted
             out["stream_frames_pushed"] = self.stream_frames
+            out["stream_bytes_pushed"] = self.stream_bytes
+            out["stream_tokens_pushed"] = self.stream_tokens
+            out["stream_frames_coalesced"] = self.stream_coalesced
             if self.master_endpoints is not None:
                 out["master"] = self._master_health()
             return out
@@ -560,12 +667,20 @@ class ServingServer:
             "cancelled": handle.status == RequestHandle.CANCELLED,
         }
 
-    def note_frames(self, n: int) -> None:
+    def note_frames(self, n: int, nbytes: int = 0, ntokens: int = 0,
+                    coalesced: int = 0) -> None:
         from paddle_tpu.serving.session import SERVING_EVENTS
 
         with self._stream_lock:
             self.stream_frames += n
+            self.stream_bytes += nbytes
+            self.stream_tokens += ntokens
+            self.stream_coalesced += coalesced
         SERVING_EVENTS.incr("serving_stream_frames", n)
+
+    def note_stream(self, delta: int) -> None:
+        with self._stream_lock:
+            self.stream_active += delta
 
     def _generate_config(self, req: dict) -> dict:
         """Whole-request generation against the long-lived GenerationSession
@@ -736,6 +851,13 @@ class ServingClient:
         self._client = MasterClient(address, **client_kw)
         self.tenant_id: Optional[str] = None
         self.lease_s: float = 30.0
+        # wire accounting for the dedicated stream connections (ISSUE 20):
+        # each stream() conn folds its byte/round-trip counters in here when
+        # it closes, so a bench can compute bytes per delivered token across
+        # the request/reply client AND every push stream it ran
+        self.stream_bytes_in = 0
+        self.stream_bytes_out = 0
+        self.stream_round_trips = 0
         self.hedges = 0  # hedged retries issued (TTFT-deadline misses)
         self.shed_retries = 0  # submits retried after a shed's retry_after_ms
         self.stream_reattaches = 0  # dropped push-streams resumed by cursor
@@ -956,6 +1078,7 @@ class ServingClient:
         failures = 0
         conn = MasterClient(
             self._client.endpoints, timeout=self._client.timeout, retries=2,
+            wire=self._client.wire,
         )
         try:
             while True:
@@ -1038,6 +1161,9 @@ class ServingClient:
                     conn.close()  # reattach from `delivered` on a fresh socket
         finally:
             conn.close()
+            self.stream_bytes_in += conn.bytes_received
+            self.stream_bytes_out += conn.bytes_sent
+            self.stream_round_trips += conn.round_trips
 
     def cancel(self, request_id: int) -> dict:
         """Cancel a submitted request server-side (pages recycle at the next
@@ -1062,6 +1188,21 @@ class ServingClient:
         return self._client.call(
             "trace_export", **self._id_kw()
         ).get("chrome_trace", {})
+
+    @property
+    def wire_framed(self) -> bool:
+        """True once the request/reply connection negotiated binary frames."""
+        return self._client.wire_framed
+
+    def wire_totals(self) -> dict:
+        """Bytes and round trips this client has spent on the wire — the
+        request/reply connection plus every finished push stream (bench
+        food: bytes per delivered token, round trips per token)."""
+        return {
+            "bytes_in": self._client.bytes_received + self.stream_bytes_in,
+            "bytes_out": self._client.bytes_sent + self.stream_bytes_out,
+            "round_trips": self._client.round_trips + self.stream_round_trips,
+        }
 
     def close(self) -> None:
         self._client.close()

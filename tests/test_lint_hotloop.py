@@ -452,7 +452,10 @@ def test_updater_reshard_sites_tagged_and_pinned():
 # assignment/pump/reap path is the "RPC Considered Harmful" regression this
 # lint pins — a fleet-size cap smuggled in as an innocent health probe.
 
-RPC_CALL = re.compile(r"\.call\(")
+# ISSUE 20: `.call_many(` (the pipelined batch) and `.call_stream(` are
+# round trips too — a batched RPC smuggled into a dispatch loop is still
+# a blocking replica RPC and needs the same tag
+RPC_CALL = re.compile(r"\.call(?:_many|_stream)?\(")
 RPC_TAG = "rpc-ok"
 # (file, class, dispatch-path methods, max rpc-ok tags)
 #
@@ -878,4 +881,102 @@ def test_decode_hot_bodies_stay_prefix_free():
         "admission/commit-time structure (reserve aliases, commit_prefix "
         "registers); decode and verify only ever write pages past the "
         "prompt:\n  " + "\n  ".join(offenders)
+    )
+
+
+# -- binary control plane (ISSUE 20 framed wire) ------------------------------
+#
+# The framed transport exists to get per-token/per-task JSON encode cost OFF
+# the hot paths: stream pushes ride frames.encode_stream (compact binary
+# deltas), control replies ride frames.write_frame, and heartbeats piggyback
+# on data frames. Two disciplines keep that true:
+#
+#   * the hot emission/dispatch bodies — router pump + dispatch, the
+#     handler's frame loop and push loop, both heartbeat loops — never call
+#     json.dumps/json.loads DIRECTLY (zero tolerance, no tag): every codec
+#     decision lives behind the frames/encode_frame seams, so switching a
+#     connection's wire can never leave a stray JSON encode on the hot path;
+#   * the header struct is packed in exactly THREE places, all inside
+#     frames.write_frame / frames.encode_stream, and server.py reaches
+#     frames.encode_stream through exactly ONE call site (encode_frame, the
+#     seam call_stream parses against) — one framing implementation, nothing
+#     to drift.
+
+FRAMES_PY = os.path.join(_REPO, "paddle_tpu", "runtime", "frames.py")
+MASTER_PY = os.path.join(_REPO, "paddle_tpu", "runtime", "master.py")
+FLEET_PY = os.path.join(_REPO, "paddle_tpu", "serving", "fleet.py")
+
+JSON_CODEC_CALL = re.compile(r"(?<![\w.])json\.dumps\(|(?<![\w.])json\.loads\(")
+# (file, class, wire-hot methods) — zero tolerance, no tags
+WIRE_JSON_FREE = [
+    (ROUTER_PY, "Router",
+     ("_pump_once", "_on_result", "_try_assign", "_choose_replica",
+      "_forward", "_send_cancels")),
+    (SERVER_PY, "_Handler",
+     ("_push_frames", "_serve_frames", "_reply_frame", "_dispatch")),
+    (MASTER_PY, "_Heartbeater", ("_loop",)),
+    (FLEET_PY, "ReplicaAgent", ("_loop",)),
+]
+
+
+def test_wire_hot_paths_free_of_direct_json_codec():
+    """No direct json.dumps/json.loads in the wire-hot bodies, tagged or
+    not — encoding decisions belong to the frames module / encode_frame
+    seam, where the per-connection wire negotiation picks the codec."""
+    violations = []
+    for path, cls, methods in WIRE_JSON_FREE:
+        v, _ = _scan(path, cls, methods, JSON_CODEC_CALL, tag=None)
+        violations += v
+    assert not violations, (
+        "direct JSON codec call on a wire-hot path — route it through "
+        "frames.write_frame / encode_frame so the negotiated wire (not the "
+        "call site) owns the encoding:\n  " + "\n  ".join(violations)
+    )
+
+
+def _module_spans(tree: ast.Module, methods):
+    """Module-level function spans (the _hot_spans sibling for functions
+    that live outside any class)."""
+    for node in tree.body:
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in methods
+        ):
+            yield node.name, node.lineno, node.end_lineno
+
+
+def test_frame_header_packed_only_in_the_two_encoders():
+    """`_HEADER.pack(` appears exactly 3 times in frames.py — once in
+    write_frame (control/reply frames) and twice in encode_stream (the
+    compact delta and the JSON-carrying stream frame). A fourth site is a
+    second framing implementation."""
+    source, sites = _call_sites(FRAMES_PY, re.compile(r"_HEADER\.pack\("))
+    spans = {name: (lo, hi) for name, lo, hi in _module_spans(
+        ast.parse(source), ("write_frame", "encode_stream"))}
+    assert set(spans) == {"write_frame", "encode_stream"}, (
+        f"frames.write_frame/encode_stream moved/renamed — update {__file__}"
+    )
+    in_wf = [ln for ln in sites
+             if spans["write_frame"][0] <= ln <= spans["write_frame"][1]]
+    in_es = [ln for ln in sites
+             if spans["encode_stream"][0] <= ln <= spans["encode_stream"][1]]
+    assert len(sites) == 3 and len(in_wf) == 1 and len(in_es) == 2, (
+        f"_HEADER.pack( sites in frames.py at lines {sites} (pinned: 1 in "
+        "write_frame + 2 in encode_stream) — every frame on the wire must "
+        "come from one of the two encoders call sites parse against"
+    )
+
+
+def test_stream_binary_encoder_reached_through_one_seam():
+    """server.py calls frames.encode_stream from exactly one place — inside
+    encode_frame, the wire-switch seam — so the framed and line stream
+    encodings can never diverge per call site."""
+    source, sites = _call_sites(SERVER_PY, re.compile(r"encode_stream\("))
+    spans = list(_module_spans(ast.parse(source), ("encode_frame",)))
+    assert spans, f"server.encode_frame moved/renamed — update {__file__}"
+    _, lo, hi = spans[0]
+    assert len(sites) == 1 and lo <= sites[0] <= hi, (
+        f"encode_stream( call sites in server.py at lines {sites} (pinned: "
+        "exactly 1, inside encode_frame) — push frames pick their codec at "
+        "the encode_frame seam only"
     )

@@ -511,3 +511,125 @@ def test_poll_many_batches_and_scopes_tenancy(model_and_params):
         assert out[2]["err"] == "unknown"
     finally:
         srv.stop()
+
+
+# -- prefix affinity (ISSUE 20 / ROADMAP 2a) ----------------------------------
+
+
+def _mk_fleet_view(n):
+    from paddle_tpu.serving.fleet import FleetView
+
+    fv = FleetView(lease_s=30.0)
+    reps = [fv.register(("127.0.0.1", 9000 + i)) for i in range(n)]
+    for r in reps:
+        r.load = {"max_slots": 4}
+    return fv, reps
+
+
+def test_fleet_choose_affinity_hint_semantics():
+    """The affine replica wins within AFFINITY_SLACK occupancy; past the
+    slack, dead, or excluded, the preference degrades to least-loaded —
+    a hint, never a constraint."""
+    from paddle_tpu.serving.fleet import ReplicaState
+
+    fv, (r0, r1) = _mk_fleet_view(2)
+    # idle fleet: the index tie-break says r0, the preference says r1
+    assert fv.choose().replica_id == r0.replica_id
+    assert fv.choose(prefer=r1.replica_id).replica_id == r1.replica_id
+    # one in-flight request (0.25 occupancy at 4 slots) is exactly within
+    # the slack: same-prefix traffic stays on the warm replica
+    r1.outstanding.add(1)
+    assert fv.choose(prefer=r1.replica_id).replica_id == r1.replica_id
+    # past the slack the preference loses to load balance
+    r1.load = {"max_slots": 4, "queue_depth": 2}
+    assert fv.choose(prefer=r1.replica_id).replica_id == r0.replica_id
+    # a dead affine replica fails over to the survivor
+    r1.load = {"max_slots": 4}
+    r1.outstanding.clear()
+    r1.state = ReplicaState.EVICTED
+    assert fv.choose(prefer=r1.replica_id).replica_id == r0.replica_id
+    # an excluded affine replica (already tried this request) is skipped
+    r1.state = ReplicaState.LIVE
+    assert fv.choose(
+        exclude={r1.replica_id}, prefer=r1.replica_id
+    ).replica_id == r0.replica_id
+
+
+def test_affinity_key_hashes_prompt_head():
+    from paddle_tpu.serving.router import AFFINITY_HEAD, affinity_key
+
+    a = affinity_key([1, 2, 3, 4])
+    assert a == affinity_key([1, 2, 3, 4])          # deterministic
+    assert a != affinity_key([9, 2, 3, 4])          # head-sensitive
+    long = list(range(AFFINITY_HEAD)) + [50]
+    assert affinity_key(long) == affinity_key(long[:-1] + [77])  # tail-blind
+    assert affinity_key([]) is None                  # empty prompt: no key
+
+
+def test_affinity_warm_hit_rate_beats_pure_least_loaded():
+    """Synthetic dispatch trace at EQUAL load: two prompt heads interleave
+    with a bounded in-flight window. The affinity map keeps each head on
+    the replica that served it last (warm prefix cache); pure least-loaded
+    ping-pongs on occupancy ties. Warm-hit rate = fraction of repeat-head
+    dispatches landing where that head last ran."""
+
+    def run(affine):
+        fv, reps = _mk_fleet_view(2)
+        amap, last, inflight = {}, {}, []
+        hits = total = 0
+        used = set()
+        for i in range(40):
+            head = "A" if i % 2 == 0 else "B"
+            rep = fv.choose(prefer=amap.get(head) if affine else None)
+            if head in last:
+                total += 1
+                hits += rep.replica_id == last[head]
+            last[head] = amap[head] = rep.replica_id
+            used.add(rep.replica_id)
+            rep.outstanding.add(i)
+            inflight.append((rep, i))
+            if len(inflight) > 2:  # steady state: 2 requests in flight
+                old, rid = inflight.pop(0)
+                old.outstanding.discard(rid)
+        return hits / total, used
+
+    warm_rate, warm_used = run(affine=True)
+    cold_rate, _ = run(affine=False)
+    assert warm_rate > cold_rate, (warm_rate, cold_rate)
+    assert warm_rate >= 0.9                 # affinity keeps heads pinned
+    assert len(warm_used) == 2              # ... without starving a replica
+
+
+@pytest.mark.timeout(120)
+def test_affinity_failover_when_affine_replica_dies(
+    model_and_params, reference
+):
+    """The router remembers which replica served PROMPT's head; kill that
+    replica and the same head must complete on the survivor (preference is
+    a hint — eviction beats affinity), token-identical to the oracle."""
+    from paddle_tpu.serving.server import ServingClient
+
+    router, servers = make_fleet(model_and_params, 2, lease_s=1.5)
+    try:
+        client = ServingClient(router.address)
+        r1 = client.generate(PROMPT, 8)
+        assert r1["tokens"] == reference["greedy"]
+        aff = dict(router.router._affinity)
+        assert len(aff) == 1, "dispatch recorded the prompt-head affinity"
+        affine_id = next(iter(aff.values()))
+        rep = router.fleet.get(affine_id)
+        assert rep is not None
+        victim = next(
+            (srv, sess) for srv, sess in servers
+            if srv.address[1] == rep.endpoint[1]
+        )
+        victim[0].kill()
+        assert _wait(lambda: len(router.fleet.live()) == 1), "eviction"
+        r2 = client.generate(PROMPT, 8)
+        assert r2["tokens"] == reference["greedy"]
+        # the map re-pointed at the survivor for the next warm hit
+        survivor = router.fleet.live()[0].replica_id
+        assert router.router._affinity.get(next(iter(aff))) == survivor
+        assert survivor != affine_id
+    finally:
+        stop_fleet(router, servers)
